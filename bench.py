@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Benchmark harness: PPO CartPole env-steps/sec on the available accelerator.
+
+Mirrors the reference benchmark conditions (``sheeprl/configs/exp/
+ppo_benchmarks.yaml``: 65536 total steps, 1 env, sync, logging/checkpoints
+off; reference wall-clock 81.27 s on 4 CPUs → ~806 env-steps/s, see
+BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_STEPS_PER_SEC = 65536 / 81.27  # reference PPO benchmark (README.md:100-117)
+
+
+def main() -> None:
+    total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", 65536))
+    overrides = [
+        "exp=ppo_benchmarks",
+        f"algo.total_steps={total_steps}",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "checkpoint.save_last=False",
+        "metric.log_level=0",
+        "metric.disable_timer=True",
+    ]
+    from sheeprl_tpu.cli import run
+
+    start = time.perf_counter()
+    run(overrides)
+    elapsed = time.perf_counter() - start
+    steps_per_sec = total_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "env-steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

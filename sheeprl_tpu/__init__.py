@@ -1,0 +1,21 @@
+"""sheeprl_tpu — a TPU-native reinforcement-learning framework.
+
+A ground-up JAX/XLA re-design with the capability surface of SheepRL
+(reference mounted at /root/reference): the same algorithms, config tree, CLI
+verbs, buffers, checkpointing and metrics — built on pure functions, pytrees,
+``lax.scan`` and a ``jax.sharding.Mesh`` instead of torch modules and
+Lightning Fabric.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Surpress noisy warnings from third-party imports at CLI startup
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+__version__ = "0.1.0"
+
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry  # noqa: E402
+
+__all__ = ["algorithm_registry", "evaluation_registry", "__version__"]

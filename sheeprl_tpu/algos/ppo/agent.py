@@ -1,0 +1,248 @@
+"""PPO agent in Flax (reference: ``sheeprl/algos/ppo/agent.py:20-330``).
+
+One flax module holds encoder + actor + critic; the *player* of the reference
+(a weight-tied single-device copy, ``agent.py:254+``) is simply a set of
+jitted apply functions over the same params — functional JAX makes the
+weight-tying hack unnecessary (SURVEY §7 "hard parts").
+
+Action-space support mirrors the reference: discrete, multi-discrete
+(one head per sub-action) and continuous (mean/log_std head, Independent
+Normal).
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models import MLP, MultiEncoder, NatureCNN, get_activation
+
+__all__ = ["PPOAgent", "CNNEncoder", "MLPEncoder", "build_agent", "PPOPlayer"]
+
+
+class CNNEncoder(nn.Module):
+    """NatureCNN over channel-concatenated pixel keys (NHWC)."""
+
+    keys: Sequence[str]
+    features_dim: int = 512
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return NatureCNN(features_dim=self.features_dim, dtype=self.dtype, name="nature")(x)
+
+
+class MLPEncoder(nn.Module):
+    keys: Sequence[str]
+    features_dim: Optional[int] = None
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "relu"
+    layer_norm: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            output_dim=self.features_dim,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="mlp",
+        )(x)
+
+
+class PPOAgent(nn.Module):
+    """Returns ``(actor_outs, value)``: for continuous spaces ``actor_outs``
+    is ``[mean_logstd]``; otherwise one logits tensor per sub-action."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    encoder_cfg: Dict[str, Any]
+    actor_cfg: Dict[str, Any]
+    critic_cfg: Dict[str, Any]
+    screen_size: int = 64
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> Tuple[List[jax.Array], jax.Array]:
+        cnn_encoder = (
+            CNNEncoder(keys=self.cnn_keys, features_dim=self.encoder_cfg["cnn_features_dim"], dtype=self.dtype, name="cnn_encoder")
+            if self.cnn_keys
+            else None
+        )
+        mlp_encoder = (
+            MLPEncoder(
+                keys=self.mlp_keys,
+                features_dim=self.encoder_cfg["mlp_features_dim"],
+                dense_units=self.encoder_cfg["dense_units"],
+                mlp_layers=self.encoder_cfg["mlp_layers"],
+                dense_act=self.encoder_cfg["dense_act"],
+                layer_norm=self.encoder_cfg["layer_norm"],
+                dtype=self.dtype,
+                name="mlp_encoder",
+            )
+            if self.mlp_keys
+            else None
+        )
+        feat = MultiEncoder(cnn_encoder, mlp_encoder, name="feature_extractor")(obs)
+
+        value = MLP(
+            hidden_sizes=(self.critic_cfg["dense_units"],) * self.critic_cfg["mlp_layers"],
+            output_dim=1,
+            activation=self.critic_cfg["dense_act"],
+            layer_norm=self.critic_cfg["layer_norm"],
+            dtype=self.dtype,
+            name="critic",
+        )(feat)
+
+        if self.actor_cfg["mlp_layers"] > 0:
+            backbone = MLP(
+                hidden_sizes=(self.actor_cfg["dense_units"],) * self.actor_cfg["mlp_layers"],
+                output_dim=None,
+                activation=self.actor_cfg["dense_act"],
+                layer_norm=self.actor_cfg["layer_norm"],
+                dtype=self.dtype,
+                name="actor_backbone",
+            )(feat)
+        else:
+            backbone = feat
+        if self.is_continuous:
+            out = nn.Dense(int(sum(self.actions_dim)) * 2, dtype=self.dtype, name="actor_head_0")(backbone)
+            actor_outs = [out]
+        else:
+            actor_outs = [
+                nn.Dense(int(d), dtype=self.dtype, name=f"actor_head_{i}")(backbone)
+                for i, d in enumerate(self.actions_dim)
+            ]
+        return actor_outs, value
+
+
+# -- functional policy ops ---------------------------------------------------
+
+
+def _dists(actor_outs: List[jax.Array], is_continuous: bool):
+    from sheeprl_tpu.distributions import Independent, Normal, OneHotCategorical
+
+    if is_continuous:
+        mean, log_std = jnp.split(actor_outs[0], 2, axis=-1)
+        return [Independent(Normal(mean, jnp.exp(log_std)), 1)]
+    return [OneHotCategorical(logits=lo) for lo in actor_outs]
+
+
+def forward_with_actions(
+    agent: PPOAgent, params, obs: Dict[str, jax.Array], actions: List[jax.Array]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Log-prob/entropy/value of given actions (the train-path forward,
+    reference: ``agent.py:155-193``)."""
+    actor_outs, values = agent.apply(params, obs)
+    dists = _dists(actor_outs, agent.is_continuous)
+    if agent.is_continuous:
+        logprob = dists[0].log_prob(actions[0])[..., None]
+        entropy = dists[0].entropy()[..., None]
+    else:
+        logprobs = [d.log_prob(a) for d, a in zip(dists, actions)]
+        entropies = [d.entropy() for d in dists]
+        logprob = jnp.stack(logprobs, axis=-1).sum(axis=-1, keepdims=True)
+        entropy = jnp.stack(entropies, axis=-1).sum(axis=-1, keepdims=True)
+    return logprob, entropy, values
+
+
+def sample_actions(
+    agent: PPOAgent, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False
+) -> Tuple[Tuple[jax.Array, ...], jax.Array, jax.Array]:
+    """Player forward: sample actions, return (actions, logprob, value)
+    (reference: ``agent.py:194-253``)."""
+    actor_outs, values = agent.apply(params, obs)
+    dists = _dists(actor_outs, agent.is_continuous)
+    if agent.is_continuous:
+        if greedy:
+            acts = dists[0].mode
+        else:
+            acts = dists[0].sample(key)
+        logprob = dists[0].log_prob(acts)[..., None]
+        return (acts,), logprob, values
+    keys = jax.random.split(key, len(dists))
+    acts, logprobs = [], []
+    for d, k in zip(dists, keys):
+        a = d.mode if greedy else d.sample(k)
+        acts.append(a)
+        logprobs.append(d.log_prob(a))
+    logprob = jnp.stack(logprobs, axis=-1).sum(axis=-1, keepdims=True)
+    return tuple(acts), logprob, values
+
+
+class PPOPlayer:
+    """Thin host-side wrapper bundling jitted policy fns with the env-side
+    bookkeeping (reference class: ``agent.py:194-253``)."""
+
+    def __init__(self, agent: PPOAgent, cnn_keys: Sequence[str], mlp_keys: Sequence[str]):
+        self.agent = agent
+        self.cnn_keys = list(cnn_keys)
+        self.mlp_keys = list(mlp_keys)
+        self.is_continuous = agent.is_continuous
+        self.actions_dim = agent.actions_dim
+        self._forward = jax.jit(lambda p, o, k: sample_actions(agent, p, o, k))
+        self._greedy = jax.jit(lambda p, o, k: sample_actions(agent, p, o, k, greedy=True))
+        self._values = jax.jit(lambda p, o: agent.apply(p, o)[1])
+
+    def __call__(self, params, obs: Dict[str, jax.Array], key: jax.Array):
+        return self._forward(params, obs, key)
+
+    def get_actions(self, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False):
+        fn = self._greedy if greedy else self._forward
+        acts, _, _ = fn(params, obs, key)
+        return acts
+
+    def get_values(self, params, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self._values(params, obs)
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[PPOAgent, Any, PPOPlayer]:
+    """Create module + params (+ tied player)
+    (reference: ``agent.py:254-330``)."""
+    agent = PPOAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.encoder),
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+        screen_size=cfg.env.screen_size,
+        dtype=fabric.precision.compute_dtype,
+    )
+    dummy_obs = {}
+    for k in list(cfg.algo.cnn_keys.encoder):
+        shape = obs_space[k].shape
+        dummy_obs[k] = jnp.zeros((1, *shape), dtype=jnp.float32)
+    for k in list(cfg.algo.mlp_keys.encoder):
+        shape = obs_space[k].shape
+        dummy_obs[k] = jnp.zeros((1, int(np.prod(shape))), dtype=jnp.float32)
+    key = jax.random.PRNGKey(cfg.seed)
+    params = agent.init(key, dummy_obs)
+    if agent_state is not None:
+        from flax.core import freeze, unfreeze  # noqa: F401
+
+        params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params, agent_state)
+    params = fabric.put_replicated(params)
+    player = PPOPlayer(agent, cfg.algo.cnn_keys.encoder, cfg.algo.mlp_keys.encoder)
+    return agent, params, player

@@ -1,0 +1,313 @@
+"""Command-line dispatch (reference: ``sheeprl/cli.py:23-449``).
+
+Verbs mirror the reference console scripts:
+
+- ``sheeprl_tpu run exp=ppo ...`` (or just ``sheeprl_tpu exp=ppo``) — train;
+- ``sheeprl_tpu eval checkpoint_path=...`` — evaluate a checkpoint;
+- ``sheeprl_tpu agents`` — list registered algorithms;
+- ``sheeprl_tpu registration ...`` — MLflow model registration (optional dep).
+
+Arguments are hydra-style ``key=value`` tokens handled by
+:func:`sheeprl_tpu.config.compose`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pathlib
+import sys
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.config import ConfigError, DotDict, compose, dotdict, load_yaml
+from sheeprl_tpu.utils.registry import (
+    algorithm_registry,
+    evaluation_registry,
+    get_entrypoint,
+    resolve_algorithm,
+    resolve_evaluation,
+)
+
+__all__ = ["run", "evaluation", "registration", "available_agents", "main", "run_algorithm", "eval_algorithm"]
+
+
+def resume_from_checkpoint(cfg: DotDict) -> DotDict:
+    """Merge the checkpoint run's saved config over the current one
+    (reference: ``cli.py:23-56``)."""
+    import copy
+
+    from sheeprl_tpu.config import deep_merge
+
+    ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
+    old_cfg = dotdict(load_yaml(ckpt_path.parent.parent / "config.yaml"))
+    if old_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            "This experiment is run with a different environment from the one of the experiment you want to restart. "
+            f"Got '{cfg.env.id}', but the environment of the experiment of the checkpoint was {old_cfg.env.id}."
+        )
+    if old_cfg.algo.name != cfg.algo.name:
+        raise ValueError(
+            "This experiment is run with a different algorithm from the one of the experiment you want to restart. "
+            f"Got '{cfg.algo.name}', but the algorithm of the experiment of the checkpoint was {old_cfg.algo.name}."
+        )
+    if old_cfg.algo.get("learning_starts", 0) and old_cfg.algo.learning_starts > 0:
+        warnings.warn(
+            "The `algo.learning_starts` parameter is greater than zero: the resuming experiment will pre-fill "
+            "the buffer for `algo.learning_starts` steps. Set `algo.learning_starts=0` if not intended."
+        )
+    old_cfg = copy.deepcopy(old_cfg)
+    old_cfg.pop("root_dir", None)
+    old_cfg.pop("run_name", None)
+    old_cfg.get("checkpoint", {}).pop("resume_from", None)
+    old_cfg.get("algo", {}).pop("learning_starts", None)
+    merged = dict(cfg)
+    deep_merge(merged, old_cfg)
+    return dotdict(merged)
+
+
+def check_configs(cfg: DotDict) -> None:
+    """Config validation (reference: ``cli.py:270-344``). Torch-specific
+    precision flags don't apply; strategy strings are validated loosely since
+    the mesh is always the mechanism."""
+    entry = resolve_algorithm(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Given the algorithm named '{cfg.algo.name}', no module has been found to be imported.")
+    strategy = str(cfg.fabric.get("strategy", "auto")).lower()
+    if strategy not in ("auto", "ddp", "dp", "single_device"):
+        warnings.warn(
+            f"Strategy '{strategy}' has no TPU meaning; the device mesh is always used. Proceeding with 'auto'.",
+            UserWarning,
+        )
+    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+    if not (_IS_MLFLOW_AVAILABLE or cfg.model_manager.disabled):
+        warnings.warn("MLFlow is not installed. Setting `cfg.model_manager.disabled=True`", UserWarning)
+        cfg.model_manager.disabled = True
+    if cfg.algo.get("learning_starts") is not None and cfg.algo.learning_starts < 0:
+        raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero.")
+    if cfg.env.action_repeat < 1:
+        cfg.env.action_repeat = 1
+
+
+def _load_utils_module(entry: Dict[str, Any]):
+    pkg = entry["module"].rsplit(".", 1)[0]
+    return importlib.import_module(f"{pkg}.utils")
+
+
+def run_algorithm(cfg: DotDict) -> None:
+    """(reference: ``cli.py:59-198``)"""
+    os.environ.setdefault("OMP_NUM_THREADS", str(cfg.num_threads))
+
+    entry = resolve_algorithm(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Given the algorithm named '{cfg.algo.name}', no module has been found to be imported.")
+    utils = _load_utils_module(entry)
+    command = get_entrypoint(entry)
+
+    kwargs: Dict[str, Any] = {}
+    if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
+        ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+        exploration_cfg = dotdict(load_yaml(ckpt_path.parent.parent / "config.yaml"))
+        if exploration_cfg.env.id != cfg.env.id:
+            raise ValueError(
+                "This experiment is run with a different environment from the one of the exploration you want to "
+                f"finetune. Got '{cfg.env.id}', but the environment used during exploration was "
+                f"{exploration_cfg.env.id}."
+            )
+        kwargs["exploration_cfg"] = exploration_cfg
+        for k in (
+            "frame_stack",
+            "screen_size",
+            "action_repeat",
+            "grayscale",
+            "clip_rewards",
+            "frame_stack_dilation",
+            "max_episode_steps",
+            "reward_as_observation",
+        ):
+            cfg.env[k] = exploration_cfg.env[k]
+
+    # Metric key filtering (reference: cli.py:150-164)
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    if cfg.get("metric") is not None:
+        predefined = getattr(utils, "AGGREGATOR_KEYS", None)
+        if predefined is None:
+            warnings.warn(
+                f"No 'AGGREGATOR_KEYS' set found for the {cfg.algo.name} algorithm. No metric will be logged.",
+                UserWarning,
+            )
+            predefined = set()
+        timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+        metrics_cfg = cfg.metric.aggregator.get("metrics") or {}
+        for k in set(metrics_cfg.keys()) - set(predefined):
+            metrics_cfg.pop(k, None)
+        MetricAggregator.disabled = cfg.metric.log_level == 0 or len(metrics_cfg) == 0
+
+    # Model-manager key filtering (reference: cli.py:166-180)
+    if cfg.get("model_manager") is not None and not cfg.model_manager.disabled and cfg.model_manager.models is not None:
+        predefined_models = getattr(utils, "MODELS_TO_REGISTER", set())
+        for k in set(cfg.model_manager.models.keys()) - set(predefined_models):
+            cfg.model_manager.models.pop(k, None)
+
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.parallel.distributed import maybe_init
+    from sheeprl_tpu.utils.callback import CheckpointCallback
+
+    maybe_init()
+    callbacks = []
+    for cb_spec in cfg.fabric.get("callbacks") or []:
+        target = cb_spec.get("_target_", "") if isinstance(cb_spec, dict) else ""
+        if target.endswith("CheckpointCallback"):
+            callbacks.append(CheckpointCallback(keep_last=cb_spec.get("keep_last")))
+    fabric = Fabric.from_config(cfg.fabric, callbacks=callbacks)
+
+    def reproducible(func):
+        def wrapper(fabric, cfg, *args, **kw):
+            fabric.seed_everything(cfg.seed)
+            return func(fabric, cfg, *args, **kw)
+
+        return wrapper
+
+    fabric.launch(reproducible(command), cfg, **kwargs)
+
+
+def eval_algorithm(cfg: DotDict) -> None:
+    """(reference: ``cli.py:201-267``)"""
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    fabric = Fabric(devices=1, accelerator=cfg.fabric.get("accelerator", "auto"), precision=str(cfg.fabric.get("precision", "32-true")))
+    fabric.seed_everything(cfg.seed if cfg.get("seed") is not None else 42)
+    state = load_state(cfg.checkpoint_path)
+
+    entry = resolve_evaluation(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Given the algorithm named '{cfg.algo.name}', no evaluation has been registered.")
+    command = get_entrypoint(entry)
+    fabric.launch(command, cfg, state)
+
+
+def available_agents() -> None:
+    """Rich table of registered algorithms
+    (reference: ``sheeprl/available_agents.py:7-35``)."""
+    from sheeprl_tpu.utils.registry import _ensure_populated
+
+    _ensure_populated()
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table(title="SheepRL-TPU Agents")
+        table.add_column("Module")
+        table.add_column("Algorithm")
+        table.add_column("Entrypoint")
+        table.add_column("Decoupled")
+        for module, algos in algorithm_registry.items():
+            for algo in algos:
+                table.add_row(algo["module"], algo["name"], algo["entrypoint"], str(algo["decoupled"]))
+        Console().print(table)
+    except ImportError:  # pragma: no cover
+        for module, algos in algorithm_registry.items():
+            for algo in algos:
+                print(f"{algo['module']}: {algo['name']} ({algo['entrypoint']}, decoupled={algo['decoupled']})")
+
+
+def run(args: Optional[List[str]] = None) -> None:
+    """Train (reference: ``cli.py:357-365``)."""
+    args = list(sys.argv[1:] if args is None else args)
+    cfg = compose(args)
+    from sheeprl_tpu.utils.utils import print_config
+
+    print_config(cfg)
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    check_configs(cfg)
+    run_algorithm(cfg)
+
+
+def evaluation(args: Optional[List[str]] = None) -> None:
+    """Evaluate a checkpoint (reference: ``cli.py:368-404``)."""
+    args = list(sys.argv[1:] if args is None else args)
+    eval_cfg = compose(args, config_name="eval_config")
+    if not eval_cfg.get("checkpoint_path"):
+        raise ValueError("You must specify the evaluation checkpoint path")
+    checkpoint_path = Path(os.path.abspath(eval_cfg.checkpoint_path))
+    ckpt_cfg = dotdict(load_yaml(checkpoint_path.parent.parent / "config.yaml"))
+
+    from sheeprl_tpu.config import deep_merge
+
+    capture_video = eval_cfg.get("env", {}).get("capture_video", True)
+    merged = dict(ckpt_cfg)
+    deep_merge(
+        merged,
+        {
+            "env": {"capture_video": capture_video, "num_envs": 1},
+            "fabric": {"devices": 1, "strategy": "auto", "accelerator": eval_cfg.get("fabric", {}).get("accelerator", "auto")},
+            "checkpoint_path": str(checkpoint_path),
+            "seed": eval_cfg.get("seed") if eval_cfg.get("seed") is not None else ckpt_cfg.get("seed", 42),
+            "root_dir": str(checkpoint_path.parent.parent.parent.parent),
+            "run_name": str(
+                Path(
+                    os.path.join(
+                        os.path.basename(str(checkpoint_path.parent.parent.parent)),
+                        os.path.basename(str(checkpoint_path.parent.parent)),
+                        "evaluation",
+                    )
+                )
+            ),
+        },
+    )
+    eval_algorithm(dotdict(merged))
+
+
+def registration(args: Optional[List[str]] = None) -> None:
+    """MLflow model registration (reference: ``cli.py:407-449``)."""
+    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError("MLflow is not installed; model registration is unavailable.")
+    args = list(sys.argv[1:] if args is None else args)
+    cfg = compose(args, config_name="model_manager_config")
+    checkpoint_path = Path(cfg.checkpoint_path)
+    ckpt_cfg = dotdict(load_yaml(checkpoint_path.parent.parent / "config.yaml"))
+    for k in ("env", "exp_name", "algo", "distribution", "seed"):
+        cfg[k] = ckpt_cfg[k]
+    cfg.to_log = ckpt_cfg
+
+    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
+
+    state = load_state(cfg.checkpoint_path)
+    algo_name = cfg.algo.name.replace("_decoupled", "")
+    if algo_name.startswith("p2e_dv"):
+        algo_name = "_".join(algo_name.split("_")[:2])
+    utils = importlib.import_module(f"sheeprl_tpu.algos.{algo_name}.utils")
+    from sheeprl_tpu.parallel import Fabric
+
+    fabric = Fabric(devices=1)
+    fabric.launch(register_model_from_checkpoint, cfg, state, utils.log_models_from_checkpoint)
+
+
+def main() -> None:
+    """Entry: dispatch on first positional verb."""
+    argv = sys.argv[1:]
+    if argv and argv[0] in ("run", "eval", "evaluation", "agents", "registration"):
+        verb, rest = argv[0], argv[1:]
+    else:
+        verb, rest = "run", argv
+    if verb == "run":
+        run(rest)
+    elif verb in ("eval", "evaluation"):
+        evaluation(rest)
+    elif verb == "agents":
+        available_agents()
+    elif verb == "registration":
+        registration(rest)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,468 @@
+"""Lightweight YAML config-composition engine.
+
+Capability parity with the reference's Hydra usage (reference:
+``sheeprl/configs/config.yaml:4-15``, ``hydra_plugins/sheeprl_search_path.py:23-33``)
+without a Hydra dependency:
+
+- a root ``config.yaml`` whose ``defaults:`` list composes config *groups*
+  (``algo/``, ``env/``, ``buffer/``, ...) into same-named keys;
+- group files with their own ``defaults:`` lists, including ``_self_`` ordering,
+  in-group inheritance (``- default``) and cross-group package injection
+  (``- /optim@optimizer: adam``);
+- experiment files (``exp=...``) that are global-package overlays and may
+  ``override /group: name`` selections;
+- dotted CLI overrides (``algo.lr=1e-4``), with group selection via bare group
+  names (``algo=ppo``, ``env=atari``);
+- ``${a.b.c}`` interpolation and ``${now:%fmt}`` resolver;
+- ``???`` mandatory markers that raise if still present after composition;
+- extra search paths via the ``SHEEPRL_SEARCH_PATH`` environment variable
+  (colon-separated directories that may contain their own group subdirs).
+
+Composed configs are plain nested dicts wrapped in :class:`DotDict` for
+attribute access, and can be dumped back to YAML for the resolved-config file
+the reference saves per run (reference: ``sheeprl/utils/utils.py:257``).
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import os
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+__all__ = ["ConfigError", "DotDict", "compose", "dotdict", "instantiate", "load_yaml", "to_yaml", "save_config"]
+
+MISSING = "???"
+_INTERP_RE = re.compile(r"\$\{([^${}]+)\}")
+
+
+class ConfigError(Exception):
+    """Raised on malformed configs, missing groups or unresolved values."""
+
+
+class DotDict(dict):
+    """dict with attribute access, recursively applied."""
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError as e:  # pragma: no cover - trivial
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def __delattr__(self, name: str) -> None:
+        try:
+            del self[name]
+        except KeyError as e:  # pragma: no cover - trivial
+            raise AttributeError(name) from e
+
+    def __deepcopy__(self, memo):
+        return DotDict({k: copy.deepcopy(v, memo) for k, v in self.items()})
+
+
+def dotdict(data: Any) -> Any:
+    """Recursively convert nested dicts (and dicts inside lists) to DotDict."""
+    if isinstance(data, dict):
+        return DotDict({k: dotdict(v) for k, v in data.items()})
+    if isinstance(data, (list, tuple)):
+        return type(data)(dotdict(v) for v in data)
+    return data
+
+
+def plain(data: Any) -> Any:
+    """Inverse of :func:`dotdict` — nested plain dicts/lists for YAML dumping."""
+    if isinstance(data, dict):
+        return {k: plain(v) for k, v in data.items()}
+    if isinstance(data, tuple):
+        return [plain(v) for v in data]
+    if isinstance(data, list):
+        return [plain(v) for v in data]
+    return data
+
+
+class _SheepLoader(yaml.SafeLoader):
+    """SafeLoader that parses scientific notation without a dot (``1e-3``)
+    as float, matching YAML 1.2 / OmegaConf behavior."""
+
+
+_SheepLoader.add_implicit_resolver(
+    "tag:yaml.org,2002:float",
+    re.compile(
+        r"""^(?:[-+]?(?:[0-9][0-9_]*)\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+        |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+        |\.[0-9][0-9_]*(?:[eE][-+]?[0-9]+)?
+        |[-+]?\.(?:inf|Inf|INF)
+        |\.(?:nan|NaN|NAN))$""",
+        re.X,
+    ),
+    list("-+0123456789."),
+)
+
+
+def yaml_load(stream: Any) -> Any:
+    return yaml.load(stream, Loader=_SheepLoader)
+
+
+def load_yaml(path: os.PathLike | str) -> Dict[str, Any]:
+    with open(path, "r") as f:
+        data = yaml_load(f)
+    if data is None:
+        return {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"Top level of {path} must be a mapping, got {type(data)}")
+    return data
+
+
+def deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge ``overlay`` onto ``base`` (overlay wins); returns ``base`` mutated."""
+    for key, value in overlay.items():
+        if key in base and isinstance(base[key], dict) and isinstance(value, dict):
+            deep_merge(base[key], value)
+        else:
+            base[key] = copy.deepcopy(value)
+    return base
+
+
+def set_by_path(cfg: Dict[str, Any], dotted: str, value: Any, *, create: bool = True) -> None:
+    keys = dotted.split(".")
+    node = cfg
+    for k in keys[:-1]:
+        if k not in node or not isinstance(node[k], dict):
+            if not create:
+                raise ConfigError(f"Cannot set '{dotted}': '{k}' is not a mapping")
+            node[k] = {}
+        node = node[k]
+    node[keys[-1]] = value
+
+
+def get_by_path(cfg: Dict[str, Any], dotted: str) -> Any:
+    node: Any = cfg
+    for k in dotted.split("."):
+        if isinstance(node, dict) and k in node:
+            node = node[k]
+        elif isinstance(node, (list, tuple)):
+            node = node[int(k)]
+        else:
+            raise KeyError(dotted)
+    return node
+
+
+class _Composer:
+    def __init__(self, config_dirs: Sequence[Path]):
+        self.config_dirs = [Path(d) for d in config_dirs]
+
+    # -- file lookup over the search path ------------------------------------
+    def _find(self, group: str, name: str) -> Path:
+        candidates = []
+        for root in self.config_dirs:
+            base = root / group if group else root
+            for fname in (f"{name}.yaml", f"{name}.yml", name):
+                p = base / fname
+                candidates.append(p)
+                if p.is_file():
+                    return p
+        raise ConfigError(
+            f"Config '{name}' not found in group '{group or '<root>'}'. Tried: "
+            + ", ".join(str(c) for c in candidates[:6])
+        )
+
+    def group_options(self, group: str) -> List[str]:
+        names: List[str] = []
+        for root in self.config_dirs:
+            base = root / group
+            if base.is_dir():
+                names.extend(sorted(p.stem for p in base.glob("*.yaml")))
+        return sorted(set(names))
+
+    # -- group-file loading with nested defaults -----------------------------
+    def load_group_file(self, group: str, name: str) -> Tuple[Dict[str, Any], Dict[str, str], bool]:
+        """Load ``<group>/<name>.yaml`` resolving its ``defaults:`` list.
+
+        Returns ``(content, group_overrides, is_global_package)`` where
+        ``group_overrides`` maps group name -> selected option (from
+        ``override /group: option`` entries, used by exp files).
+        """
+        path = self._find(group, name)
+        raw = load_yaml(path)
+        is_global = _is_global_package(path)
+        defaults = raw.pop("defaults", None)
+        if defaults is None:
+            return raw, {}, is_global
+
+        result: Dict[str, Any] = {}
+        overrides: Dict[str, str] = {}
+        self_merged = False
+        for entry in defaults:
+            if entry == "_self_":
+                deep_merge(result, raw)
+                self_merged = True
+            elif isinstance(entry, str):
+                sub, sub_over, _ = self.load_group_file(group, entry)
+                deep_merge(result, sub)
+                overrides.update(sub_over)
+            elif isinstance(entry, dict):
+                for key, option in entry.items():
+                    key = key.strip()
+                    if key.startswith("override "):
+                        target = key[len("override "):].strip().lstrip("/")
+                        overrides[target] = option
+                        continue
+                    if option is None:
+                        continue
+                    # '/optim@optimizer: adam' → load optim/adam under key 'optimizer'
+                    if "@" in key:
+                        src, _, pkg = key.partition("@")
+                        src = src.strip().lstrip("/")
+                        sub, _, _ = self.load_group_file(src, option)
+                        sub_dict: Dict[str, Any] = {}
+                        set_by_path(sub_dict, pkg.strip(), sub)
+                        deep_merge(result, sub_dict)
+                    else:
+                        src = key.lstrip("/")
+                        sub, _, _ = self.load_group_file(src, option)
+                        deep_merge(result, {src: sub} if src != group else sub)
+            else:
+                raise ConfigError(f"Bad defaults entry {entry!r} in {path}")
+        if not self_merged:
+            deep_merge(result, raw)
+        return result, overrides, is_global
+
+
+def _is_global_package(path: Path) -> bool:
+    try:
+        with open(path, "r") as f:
+            head = f.read(256)
+        return "@package _global_" in head
+    except OSError:  # pragma: no cover
+        return False
+
+
+def _parse_override(token: str) -> Tuple[str, Any]:
+    if "=" not in token:
+        raise ConfigError(f"Override '{token}' must look like key=value")
+    key, _, raw_value = token.partition("=")
+    try:
+        value = yaml_load(raw_value) if raw_value != "" else ""
+    except yaml.YAMLError:
+        value = raw_value
+    return key.strip(), value
+
+
+def default_config_dirs() -> List[Path]:
+    dirs = [Path(__file__).parent / "configs"]
+    for extra in os.environ.get("SHEEPRL_SEARCH_PATH", "").split(":"):
+        extra = extra.strip()
+        if not extra:
+            continue
+        # accept both plain paths and hydra-style 'file://...' specs
+        if extra.startswith("file://"):
+            extra = extra[len("file://"):]
+        p = Path(extra)
+        if p.is_dir():
+            dirs.append(p)
+    return dirs
+
+
+def compose(
+    overrides: Sequence[str] = (),
+    *,
+    config_dirs: Optional[Sequence[os.PathLike | str]] = None,
+    config_name: str = "config",
+    allow_missing: Sequence[str] = (),
+) -> DotDict:
+    """Compose the full configuration like ``hydra.main`` would.
+
+    ``overrides`` are CLI-style tokens: group selections (``exp=ppo``,
+    ``algo=sac``) and dotted value overrides (``env.num_envs=4``). Group
+    selections are recognized by the key naming an existing group directory.
+    """
+    dirs = [Path(d) for d in config_dirs] if config_dirs else default_config_dirs()
+    composer = _Composer(dirs)
+
+    root_path = composer._find("", config_name)
+    root_raw = load_yaml(root_path)
+    root_defaults = root_raw.pop("defaults", [])
+
+    # Split CLI overrides into group selections vs dotted value overrides.
+    group_selections: Dict[str, str] = {}
+    value_overrides: List[Tuple[str, Any]] = []
+    for token in overrides:
+        key, value = _parse_override(token)
+        if "." not in key and isinstance(value, str) and (composer.group_options(key) or key == "exp"):
+            group_selections[key] = value
+        else:
+            value_overrides.append((key, value))
+
+    cfg: Dict[str, Any] = {}
+    exp_selection: Optional[str] = group_selections.pop("exp", None)
+    exp_in_defaults = False
+    ordered_groups: List[Tuple[str, str]] = []
+    self_pos_merged = False
+    for entry in root_defaults:
+        if entry == "_self_":
+            deep_merge(cfg, root_raw)
+            self_pos_merged = True
+            continue
+        if not isinstance(entry, dict):
+            raise ConfigError(f"Bad root defaults entry {entry!r}")
+        for group, option in entry.items():
+            group = group.strip().lstrip("/")
+            if group == "exp":
+                exp_in_defaults = True
+                if exp_selection is None and option not in (None, MISSING):
+                    exp_selection = option
+                continue
+            option = group_selections.get(group, option)
+            if option is None:
+                continue
+            if isinstance(option, str) and option.endswith((".yaml", ".yml")):
+                option = option.rsplit(".", 1)[0]
+            ordered_groups.append((group, option))
+    if not self_pos_merged:
+        deep_merge(cfg, root_raw)
+
+    # The exp overlay may override group selections — resolve it first.
+    exp_overlay: Dict[str, Any] = {}
+    exp_group_overrides: Dict[str, str] = {}
+    if exp_selection is None and exp_in_defaults:
+        raise ConfigError("You must specify an experiment: add exp=<name> (e.g. exp=ppo)")
+    if exp_selection is not None:
+        exp_overlay, exp_group_overrides, _ = composer.load_group_file("exp", exp_selection)
+
+    for group, option in ordered_groups:
+        option = group_selections.get(group, exp_group_overrides.get(group, option))
+        content, _, is_global = composer.load_group_file(group, option)
+        if is_global:
+            deep_merge(cfg, content)
+        else:
+            deep_merge(cfg, {group: content})
+
+    deep_merge(cfg, exp_overlay)
+
+    for key, value in value_overrides:
+        set_by_path(cfg, key, value)
+
+    _resolve_interpolations(cfg)
+    _check_missing(cfg, allow_missing=allow_missing)
+    return dotdict(cfg)
+
+
+# -- interpolation -----------------------------------------------------------
+
+def _now_resolver(fmt: str) -> str:
+    return datetime.datetime.now().strftime(fmt)
+
+
+def _env_resolver(arg: str) -> str:
+    name, _, default = arg.partition(",")
+    return os.environ.get(name.strip(), default)
+
+
+_RESOLVERS = {"now": _now_resolver, "oc.env": _env_resolver}
+
+
+def _resolve_value(value: str, root: Dict[str, Any], stack: Tuple[str, ...] = ()) -> Any:
+    matches = list(_INTERP_RE.finditer(value))
+    if not matches:
+        return value
+    # Full-string single interpolation keeps the referenced type.
+    if len(matches) == 1 and matches[0].span() == (0, len(value)):
+        return _lookup_interp(matches[0].group(1), root, stack)
+
+    def sub(match: re.Match) -> str:
+        return str(_lookup_interp(match.group(1), root, stack))
+
+    return _INTERP_RE.sub(sub, value)
+
+
+def _lookup_interp(expr: str, root: Dict[str, Any], stack: Tuple[str, ...]) -> Any:
+    expr = expr.strip()
+    if ":" in expr:
+        name, _, arg = expr.partition(":")
+        if name in _RESOLVERS:
+            return _RESOLVERS[name](arg)
+    if expr in stack:
+        raise ConfigError(f"Interpolation cycle detected at '${{{expr}}}' (stack: {stack})")
+    try:
+        target = get_by_path(root, expr)
+    except KeyError:
+        raise ConfigError(f"Interpolation '${{{expr}}}' not found") from None
+    if isinstance(target, str) and _INTERP_RE.search(target):
+        return _resolve_value(target, root, stack + (expr,))
+    return target
+
+
+def _resolve_interpolations(cfg: Dict[str, Any]) -> None:
+    def walk(node: Any) -> Any:
+        if isinstance(node, dict):
+            for k, v in list(node.items()):
+                node[k] = walk(v)
+            return node
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, str) and _INTERP_RE.search(node):
+            return _resolve_value(node, cfg)
+        return node
+
+    walk(cfg)
+
+
+def _check_missing(cfg: Dict[str, Any], allow_missing: Sequence[str] = (), prefix: str = "") -> None:
+    for k, v in cfg.items():
+        dotted = f"{prefix}{k}"
+        if isinstance(v, dict):
+            _check_missing(v, allow_missing, prefix=f"{dotted}.")
+        elif v == MISSING and dotted not in allow_missing:
+            raise ConfigError(f"Mandatory value '{dotted}' (???) was not provided")
+
+
+# -- instantiate (the reference's hydra.utils.instantiate analogue) ----------
+
+def instantiate(spec: Any, *args: Any, **kwargs: Any) -> Any:
+    """Build an object from a ``{_target_: dotted.path, **kw}`` mapping.
+
+    Nested mappings containing ``_target_`` are instantiated recursively
+    (e.g. the atari env config wraps a ``gymnasium.make`` spec)."""
+    if not isinstance(spec, dict) or "_target_" not in spec:
+        raise ConfigError(f"instantiate() needs a mapping with _target_, got {spec!r}")
+    import importlib
+
+    spec = {
+        k: (instantiate(v) if isinstance(v, dict) and "_target_" in v else v)
+        for k, v in spec.items()
+    }
+    target = spec["_target_"]
+    module_name, _, attr = target.rpartition(".")
+    if not module_name:
+        raise ConfigError(f"Bad _target_: {target}")
+    try:
+        module = importlib.import_module(module_name)
+        fn = getattr(module, attr)
+    except (ImportError, AttributeError):
+        # _target_ may point at an attribute of a class (e.g. pkg.Class.method)
+        parent_name, _, cls_name = module_name.rpartition(".")
+        module = importlib.import_module(parent_name)
+        fn = getattr(getattr(module, cls_name), attr)
+    kw = {k: v for k, v in spec.items() if k not in ("_target_", "_partial_")}
+    kw.update(kwargs)
+    if spec.get("_partial_"):
+        import functools
+
+        return functools.partial(fn, *args, **kw)
+    return fn(*args, **kw)
+
+
+def to_yaml(cfg: Any) -> str:
+    return yaml.safe_dump(plain(cfg), sort_keys=False, default_flow_style=False)
+
+
+def save_config(cfg: Any, path: os.PathLike | str) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(to_yaml(cfg))

@@ -1,0 +1,15 @@
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.data.memmap import MemmapArray
+
+__all__ = [
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "EnvIndependentReplayBuffer",
+    "EpisodeBuffer",
+    "MemmapArray",
+]

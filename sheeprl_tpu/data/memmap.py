@@ -1,0 +1,149 @@
+"""File-backed numpy arrays (reference: ``sheeprl/utils/memmap.py:22-270``).
+
+Purpose on TPU-VM hosts is the same as in the reference: (a) replay buffers
+larger than host RAM, (b) zero-copy handoff of buffer state between processes
+— pickling transfers a *non-owning* view so the receiving process maps the
+same file without deleting it on GC.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+from sys import getrefcount
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["MemmapArray"]
+
+
+class MemmapArray:
+    def __init__(
+        self,
+        dtype: np.dtype | str,
+        shape: Tuple[int, ...],
+        filename: str | os.PathLike | None = None,
+        mode: str = "r+",
+    ) -> None:
+        if filename is None:
+            fd, filename = tempfile.mkstemp(suffix=".memmap")
+            os.close(fd)
+        self._filename = Path(filename).resolve()
+        self._filename.parent.mkdir(parents=True, exist_ok=True)
+        self._filename.touch(exist_ok=True)
+        self._dtype = np.dtype(dtype)
+        self._shape = tuple(shape)
+        if mode not in ("r+", "w+", "c", "copyonwrite", "readwrite", "write"):
+            raise ValueError(f"Unsupported memmap mode '{mode}'")
+        self._mode = mode
+        self._array: Optional[np.memmap] = np.memmap(
+            filename=str(self._filename), dtype=self._dtype, shape=self._shape, mode="w+"
+        )
+        self._has_ownership = True
+        self._array_dir = str(self._filename.parent)
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def filename(self) -> str:
+        return str(self._filename)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def has_ownership(self) -> bool:
+        return self._has_ownership
+
+    @has_ownership.setter
+    def has_ownership(self, value: bool) -> None:
+        self._has_ownership = bool(value)
+
+    @property
+    def array(self) -> np.memmap:
+        if self._array is None:  # re-open after unpickling in a new process
+            self._array = np.memmap(
+                filename=str(self._filename), dtype=self._dtype, shape=self._shape, mode=self._mode
+            )
+        return self._array
+
+    @array.setter
+    def array(self, value: np.ndarray) -> None:
+        if not isinstance(value, np.ndarray):
+            raise ValueError(f"The value to be set must be a numpy array, got {type(value)}")
+        if value.shape != self._shape:
+            raise ValueError(f"Shape mismatch: expected {self._shape}, got {value.shape}")
+        self.array[:] = value
+
+    # -- construction helpers ------------------------------------------------
+    @classmethod
+    def from_array(
+        cls,
+        array: np.ndarray | "MemmapArray",
+        filename: str | os.PathLike | None = None,
+        mode: str = "r+",
+    ) -> "MemmapArray":
+        """Create a MemmapArray initialized with ``array``'s contents
+        (reference: ``memmap.py:172-211``). If ``array`` is itself a
+        MemmapArray backed by the same file, the new instance is a non-owning
+        view."""
+        is_memmap = isinstance(array, MemmapArray)
+        src = array.array if is_memmap else np.asarray(array)
+        out = cls(dtype=src.dtype, shape=src.shape, filename=filename, mode=mode)
+        if is_memmap and Path(array.filename).resolve() == out._filename:
+            out._has_ownership = False
+        else:
+            out.array[:] = src[:]
+        return out
+
+    # -- pickling: transfer a non-owning view --------------------------------
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        state["_array"] = None
+        state["_has_ownership"] = False
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+
+    def __del__(self) -> None:
+        # Only the owning instance (and only the last reference to its
+        # memmap) deletes the backing file (reference: memmap.py:213-228).
+        if getattr(self, "_has_ownership", False) and self._array is not None and getrefcount(self._array) <= 2:
+            self._array = None
+            try:
+                os.unlink(self._filename)
+            except OSError:
+                pass
+            try:
+                if not any(os.scandir(self._array_dir)):
+                    os.rmdir(self._array_dir)
+            except OSError:
+                pass
+
+    # -- array interface -----------------------------------------------------
+    def __getitem__(self, idx: Any) -> np.ndarray:
+        return self.array[idx]
+
+    def __setitem__(self, idx: Any, value: Any) -> None:
+        self.array[idx] = value
+
+    def __array__(self, dtype=None) -> np.ndarray:
+        arr = np.asarray(self.array)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __len__(self) -> int:
+        return self._shape[0]
+
+    def __repr__(self) -> str:
+        return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, mode={self._mode}, filename={self._filename})"

@@ -1,0 +1,10 @@
+from sheeprl_tpu.ops.core import (
+    gae,
+    lambda_returns,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+)
+
+__all__ = ["gae", "lambda_returns", "symlog", "symexp", "two_hot_encoder", "two_hot_decoder"]

@@ -1,0 +1,135 @@
+"""Core jittable RL math.
+
+The reference implements these as Python loops / torch scatter ops
+(``sheeprl/utils/utils.py:64-101`` gae, ``:148-207`` symlog/two-hot;
+``sheeprl/algos/dreamer_v3/utils.py`` lambda returns). Here every op is a pure
+function built on ``lax.scan`` / vectorized indexing so it fuses inside the
+surrounding jitted train step — no host round-trips, static shapes only.
+
+All time-major tensors are shaped ``(T, B, ...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["gae", "lambda_returns", "symlog", "symexp", "two_hot_encoder", "two_hot_decoder"]
+
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over a rollout
+    (reference semantics: ``sheeprl/utils/utils.py:64-101``).
+
+    Args:
+        rewards/values/dones: time-major ``(T, B, 1)`` (or ``(T, B)``).
+        next_value: bootstrap value for the state after the last step, ``(B, 1)``.
+        dones: episode-termination flags aligned with rewards: ``dones[t]``
+            marks whether the state *after* step ``t`` is terminal (same
+            convention as the reference, which uses ``not_dones[t]`` to mask
+            the bootstrap of step ``t``).
+
+    Returns:
+        ``(returns, advantages)`` with the shape of ``rewards``.
+    """
+    not_dones = 1.0 - dones.astype(values.dtype)
+
+    def step(lastgaelam, inp):
+        reward, value, next_val, nonterminal = inp
+        delta = reward + gamma * next_val * nonterminal - value
+        lastgaelam = delta + gamma * gae_lambda * nonterminal * lastgaelam
+        return lastgaelam, lastgaelam
+
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    # step t bootstraps with not_done[t] (mask of the state after step t)
+    init = jnp.zeros_like(next_value)
+    _, adv_rev = jax.lax.scan(
+        step,
+        init,
+        (rewards[::-1], values[::-1], next_values[::-1], not_dones[::-1]),
+    )
+    advantages = adv_rev[::-1]
+    returns = advantages + values
+    return returns, advantages
+
+
+def lambda_returns(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(λ) returns used by the Dreamer family
+    (reference: ``sheeprl/algos/dreamer_v3/utils.py:66-77`` compute_lambda_values):
+    ``ret[t] = r[t] + c[t] * ((1-λ) v[t] + λ ret[t+1])`` with ``ret[T] = v[T-1]``.
+
+    In the Dreamer convention the inputs are arrival-aligned: ``rewards[t]``
+    and ``values[t]`` are the reward/value *at* imagined state t, and
+    ``continues`` already folds in the discount factor (γ * continue-prob).
+    Shapes are time-major ``(T, B, 1)``; the last value bootstraps.
+    """
+    inputs = rewards + continues * values * (1 - lmbda)
+
+    def step(carry, inp):
+        inputs_t, cont_t = inp
+        ret = inputs_t + cont_t * lmbda * carry
+        return ret, ret
+
+    _, returns_rev = jax.lax.scan(step, values[-1], (inputs[::-1], continues[::-1]))
+    return returns_rev[::-1]
+
+
+def symlog(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1)
+
+
+def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optional[int] = None) -> jax.Array:
+    """Two-hot encode scalars onto a symmetric support
+    (reference: ``sheeprl/utils/utils.py:156-190``).
+
+    Args:
+        x: ``(..., 1)`` values.
+    Returns:
+        ``(..., num_buckets)`` two-hot vectors.
+    """
+    if num_buckets is None:
+        num_buckets = support_range * 2 + 1
+    if num_buckets % 2 == 0:
+        raise ValueError("num_buckets must be odd")
+    x = jnp.clip(x, -support_range, support_range)
+    buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    bucket_size = (2 * support_range) / (num_buckets - 1) if num_buckets > 1 else 1.0
+
+    right_idxs = jnp.searchsorted(buckets, x, side="left")
+    right_idxs = jnp.clip(right_idxs, 0, num_buckets - 1)
+    left_idxs = jnp.clip(right_idxs - 1, 0, num_buckets - 1)
+    left_value = jnp.abs(buckets[right_idxs] - x) / bucket_size
+    right_value = 1.0 - left_value
+
+    # scatter-add via one-hot matmuls (MXU-friendly, static shapes)
+    left_oh = jax.nn.one_hot(left_idxs[..., 0], num_buckets, dtype=x.dtype)
+    right_oh = jax.nn.one_hot(right_idxs[..., 0], num_buckets, dtype=x.dtype)
+    return left_oh * left_value + right_oh * right_value
+
+
+def two_hot_decoder(x: jax.Array, support_range: int) -> jax.Array:
+    """Expected value of a two-hot/categorical vector over the support
+    (reference: ``sheeprl/utils/utils.py:193-207``)."""
+    num_buckets = x.shape[-1]
+    if num_buckets % 2 == 0:
+        raise ValueError("support size must be odd")
+    support = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    return jnp.sum(x * support, axis=-1, keepdims=True)

@@ -1,0 +1,3 @@
+from sheeprl_tpu.optim.builders import adam, rmsprop, rmsprop_tf, sgd, build_optimizer
+
+__all__ = ["adam", "sgd", "rmsprop", "rmsprop_tf", "build_optimizer"]

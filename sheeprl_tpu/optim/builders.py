@@ -1,0 +1,95 @@
+"""Optimizer builders on optax.
+
+Replaces the reference's torch optimizers instantiated from the ``optim``
+config group (``sheeprl/configs/optim/*.yaml``) and the TF-style RMSprop
+(``sheeprl/optim/rmsprop_tf.py:1-156``: epsilon added *inside* the sqrt,
+used by Dreamer-V1/V2).
+
+Each builder returns an ``optax.GradientTransformation``; ``build_optimizer``
+wraps a config node (``_target_`` + kwargs) and composes global-norm clipping
+when ``max_grad_norm`` is given — the optax analogue of
+``fabric.clip_gradients`` in the reference's train loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+import optax
+
+__all__ = ["adam", "sgd", "rmsprop", "rmsprop_tf", "build_optimizer"]
+
+
+def adam(
+    lr: float = 2e-4,
+    eps: float = 1e-4,
+    weight_decay: float = 0.0,
+    betas: Sequence[float] = (0.9, 0.999),
+    **_: Any,
+) -> optax.GradientTransformation:
+    b1, b2 = betas
+    if weight_decay:
+        return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    return optax.adam(lr, b1=b1, b2=b2, eps=eps)
+
+
+def sgd(lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False, **_: Any):
+    tx = optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def rmsprop(
+    lr: float = 1e-3,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+):
+    # torch-style: eps added outside the sqrt
+    tx = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=False, centered=centered, momentum=momentum or None)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def rmsprop_tf(
+    lr: float = 1e-3,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+):
+    """TF-style RMSprop: eps inside the sqrt (reference: ``sheeprl/optim/rmsprop_tf.py``)."""
+    tx = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=True, centered=centered, momentum=momentum or None)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def build_optimizer(
+    optim_cfg: Mapping[str, Any],
+    max_grad_norm: Optional[float] = None,
+    lr_override: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Build from a config node with ``_target_`` (torch paths are mapped by
+    leaf name for reference-config compatibility)."""
+    from sheeprl_tpu.config import ConfigError
+
+    cfg = dict(optim_cfg)
+    target = cfg.pop("_target_", "adam")
+    leaf = target.rsplit(".", 1)[-1].lower()
+    builders = {"adam": adam, "adamw": adam, "sgd": sgd, "rmsprop": rmsprop, "rmsproptf": rmsprop_tf, "rmsprop_tf": rmsprop_tf}
+    if leaf not in builders:
+        raise ConfigError(f"Unknown optimizer '{target}'")
+    if lr_override is not None:
+        cfg["lr"] = lr_override
+    tx = builders[leaf](**cfg)
+    if max_grad_norm is not None and max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx

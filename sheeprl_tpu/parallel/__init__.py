@@ -1,0 +1,3 @@
+from sheeprl_tpu.parallel.fabric import Fabric, Precision, get_single_device_fabric
+
+__all__ = ["Fabric", "Precision", "get_single_device_fabric"]

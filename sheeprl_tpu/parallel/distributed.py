@@ -1,0 +1,47 @@
+"""Multi-host bring-up.
+
+The reference's multi-process story is Lightning spawning one process per GPU
+and initializing NCCL/Gloo groups (reference: ``sheeprl/cli.py:186-198``,
+``ppo_decoupled.py:645-666``). The TPU-native story is one process per host,
+started by the pod runtime (or manually), with ``jax.distributed.initialize``
+wiring DCN; chips then appear as one global ``jax.devices()`` list and all
+tensor collectives ride ICI via sharded ``jit``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def maybe_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize ``jax.distributed`` when running multi-host.
+
+    No-op when single-process (the common dev case) or already initialized.
+    Env-var driven: honors ``SHEEPRL_COORDINATOR``/``SHEEPRL_NUM_PROCESSES``/
+    ``SHEEPRL_PROCESS_ID`` as well as the standard TPU pod auto-detection.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("SHEEPRL_COORDINATOR")
+    if num_processes is None and "SHEEPRL_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["SHEEPRL_NUM_PROCESSES"])
+    if process_id is None and "SHEEPRL_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["SHEEPRL_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True

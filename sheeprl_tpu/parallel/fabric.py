@@ -1,0 +1,222 @@
+"""Device-mesh runtime — the TPU-native replacement for Lightning Fabric.
+
+The reference leans on ``lightning.fabric.Fabric`` for device management, DDP
+wrapping, precision and launching (reference: ``sheeprl/cli.py:148-198``).
+On TPU none of that machinery exists as wrappers around modules: the idiomatic
+design is
+
+- one JAX *process per host*, all chips visible through ``jax.devices()``;
+- a :class:`jax.sharding.Mesh` laying out chips over named axes
+  (``dp``/``fsdp``/``tp``) — data-parallel gradient all-reduce is not a wrapper
+  but a consequence of jitting a loss over batch-sharded inputs with
+  replicated params (XLA inserts the ``psum`` over ICI);
+- precision as a *policy* applied to params/compute dtypes rather than autocast
+  contexts.
+
+``Fabric`` here is therefore a small, stateless-ish context object: mesh +
+sharding helpers + rank info + RNG seeding + checkpoint IO. Algorithm mains
+receive it exactly like reference mains receive a Lightning Fabric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["Precision", "Fabric", "get_single_device_fabric"]
+
+
+_PRECISION_ALIASES = {
+    "32-true": ("float32", "float32"),
+    "32": ("float32", "float32"),
+    "bf16-mixed": ("float32", "bfloat16"),
+    "bf16-true": ("bfloat16", "bfloat16"),
+    "16-mixed": ("float32", "bfloat16"),  # fp16 has no TPU advantage; map to bf16
+    "16-true": ("bfloat16", "bfloat16"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Param/compute dtype policy (replaces Fabric precision strings)."""
+
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+
+    @classmethod
+    def from_string(cls, spec: str) -> "Precision":
+        if spec not in _PRECISION_ALIASES:
+            raise ValueError(f"Unknown precision '{spec}'. Known: {sorted(_PRECISION_ALIASES)}")
+        p, c = _PRECISION_ALIASES[spec]
+        return cls(param_dtype=jnp.dtype(p), compute_dtype=jnp.dtype(c))
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+class Fabric:
+    """Mesh + precision + rank context handed to every algorithm ``main``.
+
+    Config surface (group ``fabric`` for UX parity with the reference):
+
+    - ``devices``: chips *per process* to use (int or "auto");
+    - ``accelerator``: "auto" | "tpu" | "cpu" — informational, JAX picks the
+      platform from the environment;
+    - ``precision``: Lightning-style string, mapped to a dtype policy;
+    - ``strategy``: "auto" | "ddp" — accepted for config compatibility; the
+      mesh is always the mechanism.
+    """
+
+    def __init__(
+        self,
+        devices: int | str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        strategy: str = "auto",
+        mesh_axes: Sequence[str] = ("dp",),
+        mesh_shape: Optional[Sequence[int]] = None,
+        callbacks: Optional[Sequence[Any]] = None,
+    ) -> None:
+        all_devices = jax.devices()
+        if devices in ("auto", None, -1):
+            n = len(all_devices)
+        else:
+            n = int(devices)
+            if n > len(all_devices):
+                raise ValueError(f"Requested {n} devices but only {len(all_devices)} are visible")
+        self.devices = all_devices[:n]
+        self.accelerator = accelerator
+        self.strategy = strategy
+        self.precision = Precision.from_string(precision)
+        self.callbacks = list(callbacks or [])
+        self.mesh_axes = tuple(mesh_axes)
+        if mesh_shape is None:
+            mesh_shape = [n] + [1] * (len(self.mesh_axes) - 1)
+        dev_array = np.asarray(self.devices).reshape(tuple(mesh_shape))
+        self.mesh = Mesh(dev_array, self.mesh_axes)
+
+    # -- rank info -----------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        """Number of devices in the mesh (all processes)."""
+        return self.mesh.size
+
+    @property
+    def global_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def node_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def device(self) -> jax.Device:
+        return self.devices[0]
+
+    # -- rng -----------------------------------------------------------------
+    def seed_everything(self, seed: int) -> jax.Array:
+        """Seed python/numpy and return the root PRNG key
+        (replaces ``fabric.seed_everything``)."""
+        random.seed(seed)
+        np.random.seed(seed)
+        os.environ["PYTHONHASHSEED"] = str(seed)
+        return jax.random.PRNGKey(seed)
+
+    # -- shardings -----------------------------------------------------------
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """Batch-axis sharding over the ``dp`` mesh axis."""
+        return NamedSharding(self.mesh, P("dp"))
+
+    def shard_data(self, tree: Any) -> Any:
+        """Place host arrays on device, batch-sharded over ``dp``."""
+        sh = self.data_sharding
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+    def put_replicated(self, tree: Any) -> Any:
+        rep = self.replicated
+        return jax.tree.map(lambda x: jax.device_put(x, rep), tree)
+
+    # -- launch --------------------------------------------------------------
+    def launch(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run ``fn(self, *args)``.
+
+        Unlike Lightning there is no process spawning: JAX multi-host runs are
+        started externally (one process per host; ``jax.distributed`` is
+        initialized by :func:`sheeprl_tpu.parallel.distributed.maybe_init`).
+        """
+        with self.mesh:
+            return fn(self, *args, **kwargs)
+
+    # -- host-side collectives (control plane) -------------------------------
+    def broadcast_obj(self, obj: Any, src: int = 0) -> Any:
+        """Object broadcast across processes (DCN control-plane).
+        Single-process: identity."""
+        if jax.process_count() == 1:
+            return obj
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        return multihost_utils.broadcast_one_to_all(obj, is_source=jax.process_index() == src)
+
+    def barrier(self) -> None:
+        if jax.process_count() > 1:  # pragma: no cover
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
+
+    # -- callbacks (checkpoint hooks) ---------------------------------------
+    def call(self, hook_name: str, **kwargs: Any) -> None:
+        for cb in self.callbacks:
+            hook = getattr(cb, hook_name, None)
+            if hook is not None:
+                hook(fabric=self, **kwargs)
+
+    # -- factory -------------------------------------------------------------
+    @classmethod
+    def from_config(cls, fabric_cfg: Mapping[str, Any], callbacks: Optional[Sequence[Any]] = None) -> "Fabric":
+        return cls(
+            devices=fabric_cfg.get("devices", "auto"),
+            accelerator=fabric_cfg.get("accelerator", "auto"),
+            precision=str(fabric_cfg.get("precision", "32-true")),
+            strategy=str(fabric_cfg.get("strategy", "auto")),
+            mesh_axes=tuple(fabric_cfg.get("mesh_axes", ("dp",))),
+            mesh_shape=fabric_cfg.get("mesh_shape"),
+            callbacks=callbacks,
+        )
+
+
+def get_single_device_fabric(fabric: Fabric) -> Fabric:
+    """A sibling context pinned to one device, sharing the precision policy
+    (reference: ``sheeprl/utils/fabric.py:8-35``) — used for the *player* so
+    env-interaction inference never touches the mesh."""
+    f = Fabric(
+        devices=1,
+        accelerator=fabric.accelerator,
+        precision="32-true",
+        strategy="auto",
+        mesh_axes=("dp",),
+        callbacks=fabric.callbacks,
+    )
+    f.precision = fabric.precision
+    return f

@@ -1,0 +1,46 @@
+"""Checkpoint IO.
+
+Replaces ``fabric.save/load`` (torch.save pickles) with a host-side pickle of
+the full training state: JAX arrays are pulled to host numpy first
+(``jax.device_get``), so files contain only numpy/python objects and restore
+works on any topology. Replay buffers (dict-of-ndarray / MemmapArray) pickle
+through their own ``__getstate__``.
+
+The state layout per algorithm mirrors the reference (agent params, optimizer
+states, counters, ``Ratio``/``Moments`` states — e.g. ``dreamer_v3.py:735-753``)
+so resume fast-forwards identically.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+__all__ = ["save_state", "load_state"]
+
+
+def _to_host(tree: Any) -> Any:
+    """Convert any jax arrays in a pytree (incl. inside lists/dicts) to numpy."""
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree.map(leaf, tree)
+
+
+def save_state(path: str | Path, state: Dict[str, Any]) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    host_state = _to_host(state)
+    with open(path, "wb") as f:
+        pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_state(path: str | Path) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return pickle.load(f)

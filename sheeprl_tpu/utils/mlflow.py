@@ -1,0 +1,123 @@
+"""MLflow model-registry integration (reference: ``sheeprl/utils/mlflow.py``).
+
+Optional dependency: every entrypoint raises cleanly when mlflow is absent.
+JAX params are logged as pickled artifacts (there is no ``mlflow.pytorch``
+equivalent for flax in-tree; the artifact contains the raw param pytree plus
+the resolved config needed to rebuild the agent with ``build_agent``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import tempfile
+from pathlib import Path
+from typing import Any, Callable, Dict
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+__all__ = ["MlflowModelManager", "register_model", "register_model_from_checkpoint"]
+
+
+def _require_mlflow():
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError(
+            "MLflow is not installed. Please install it with 'pip install mlflow' to use the model manager."
+        )
+    import mlflow
+
+    return mlflow
+
+
+def log_params_artifact(name: str, params: Any) -> None:  # pragma: no cover - mlflow optional
+    mlflow = _require_mlflow()
+    import jax
+    import numpy as np
+
+    host = jax.tree.map(lambda x: np.asarray(x), params)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / f"{name}.pkl"
+        with open(path, "wb") as f:
+            pickle.dump(host, f)
+        mlflow.log_artifact(str(path), artifact_path=name)
+
+
+def register_model(fabric, log_models_fn: Callable, cfg: Dict[str, Any], models_to_log: Dict[str, Any]):  # pragma: no cover
+    mlflow = _require_mlflow()
+    tracking_uri = cfg.get("logger", {}).get("tracking_uri")
+    if tracking_uri:
+        mlflow.set_tracking_uri(tracking_uri)
+    experiment = mlflow.set_experiment(cfg.get("exp_name", "sheeprl_tpu"))
+    with mlflow.start_run(run_name=cfg.get("run_name", "run")) as run:
+        model_info = log_models_fn(cfg, models_to_log, run.info.run_id, experiment.experiment_id, None)
+    manager = MlflowModelManager(fabric, tracking_uri)
+    for k, spec in (cfg.get("model_manager", {}).get("models") or {}).items():
+        if k in model_info:
+            manager.register_model(model_info[k], spec["model_name"], spec.get("description"), spec.get("tags"))
+    return model_info
+
+
+def register_model_from_checkpoint(  # pragma: no cover
+    fabric, cfg: Dict[str, Any], state: Dict[str, Any], log_models_from_checkpoint: Callable
+):
+    mlflow = _require_mlflow()
+    from types import SimpleNamespace
+
+    from sheeprl_tpu.envs.factory import make_env
+
+    env = make_env(cfg, cfg.seed, 0, None)()
+    tracking_uri = cfg.get("logger", {}).get("tracking_uri")
+    if tracking_uri:
+        mlflow.set_tracking_uri(tracking_uri)
+    experiment = mlflow.set_experiment(cfg.get("exp_name", "sheeprl_tpu"))
+    cfg.run = SimpleNamespace(id=None, name=cfg.get("run_name", "registration"))
+    cfg.experiment = SimpleNamespace(id=experiment.experiment_id)
+    model_info = log_models_from_checkpoint(fabric, env, cfg, state)
+    manager = MlflowModelManager(fabric, tracking_uri)
+    for k, spec in (cfg.get("model_manager", {}).get("models") or {}).items():
+        if k in model_info:
+            manager.register_model(model_info[k], spec["model_name"], spec.get("description"), spec.get("tags"))
+    env.close()
+    return model_info
+
+
+class MlflowModelManager:
+    """Register/version/transition/delete models
+    (reference: ``sheeprl/utils/mlflow.py:34+``)."""
+
+    def __init__(self, fabric, tracking_uri: str | None = None):
+        mlflow = _require_mlflow()
+        self.fabric = fabric
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        from mlflow import MlflowClient
+
+        self.client = MlflowClient()
+
+    def register_model(self, model_info, model_name: str, description: str | None = None, tags: Dict | None = None):  # pragma: no cover
+        mlflow = _require_mlflow()
+        uri = getattr(model_info, "model_uri", None) or str(model_info)
+        result = mlflow.register_model(uri, model_name, tags=tags)
+        if description:
+            self.client.update_model_version(model_name, result.version, description)
+        return result
+
+    def get_latest_version(self, model_name: str):  # pragma: no cover
+        versions = self.client.search_model_versions(f"name='{model_name}'")
+        return max(versions, key=lambda v: int(v.version)) if versions else None
+
+    def transition_model(self, model_name: str, version: int, stage: str, description: str | None = None):  # pragma: no cover
+        self.client.transition_model_version_stage(model_name, version, stage)
+        if description:
+            self.client.update_model_version(model_name, version, description)
+
+    def delete_model(self, model_name: str, version: int | None = None):  # pragma: no cover
+        if version is None:
+            self.client.delete_registered_model(model_name)
+        else:
+            self.client.delete_model_version(model_name, version)
+
+    def download_model(self, model_name: str, version: int, output_path: str):  # pragma: no cover
+        mlflow = _require_mlflow()
+        return mlflow.artifacts.download_artifacts(
+            artifact_uri=f"models:/{model_name}/{version}", dst_path=output_path
+        )

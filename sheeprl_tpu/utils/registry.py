@@ -1,0 +1,111 @@
+"""Algorithm / evaluation registries (reference: ``sheeprl/utils/registry.py:11-101``).
+
+Decorator-populated tables mapping an algorithm module to its entrypoints. The
+reference eagerly imports every algorithm package from ``sheeprl/__init__.py``;
+here registration is also triggered by import (see ``sheeprl_tpu/__init__.py``),
+but the tables additionally keep the *module path* so the CLI can re-import
+lazily.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict, List, Optional
+
+algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
+evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+
+_BUILTIN_ALGO_MODULES = [
+    "sheeprl_tpu.algos.a2c.a2c",
+    "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_decoupled",
+    "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
+    "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.sac_decoupled",
+    "sheeprl_tpu.algos.sac_ae.sac_ae",
+    "sheeprl_tpu.algos.droq.droq",
+    "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
+    "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
+    "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_finetuning",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_finetuning",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning",
+]
+
+_BUILTIN_EVAL_MODULES = [
+    "sheeprl_tpu.algos.a2c.evaluate",
+    "sheeprl_tpu.algos.ppo.evaluate",
+    "sheeprl_tpu.algos.ppo_recurrent.evaluate",
+    "sheeprl_tpu.algos.sac.evaluate",
+    "sheeprl_tpu.algos.sac_ae.evaluate",
+    "sheeprl_tpu.algos.droq.evaluate",
+    "sheeprl_tpu.algos.dreamer_v1.evaluate",
+    "sheeprl_tpu.algos.dreamer_v2.evaluate",
+    "sheeprl_tpu.algos.dreamer_v3.evaluate",
+    "sheeprl_tpu.algos.p2e_dv1.evaluate",
+    "sheeprl_tpu.algos.p2e_dv2.evaluate",
+    "sheeprl_tpu.algos.p2e_dv3.evaluate",
+]
+
+
+def register_algorithm(decoupled: bool = False) -> Callable:
+    """Register ``fn`` as algorithm entrypoint; algo name = fn.__module__ leaf."""
+
+    def decorator(fn: Callable) -> Callable:
+        module = fn.__module__
+        name = module.rsplit(".", 1)[-1]
+        entry = {"name": name, "module": module, "entrypoint": fn.__name__, "decoupled": decoupled}
+        entries = algorithm_registry.setdefault(name, [])
+        if not any(e["entrypoint"] == fn.__name__ and e["module"] == module for e in entries):
+            entries.append(entry)
+        return fn
+
+    return decorator
+
+
+def register_evaluation(algorithms: str | List[str]) -> Callable:
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+
+    def decorator(fn: Callable) -> Callable:
+        for algo in algorithms:
+            entries = evaluation_registry.setdefault(algo, [])
+            entry = {"name": algo, "module": fn.__module__, "entrypoint": fn.__name__}
+            if not any(e["module"] == fn.__module__ and e["entrypoint"] == fn.__name__ for e in entries):
+                entries.append(entry)
+        return fn
+
+    return decorator
+
+
+def _ensure_populated() -> None:
+    """Import all builtin algorithm modules so their decorators run."""
+    for mod in _BUILTIN_ALGO_MODULES + _BUILTIN_EVAL_MODULES:
+        try:
+            importlib.import_module(mod)
+        except ModuleNotFoundError as e:
+            # during bootstrap not every algo exists yet; skip silently only
+            # if the missing module is the algo itself
+            if e.name and e.name.startswith("sheeprl_tpu"):
+                continue
+            raise
+
+
+def resolve_algorithm(name: str) -> Optional[Dict[str, Any]]:
+    _ensure_populated()
+    entries = algorithm_registry.get(name)
+    return entries[0] if entries else None
+
+
+def resolve_evaluation(algo_name: str) -> Optional[Dict[str, Any]]:
+    _ensure_populated()
+    entries = evaluation_registry.get(algo_name)
+    return entries[0] if entries else None
+
+
+def get_entrypoint(entry: Dict[str, Any]) -> Callable:
+    module = importlib.import_module(entry["module"])
+    return getattr(module, entry["entrypoint"])

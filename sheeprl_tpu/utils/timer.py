@@ -1,0 +1,64 @@
+"""Timing instrumentation (reference: ``sheeprl/utils/timer.py:16-83``).
+
+A context-manager/decorator that accumulates elapsed seconds per named timer
+into a class-level table, used by the training loops to derive
+``Time/sps_train`` and ``Time/sps_env_interaction``. Unlike the reference it
+does not depend on torchmetrics — timers are plain host floats.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Dict, Optional, Type
+
+from sheeprl_tpu.utils.metric import Metric, SumMetric
+
+__all__ = ["timer", "TimerError"]
+
+
+class TimerError(Exception):
+    """Raised on misuse of the timer class."""
+
+
+class timer(ContextDecorator):
+    disabled: bool = False
+    timers: Dict[str, Metric] = {}
+
+    def __init__(self, name: str, metric: Optional[Type[Metric]] = None, **kwargs) -> None:
+        self.name = name
+        self._start_time: Optional[float] = None
+        if not timer.disabled and name is not None and name not in timer.timers:
+            timer.timers[name] = metric(**kwargs) if metric is not None else SumMetric(**kwargs)
+
+    def start(self) -> None:
+        if self._start_time is not None:
+            raise TimerError("timer is running. Use .stop() to stop it")
+        self._start_time = time.perf_counter()
+
+    def stop(self) -> float:
+        if self._start_time is None:
+            raise TimerError("timer is not running. Use .start() to start it")
+        elapsed = time.perf_counter() - self._start_time
+        self._start_time = None
+        if self.name:
+            timer.timers[self.name].update(elapsed)
+        return elapsed
+
+    @classmethod
+    def reset(cls) -> None:
+        for t in cls.timers.values():
+            t.reset()
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        return {k: float(v.compute()) for k, v in cls.timers.items()}
+
+    def __enter__(self) -> "timer":
+        if not timer.disabled:
+            self.start()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        if not timer.disabled:
+            self.stop()

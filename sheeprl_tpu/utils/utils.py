@@ -1,0 +1,131 @@
+"""Misc host-side helpers (reference: ``sheeprl/utils/utils.py``).
+
+Device-side math (gae, symlog, two-hot, lambda returns) lives in
+``sheeprl_tpu.ops`` as jittable functions; this module keeps the host-side
+pieces: step-accounting (:class:`Ratio`), schedules, config printing/saving.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+from sheeprl_tpu.config import DotDict, dotdict, save_config, to_yaml
+
+__all__ = [
+    "Ratio",
+    "polynomial_decay",
+    "normalize_array",
+    "print_config",
+    "save_configs",
+    "dotdict",
+    "DotDict",
+]
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Polynomial schedule (reference: ``sheeprl/utils/utils.py:133-146``)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final
+
+
+def normalize_array(x: np.ndarray, eps: float = 1e-8, mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """Standardize; with a boolean mask only masked entries contribute stats."""
+    if mask is None:
+        flat = x
+        normalized = (flat - flat.mean()) / (flat.std() + eps)
+        return normalized
+    masked = x[mask]
+    return (masked - masked.mean()) / (masked.std() + eps)
+
+
+class Ratio:
+    """Replay-ratio governor controlling gradient steps per env step.
+
+    Semantics match the reference exactly (``sheeprl/utils/utils.py:261-302``,
+    itself from Hafner's DreamerV3 ``when.py``) — resume correctness depends on
+    ``_prev`` surviving checkpoints.
+    """
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"'ratio' must be non-negative, got {ratio}")
+        self._pretrain_steps = pretrain_steps
+        self._ratio = ratio
+        self._prev: Optional[float] = None
+
+    def __call__(self, step: int) -> int:
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            self._prev = step
+            repeats = int(step * self._ratio)
+            if self._pretrain_steps > 0:
+                if step < self._pretrain_steps:
+                    warnings.warn(
+                        "The number of pretrain steps is greater than the number of current steps. This could lead "
+                        f"to a higher ratio than the one specified ({self._ratio}). Setting the 'pretrain_steps' "
+                        "equal to the number of current steps."
+                    )
+                    self._pretrain_steps = step
+                repeats = int(self._pretrain_steps * self._ratio)
+            return repeats
+        repeats = int((step - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return repeats
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+
+    def load_state_dict(self, state_dict: Mapping[str, Any]) -> "Ratio":
+        self._ratio = state_dict["_ratio"]
+        self._prev = state_dict["_prev"]
+        self._pretrain_steps = state_dict["_pretrain_steps"]
+        return self
+
+
+def print_config(
+    config: Mapping[str, Any],
+    fields: Sequence[str] = ("algo", "buffer", "checkpoint", "env", "fabric", "metric"),
+    cfg_save_path: Optional[str] = None,
+) -> None:
+    """Rich tree dump of the main config sections
+    (reference: ``sheeprl/utils/utils.py:209-238``)."""
+    try:
+        import rich.syntax
+        import rich.tree
+    except ImportError:  # pragma: no cover - rich is available in practice
+        print(to_yaml({k: config.get(k) for k in fields if k in config}))
+        return
+    style = "dim"
+    tree = rich.tree.Tree("CONFIG", style=style, guide_style=style)
+    for field in fields:
+        if field not in config:
+            continue
+        branch = tree.add(field, style=style, guide_style=style)
+        section = config[field]
+        content = to_yaml(section) if isinstance(section, Mapping) else str(section)
+        branch.add(rich.syntax.Syntax(content, "yaml"))
+    rich.print(tree)
+    if cfg_save_path is not None:
+        with open(os.path.join(cfg_save_path, "config_tree.txt"), "w") as fp:
+            rich.print(tree, file=fp)
+
+
+def save_configs(cfg: Mapping[str, Any], log_dir: str) -> None:
+    """Persist the resolved config next to the run artifacts
+    (reference: ``sheeprl/utils/utils.py:257-258``)."""
+    save_config(cfg, os.path.join(log_dir, "config.yaml"))

@@ -1,0 +1,35 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX initializes
+(the TPU-world analogue of the reference's ``LT_DEVICES`` fixture,
+``tests/test_algos/test_algos.py:16-53``)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The sandbox may pin an accelerator platform via sitecustomize; force CPU
+# (the reference's LT_DEVICES analogue needs a local many-device mesh).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_logdir(tmp_path):
+    return str(tmp_path / "logs")
+
+
+@pytest.fixture(autouse=True)
+def _reset_metric_state():
+    """Timers/aggregator flags are class-level; isolate tests."""
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    yield
+    timer.timers.clear()
+    timer.disabled = False
+    MetricAggregator.disabled = False

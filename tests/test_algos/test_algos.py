@@ -1,0 +1,66 @@
+"""Algorithm smoke tests: every registered algorithm runs one dry-run
+iteration end-to-end through the real CLI on dummy envs — mirroring the
+reference suite (``tests/test_algos/test_algos.py:16-566``), with the device
+count parametrized over the virtual CPU mesh instead of ``LT_DEVICES``."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def _std_args(tmp_path, algo, env="dummy", devices=1, extra=()):
+    args = [
+        f"exp={algo}",
+        f"env={env}",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "dry_run=True",
+        "buffer.memmap=False",
+        f"fabric.devices={devices}",
+        "metric.log_level=0",
+        "checkpoint.save_last=False",
+        f"log_root={tmp_path}/logs",
+        "algo.run_test=False",
+    ]
+    args.extend(extra)
+    return args
+
+
+PPO_FAST = [
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_ppo_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "ppo", devices=devices, extra=PPO_FAST))
+
+
+def test_ppo_cnn_keys(tmp_path):
+    run(
+        _std_args(
+            tmp_path,
+            "ppo",
+            extra=PPO_FAST[:-1]
+            + ["algo.mlp_keys.encoder=[state]", "algo.cnn_keys.encoder=[rgb]", "env.screen_size=64"],
+        )
+    )
+
+
+def test_ppo_continuous(tmp_path):
+    run(_std_args(tmp_path, "ppo", extra=PPO_FAST + ["env.id=continuous_dummy"]))
+
+
+def test_ppo_multidiscrete(tmp_path):
+    run(_std_args(tmp_path, "ppo", extra=PPO_FAST + ["env.id=multidiscrete_dummy"]))
+
+
+def test_unknown_algorithm_errors(tmp_path):
+    with pytest.raises(Exception):
+        run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])

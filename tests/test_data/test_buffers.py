@@ -1,0 +1,234 @@
+"""Buffer unit tests mirroring the reference's ``tests/test_data`` coverage:
+wrap-around, sample validity, next-obs shift, sequence windows, per-env
+independence, episode eviction, memmap modes."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    MemmapArray,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+
+
+def _step(t, n_envs=2, extra=None):
+    data = {
+        "observations": np.full((1, n_envs, 3), t, dtype=np.float32),
+        "rewards": np.full((1, n_envs, 1), t, dtype=np.float32),
+        "truncated": np.zeros((1, n_envs, 1), dtype=np.uint8),
+        "terminated": np.zeros((1, n_envs, 1), dtype=np.uint8),
+    }
+    if extra:
+        data.update(extra)
+    return data
+
+
+class TestReplayBuffer:
+    def test_add_and_len(self):
+        rb = ReplayBuffer(8, 2)
+        for t in range(4):
+            rb.add(_step(t))
+        assert not rb.full
+        assert rb["observations"].shape == (8, 2, 3)
+
+    def test_wraparound(self):
+        rb = ReplayBuffer(4, 1)
+        for t in range(6):
+            rb.add(_step(t, n_envs=1))
+        assert rb.full
+        # positions 0,1 were overwritten by t=4,5
+        assert rb["observations"][0, 0, 0] == 4
+        assert rb["observations"][1, 0, 0] == 5
+        assert rb["observations"][2, 0, 0] == 2
+
+    def test_add_bigger_than_buffer(self):
+        rb = ReplayBuffer(4, 1)
+        data = {
+            "observations": np.arange(10, dtype=np.float32).reshape(10, 1, 1),
+        }
+        rb.add(data)
+        assert rb.full
+
+    def test_sample_shapes(self):
+        rb = ReplayBuffer(8, 2)
+        for t in range(8):
+            rb.add(_step(t))
+        s = rb.sample(5, n_samples=3)
+        assert s["observations"].shape == (3, 5, 3)
+
+    def test_sample_next_obs_shift(self):
+        rb = ReplayBuffer(16, 1)
+        for t in range(10):
+            rb.add(_step(t, n_envs=1))
+        s = rb.sample(64, sample_next_obs=True)
+        assert np.all(s["next_observations"][..., 0] == s["observations"][..., 0] + 1)
+
+    def test_sample_empty_raises(self):
+        rb = ReplayBuffer(8, 1)
+        with pytest.raises(ValueError):
+            rb.sample(1)
+
+    def test_invalid_sizes(self):
+        with pytest.raises(ValueError):
+            ReplayBuffer(0, 1)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, 0)
+
+    def test_validate_args(self):
+        rb = ReplayBuffer(8, 2)
+        with pytest.raises(ValueError):
+            rb.add({"x": [1, 2, 3]}, validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"x": np.zeros((3,))}, validate_args=True)
+
+    def test_memmap(self, tmp_path):
+        rb = ReplayBuffer(8, 2, memmap=True, memmap_dir=tmp_path / "buf")
+        for t in range(4):
+            rb.add(_step(t))
+        assert rb.is_memmap
+        assert (tmp_path / "buf" / "observations.memmap").exists()
+        s = rb.sample(4)
+        assert s["observations"].shape == (1, 4, 3)
+
+    def test_sample_tensors_device(self):
+        rb = ReplayBuffer(8, 2)
+        for t in range(8):
+            rb.add(_step(t))
+        s = rb.sample_tensors(4)
+        import jax
+
+        assert isinstance(s["observations"], jax.Array)
+
+
+class TestSequentialReplayBuffer:
+    def test_sequence_shapes(self):
+        rb = SequentialReplayBuffer(32, 2)
+        for t in range(32):
+            rb.add(_step(t))
+        s = rb.sample(4, sequence_length=8, n_samples=3)
+        assert s["observations"].shape == (3, 8, 4, 3)
+
+    def test_sequences_contiguous(self):
+        rb = SequentialReplayBuffer(32, 1)
+        for t in range(32):
+            rb.add(_step(t, n_envs=1))
+        s = rb.sample(6, sequence_length=5)
+        obs = s["observations"][0, :, :, 0]  # (seq, batch)
+        diffs = np.diff(obs, axis=0) % 32
+        assert np.all(diffs == 1)
+
+    def test_too_long_sequence_raises(self):
+        rb = SequentialReplayBuffer(8, 1)
+        for t in range(4):
+            rb.add(_step(t, n_envs=1))
+        with pytest.raises(ValueError):
+            rb.sample(1, sequence_length=6)
+
+    def test_full_buffer_avoids_write_head(self):
+        rb = SequentialReplayBuffer(16, 1)
+        for t in range(24):  # full + wrapped
+            rb.add(_step(t, n_envs=1))
+        s = rb.sample(10, sequence_length=4)
+        obs = s["observations"][0, :, :, 0]
+        diffs = np.diff(obs, axis=0)
+        # all sequences strictly consecutive in t as well (no wrap over head)
+        assert np.all(diffs == 1)
+
+
+class TestEnvIndependentReplayBuffer:
+    def test_add_subset_envs(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=3, buffer_cls=SequentialReplayBuffer)
+        data = _step(0, n_envs=2)
+        rb.add(data, indices=[0, 2])
+        assert not rb.buffer[0].empty
+        assert rb.buffer[1].empty
+        assert not rb.buffer[2].empty
+
+    def test_sample_concat(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        for t in range(16):
+            rb.add(_step(t))
+        s = rb.sample(6, sequence_length=4)
+        assert s["observations"].shape[2] == 6  # batch axis for sequential
+
+    def test_bad_indices_length(self):
+        rb = EnvIndependentReplayBuffer(8, n_envs=2)
+        with pytest.raises(ValueError):
+            rb.add(_step(0, n_envs=2), indices=[0])
+
+
+class TestEpisodeBuffer:
+    def _episode(self, length, n_envs=1, end=True):
+        term = np.zeros((length, n_envs, 1), dtype=np.uint8)
+        if end:
+            term[-1] = 1
+        return {
+            "observations": np.tile(np.arange(length, dtype=np.float32)[:, None, None], (1, n_envs, 1)),
+            "terminated": term,
+            "truncated": np.zeros((length, n_envs, 1), dtype=np.uint8),
+        }
+
+    def test_open_episode_not_sampled(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=4)
+        eb.add(self._episode(5, end=False))
+        with pytest.raises(RuntimeError):
+            eb.sample(1, sequence_length=4)
+
+    def test_episode_saved_and_sampled(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=4)
+        eb.add(self._episode(10))
+        s = eb.sample(3, sequence_length=4)
+        assert s["observations"].shape == (1, 4, 3, 1)
+
+    def test_eviction(self):
+        eb = EpisodeBuffer(20, minimum_episode_length=4)
+        for _ in range(4):
+            eb.add(self._episode(8))
+        assert len(eb) <= 20
+        assert len(eb.buffer) <= 3
+
+    def test_too_short_episode_raises(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=8)
+        with pytest.raises(RuntimeError):
+            eb.add(self._episode(3))
+
+    def test_prioritize_ends(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, prioritize_ends=True)
+        eb.add(self._episode(10))
+        s = eb.sample(8, sequence_length=2)
+        assert s["observations"].shape == (1, 2, 8, 1)
+
+
+class TestMemmapArray:
+    def test_roundtrip(self, tmp_path):
+        arr = MemmapArray(np.float32, (4, 3), filename=tmp_path / "a.memmap")
+        arr[:] = np.ones((4, 3), dtype=np.float32)
+        assert np.all(arr[2] == 1)
+
+    def test_from_array(self, tmp_path):
+        src = np.arange(12, dtype=np.int32).reshape(4, 3)
+        arr = MemmapArray.from_array(src, filename=tmp_path / "b.memmap")
+        assert np.all(arr.array == src)
+
+    def test_pickle_transfers_non_ownership(self, tmp_path):
+        import pickle
+
+        arr = MemmapArray(np.float32, (2, 2), filename=tmp_path / "c.memmap")
+        arr[:] = 7.0
+        clone = pickle.loads(pickle.dumps(arr))
+        assert not clone.has_ownership
+        assert arr.has_ownership
+        assert np.all(clone.array == 7.0)
+
+    def test_owner_deletes_file(self, tmp_path):
+        path = tmp_path / "d.memmap"
+        arr = MemmapArray(np.float32, (2,), filename=path)
+        assert path.exists()
+        del arr
+        import gc
+
+        gc.collect()
+        assert not path.exists()

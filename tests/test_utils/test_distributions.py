@@ -1,0 +1,126 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.distributions import (
+    BernoulliSafeMode,
+    Categorical,
+    Independent,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_divergence,
+)
+
+
+def test_normal_logprob_matches_scipy():
+    from scipy.stats import norm
+
+    d = Normal(jnp.array(1.0), jnp.array(2.0))
+    x = jnp.array(0.3)
+    np.testing.assert_allclose(float(d.log_prob(x)), norm.logpdf(0.3, 1.0, 2.0), rtol=1e-5)
+
+
+def test_independent_reduces():
+    d = Independent(Normal(jnp.zeros((4, 3)), jnp.ones((4, 3))), 1)
+    assert d.log_prob(jnp.zeros((4, 3))).shape == (4,)
+    assert d.entropy().shape == (4,)
+
+
+def test_categorical_logprob_entropy():
+    logits = jnp.array([[1.0, 2.0, 0.5]])
+    d = Categorical(logits)
+    probs = np.asarray(d.probs)[0]
+    assert pytest.approx(float(d.entropy()[0]), rel=1e-3) == -np.sum(probs * np.log(probs))
+    lp = float(d.log_prob(jnp.array([1]))[0])
+    assert pytest.approx(lp, rel=1e-3) == np.log(probs[1])
+
+
+def test_onehot_sample_and_mode():
+    logits = jnp.array([[0.0, 5.0, 0.0]])
+    d = OneHotCategorical(logits)
+    s = d.sample(jax.random.PRNGKey(0))
+    assert s.shape == (1, 3)
+    assert float(s.sum()) == 1.0
+    assert int(d.mode.argmax()) == 1
+
+
+def test_onehot_unimix():
+    logits = jnp.array([[100.0, 0.0, 0.0]])
+    d = OneHotCategorical(logits, unimix=0.01)
+    probs = np.asarray(d.probs)[0]
+    assert probs[1] > 0.001  # uniform mix keeps mass everywhere
+
+
+def test_straight_through_gradient_flows():
+    logits = jnp.array([[0.5, -0.5]])
+
+    def f(lo):
+        d = OneHotCategoricalStraightThrough(logits=lo)
+        return (d.rsample(jax.random.PRNGKey(0)) * jnp.array([1.0, 2.0])).sum()
+
+    g = jax.grad(f)(logits)
+    assert np.any(np.asarray(g) != 0)
+
+
+def test_tanh_normal_bounds_and_logprob():
+    d = TanhNormal(jnp.zeros((5,)), jnp.ones((5,)))
+    a, lp = d.sample_and_log_prob(jax.random.PRNGKey(0))
+    assert np.all(np.abs(np.asarray(a)) <= 1.0)
+    assert lp.shape == (5,)
+    lp2 = d.log_prob(a)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(lp2), rtol=1e-3, atol=1e-4)
+
+
+def test_truncated_normal_support():
+    d = TruncatedNormal(jnp.zeros(()), jnp.ones(()) * 2.0, -1.0, 1.0)
+    s = d.sample(jax.random.PRNGKey(0), (1000,))
+    assert np.all(np.abs(np.asarray(s)) <= 1.0)
+    assert np.isfinite(float(d.log_prob(jnp.array(0.5))))
+    assert float(d.log_prob(jnp.array(3.0))) == -np.inf
+
+
+def test_symlog_mse_distributions():
+    mode = jnp.ones((2, 4))
+    target = jnp.ones((2, 4)) * 2
+    sd = SymlogDistribution(mode, dims=1)
+    md = MSEDistribution(mode, dims=1)
+    assert sd.log_prob(target).shape == (2,)
+    assert md.log_prob(target).shape == (2,)
+    assert float(md.log_prob(mode)[0]) == 0.0
+
+
+def test_two_hot_distribution_mean_logprob():
+    logits = jnp.zeros((3, 255))
+    d = TwoHotEncodingDistribution(logits, dims=1, low=-20, high=20)
+    assert d.mean.shape == (3, 1)
+    lp = d.log_prob(jnp.array([[0.0], [1.0], [-3.0]]))
+    assert lp.shape == (3,)
+    # uniform logits → logprob = -log(255) spread over two buckets
+    np.testing.assert_allclose(np.asarray(lp), -np.log(255), rtol=1e-4)
+
+
+def test_bernoulli_safe_mode():
+    d = BernoulliSafeMode(jnp.zeros((4,)))
+    assert np.all(np.asarray(d.mode) == 0)
+
+
+def test_kl_onehot():
+    p = OneHotCategorical(jnp.array([[1.0, 0.0]]))
+    q = OneHotCategorical(jnp.array([[1.0, 0.0]]))
+    np.testing.assert_allclose(np.asarray(kl_divergence(p, q)), 0.0, atol=1e-6)
+    r = OneHotCategorical(jnp.array([[0.0, 1.0]]))
+    assert float(kl_divergence(p, r)[0]) > 0
+
+
+def test_kl_independent_normal():
+    p = Independent(Normal(jnp.zeros((2, 3)), jnp.ones((2, 3))), 1)
+    q = Independent(Normal(jnp.ones((2, 3)), jnp.ones((2, 3))), 1)
+    kl = kl_divergence(p, q)
+    np.testing.assert_allclose(np.asarray(kl), 1.5, rtol=1e-5)
