@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-import warnings
 from typing import Any, Dict, Sequence
 
 import gymnasium as gym
@@ -66,23 +65,7 @@ def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, writer=None)
     env.close()
 
 
-def log_models(cfg, models_to_log, run_id, experiment_id=None, run_name=None):  # pragma: no cover - mlflow optional
-    if not _IS_MLFLOW_AVAILABLE:
-        raise ModuleNotFoundError("mlflow is not installed")
-    import mlflow
-
-    from sheeprl_tpu.utils.mlflow import log_params_artifact
-
-    with mlflow.start_run(run_id=run_id, experiment_id=experiment_id, run_name=run_name, nested=True):
-        model_info = {}
-        for k in cfg.model_manager.models.keys():
-            if k not in models_to_log:
-                warnings.warn(f"Model {k} not found in models_to_log, skipping.", category=UserWarning)
-                continue
-            log_params_artifact(k, models_to_log[k])
-            model_info[k] = mlflow.get_artifact_uri(k)
-        mlflow.log_dict(dict(cfg), "config.json")
-    return model_info
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: E402  (shared registry helper)
 
 
 def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional

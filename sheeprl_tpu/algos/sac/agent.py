@@ -1,0 +1,229 @@
+"""SAC agent in Flax (reference: ``sheeprl/algos/sac/agent.py:20-340``).
+
+TPU-first design notes:
+
+- the critic ensemble (``critic.n`` independent twin Qs in the reference,
+  built as a ``nn.ModuleList`` of separate modules) is a single ``nn.vmap``-ed
+  module with a stacked leading parameter axis — on TPU the whole ensemble is
+  one batched matmul on the MXU instead of N small sequential ones;
+- target critics are not deep-copied modules but a second parameter pytree in
+  the same params dict (``target_critic``), updated by a pure EMA tree-map;
+- the learnable entropy coefficient lives in the params tree as ``log_alpha``
+  so one checkpointed pytree carries the whole agent
+  (reference keeps it as an ``nn.Parameter`` on the agent,
+  ``agent.py:164-165``);
+- the *player* is a set of jitted apply functions over the actor params —
+  no weight-tying machinery needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models import MLP
+
+__all__ = ["SACActor", "SACCritic", "SACCriticEnsemble", "SACAgent", "SACPlayer", "build_agent"]
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -5.0
+
+
+class SACActor(nn.Module):
+    """Squashed-Gaussian actor backbone: two hidden layers then mean/log-std
+    heads (reference: ``agent.py:57-144``)."""
+
+    action_dim: int
+    hidden_size: int = 256
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            activation="relu",
+            dtype=self.dtype,
+            name="backbone",
+        )(obs)
+        mean = nn.Dense(self.action_dim, dtype=self.dtype, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, dtype=self.dtype, name="fc_logstd")(x)
+        return mean, log_std
+
+
+class SACCritic(nn.Module):
+    """Q(s, a) MLP; ``num_critics`` output heads share the backbone
+    (reference: ``agent.py:20-56``)."""
+
+    num_critics: int = 1
+    hidden_size: int = 256
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class SACCriticEnsemble(nn.Module):
+    """``n`` independent critics as one vmapped module: params get a stacked
+    leading axis, the forward is a single batched matmul over the ensemble
+    (replaces the reference's ``nn.ModuleList`` loop, ``agent.py:246-249``).
+    Output shape: ``(batch, n)``."""
+
+    n: int = 2
+    hidden_size: int = 256
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            SACCritic,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.n,
+        )(num_critics=1, hidden_size=self.hidden_size, dtype=self.dtype, name="qfs")
+        q = ensemble(obs, action)  # (batch, 1, n)
+        return q[..., 0, :]
+
+
+@dataclasses.dataclass(frozen=True)
+class SACAgent:
+    """Static agent description + functional ops; all learnables live in the
+    params pytree ``{actor, critic, target_critic, log_alpha}``."""
+
+    actor: SACActor
+    critic: SACCriticEnsemble
+    action_scale: Any  # (act_dim,) numpy
+    action_bias: Any
+    target_entropy: float
+    tau: float
+
+    # -- actor ops -----------------------------------------------------------
+    def actor_dist(self, actor_params, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        mean, log_std = self.actor.apply(actor_params, obs)
+        std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        return mean, std
+
+    def sample_action(
+        self, actor_params, obs: jax.Array, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Reparameterized tanh-squashed sample with its log-prob
+        (Eq. 26 of the SAC paper; reference: ``agent.py:106-143``)."""
+        mean, std = self.actor_dist(actor_params, obs)
+        scale = jnp.asarray(self.action_scale, dtype=mean.dtype)
+        bias = jnp.asarray(self.action_bias, dtype=mean.dtype)
+        x = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+        y = jnp.tanh(x)
+        action = y * scale + bias
+        log_prob = -0.5 * (((x - mean) / std) ** 2 + 2.0 * jnp.log(std) + jnp.log(2.0 * jnp.pi))
+        log_prob = log_prob - jnp.log(scale * (1.0 - y**2) + 1e-6)
+        return action, log_prob.sum(-1, keepdims=True)
+
+    def greedy_action(self, actor_params, obs: jax.Array) -> jax.Array:
+        mean, _ = self.actor.apply(actor_params, obs)
+        return jnp.tanh(mean) * jnp.asarray(self.action_scale, dtype=mean.dtype) + jnp.asarray(
+            self.action_bias, dtype=mean.dtype
+        )
+
+    # -- critic ops ----------------------------------------------------------
+    def q_values(self, critic_params, obs: jax.Array, action: jax.Array) -> jax.Array:
+        return self.critic.apply(critic_params, obs, action)
+
+    def next_target_q(
+        self, params, next_obs: jax.Array, rewards: jax.Array, terminated: jax.Array, gamma: float, key: jax.Array
+    ) -> jax.Array:
+        """TD target from the target ensemble with entropy bonus
+        (reference: ``agent.py:255-263``)."""
+        next_action, next_logp = self.sample_action(params["actor"], next_obs, key)
+        q_t = self.q_values(params["target_critic"], next_obs, next_action)
+        alpha = jnp.exp(params["log_alpha"])
+        min_q = jnp.min(q_t, axis=-1, keepdims=True) - alpha * next_logp
+        return rewards + (1.0 - terminated) * gamma * min_q
+
+    def ema(self, critic_params, target_params, flag: jax.Array):
+        """Soft target update, gated by a traced scalar ``flag`` so it can run
+        inside the scanned train step (reference: ``agent.py:266-268``)."""
+        tau = self.tau
+        return jax.tree.map(
+            lambda p, t: flag * (tau * p + (1.0 - tau) * t) + (1.0 - flag) * t,
+            critic_params,
+            target_params,
+        )
+
+
+class SACPlayer:
+    """Host-side inference wrapper over the actor params
+    (reference: ``agent.py:270-316``)."""
+
+    def __init__(self, agent: SACAgent):
+        self.agent = agent
+        self._sample = jax.jit(lambda p, o, k: agent.sample_action(p, o, k)[0])
+        self._greedy = jax.jit(agent.greedy_action)
+
+    def get_actions(self, params, obs: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:
+        actor_params = params["actor"] if isinstance(params, dict) and "actor" in params else params
+        if greedy:
+            return self._greedy(actor_params, obs)
+        return self._sample(actor_params, obs, key)
+
+    def __call__(self, params, obs: jax.Array, key: jax.Array) -> jax.Array:
+        return self.get_actions(params, obs, key)
+
+
+def build_agent(
+    fabric,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACAgent, Dict[str, Any], SACPlayer]:
+    """Create modules + the single params pytree (+ player)
+    (reference: ``agent.py:319-340``)."""
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+
+    actor = SACActor(action_dim=act_dim, hidden_size=int(cfg.algo.actor.hidden_size), dtype=fabric.precision.compute_dtype)
+    critic = SACCriticEnsemble(
+        n=int(cfg.algo.critic.n), hidden_size=int(cfg.algo.critic.hidden_size), dtype=fabric.precision.compute_dtype
+    )
+    agent = SACAgent(
+        actor=actor,
+        critic=critic,
+        action_scale=np.asarray((action_space.high - action_space.low) / 2.0, dtype=np.float32),
+        action_bias=np.asarray((action_space.high + action_space.low) / 2.0, dtype=np.float32),
+        target_entropy=-float(act_dim),
+        tau=float(cfg.algo.tau),
+    )
+
+    key = jax.random.PRNGKey(cfg.seed)
+    k_actor, k_critic = jax.random.split(key)
+    dummy_obs = jnp.zeros((1, obs_dim), dtype=jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), dtype=jnp.float32)
+    actor_params = actor.init(k_actor, dummy_obs)
+    critic_params = critic.init(k_critic, dummy_obs, dummy_act)
+    params = {
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree.map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], dtype=jnp.float32)),
+    }
+    if agent_state is not None:
+        params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params, agent_state)
+    params = fabric.put_replicated(params)
+    player = SACPlayer(agent)
+    return agent, params, player

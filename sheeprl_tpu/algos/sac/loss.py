@@ -1,0 +1,25 @@
+"""SAC losses ("Soft Actor-Critic Algorithms and Applications",
+arXiv:1812.05905; reference: ``sheeprl/algos/sac/loss.py:1-27``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["policy_loss", "critic_loss", "entropy_loss"]
+
+
+def policy_loss(alpha: jax.Array, logprobs: jax.Array, qf_values: jax.Array) -> jax.Array:
+    # Eq. 7
+    return jnp.mean(alpha * logprobs - qf_values)
+
+
+def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int) -> jax.Array:
+    # Eq. 5 — sum of per-critic MSEs against the shared TD target
+    del num_critics  # the ensemble axis is the last one
+    return jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
+
+
+def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: float) -> jax.Array:
+    # Eq. 17
+    return jnp.mean(-log_alpha * (logprobs + target_entropy))

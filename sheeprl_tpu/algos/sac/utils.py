@@ -1,0 +1,69 @@
+"""SAC host-side helpers (reference: ``sheeprl/algos/sac/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
+) -> jax.Array:
+    """Concatenate vector keys into one float32 device array shaped
+    ``(num_envs, obs_dim)`` (reference: ``utils.py:31-37``)."""
+    flat = np.concatenate([np.asarray(obs[k], dtype=np.float32) for k in mlp_keys], axis=-1)
+    return jax.device_put(flat.reshape(num_envs, -1))
+
+
+def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, writer=None) -> None:
+    """Greedy evaluation episode (reference: ``utils.py:40-62``)."""
+    env = make_env(cfg, None if cfg.seed is None else cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        jobs = prepare_obs(fabric, obs, mlp_keys=cfg.algo.mlp_keys.encoder)
+        action = player.get_actions(params, jobs, greedy=True)
+        obs, reward, done, truncated, _ = env.step(np.asarray(action).reshape(env.action_space.shape))
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and writer is not None:
+        writer.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+
+
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: E402  (shared registry helper)
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError("mlflow is not installed")
+    import mlflow
+
+    from sheeprl_tpu.algos.sac.agent import build_agent
+
+    _, params, _ = build_agent(fabric, cfg, env.observation_space, env.action_space, state["agent"])
+    model_info = {}
+    with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
+        model_info["agent"] = mlflow.log_dict(
+            jax.tree.map(lambda x: np.asarray(x).tolist(), state["agent"]), "agent_params.json"
+        )
+        mlflow.log_dict(dict(cfg.to_log), "config.json")
+    return model_info

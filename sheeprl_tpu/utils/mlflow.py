@@ -15,7 +15,7 @@ from typing import Any, Callable, Dict
 
 from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
 
-__all__ = ["MlflowModelManager", "register_model", "register_model_from_checkpoint"]
+__all__ = ["MlflowModelManager", "log_models", "register_model", "register_model_from_checkpoint"]
 
 
 def _require_mlflow():
@@ -39,6 +39,27 @@ def log_params_artifact(name: str, params: Any) -> None:  # pragma: no cover - m
         with open(path, "wb") as f:
             pickle.dump(host, f)
         mlflow.log_artifact(str(path), artifact_path=name)
+
+
+def log_models(cfg, models_to_log, run_id, experiment_id=None, run_name=None):  # pragma: no cover - mlflow optional
+    """Log each configured model's params as an artifact in a nested run.
+
+    Shared by all algorithms whose registered models are plain param pytrees
+    (each reference algo re-implements this per-package,
+    e.g. ``sheeprl/algos/sac/utils.py:65-100``)."""
+    import warnings
+
+    mlflow = _require_mlflow()
+    with mlflow.start_run(run_id=run_id, experiment_id=experiment_id, run_name=run_name, nested=True):
+        model_info = {}
+        for k in cfg.model_manager.models.keys():
+            if k not in models_to_log:
+                warnings.warn(f"Model {k} not found in models_to_log, skipping.", category=UserWarning)
+                continue
+            log_params_artifact(k, models_to_log[k])
+            model_info[k] = mlflow.get_artifact_uri(k)
+        mlflow.log_dict(dict(cfg), "config.json")
+    return model_info
 
 
 def register_model(fabric, log_models_fn: Callable, cfg: Dict[str, Any], models_to_log: Dict[str, Any]):  # pragma: no cover

@@ -61,6 +61,36 @@ def test_ppo_multidiscrete(tmp_path):
     run(_std_args(tmp_path, "ppo", extra=PPO_FAST + ["env.id=multidiscrete_dummy"]))
 
 
+SAC_FAST = [
+    "algo.per_rank_batch_size=8",
+    "algo.mlp_keys.encoder=[state]",
+    "env.id=continuous_dummy",
+]
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_sac_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "sac", devices=devices, extra=SAC_FAST))
+
+
+def test_sac_sample_next_obs(tmp_path):
+    # dry_run forces a size-1 buffer, which cannot serve shifted next-obs
+    # indices — run a real (tiny) loop instead, like the reference suite.
+    args = _std_args(
+        tmp_path,
+        "sac",
+        extra=SAC_FAST
+        + [
+            "buffer.sample_next_obs=True",
+            "buffer.size=64",
+            "algo.total_steps=4",
+            "algo.learning_starts=4",
+        ],
+    )
+    args.remove("dry_run=True")
+    run(args)
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
