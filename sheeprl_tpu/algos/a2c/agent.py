@@ -1,0 +1,52 @@
+"""A2C agent (reference: ``sheeprl/algos/a2c/agent.py``).
+
+The reference A2C agent is the PPO network restricted to vector observations
+(MLP feature extractor + actor heads + critic). Here it IS the PPO flax
+module with ``cnn_keys=()`` — the params/player machinery is shared; only the
+losses and the update schedule differ (see ``a2c.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import PPOAgent, PPOPlayer, forward_with_actions, sample_actions
+
+__all__ = ["A2CAgent", "A2CPlayer", "build_agent", "forward_with_actions", "sample_actions"]
+
+A2CAgent = PPOAgent
+A2CPlayer = PPOPlayer
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[A2CAgent, Any, A2CPlayer]:
+    agent = A2CAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=(),
+        mlp_keys=tuple(cfg.algo.mlp_keys.encoder),
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+        dtype=fabric.precision.compute_dtype,
+    )
+    dummy_obs = {
+        k: jnp.zeros((1, int(np.prod(obs_space[k].shape))), dtype=jnp.float32)
+        for k in cfg.algo.mlp_keys.encoder
+    }
+    params = agent.init(jax.random.PRNGKey(cfg.seed), dummy_obs)
+    if agent_state is not None:
+        params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params, agent_state)
+    params = fabric.put_replicated(params)
+    player = A2CPlayer(agent, (), cfg.algo.mlp_keys.encoder)
+    return agent, params, player

@@ -1,0 +1,17 @@
+"""A2C losses (reference: ``sheeprl/algos/a2c/loss.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+from sheeprl_tpu.algos.ppo.loss import _reduce
+
+__all__ = ["policy_loss", "value_loss"]
+
+
+def policy_loss(logprobs: jax.Array, advantages: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce(-(logprobs * advantages), reduction)
+
+
+def value_loss(values: jax.Array, returns: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce((values - returns) ** 2, reduction)

@@ -1,0 +1,19 @@
+"""A2C host-side helpers (reference: ``sheeprl/algos/a2c/utils.py``).
+
+The evaluation protocol and obs preparation are identical to PPO's (with no
+CNN keys configured the shared ``prepare_obs`` reshapes every key to
+``(num_envs, -1)``), so both are imported from the PPO package."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test  # noqa: F401  (shared with PPO)
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.algos.ppo.utils import log_models_from_checkpoint as _ppo_impl
+
+    return _ppo_impl(fabric, env, cfg, state)

@@ -61,6 +61,25 @@ def test_ppo_multidiscrete(tmp_path):
     run(_std_args(tmp_path, "ppo", extra=PPO_FAST + ["env.id=multidiscrete_dummy"]))
 
 
+A2C_FAST = [
+    "algo.rollout_steps=8",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_a2c_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "a2c", devices=devices, extra=A2C_FAST))
+
+
+def test_a2c_continuous(tmp_path):
+    run(_std_args(tmp_path, "a2c", extra=A2C_FAST + ["env.id=continuous_dummy"]))
+
+
+def test_a2c_multidiscrete(tmp_path):
+    run(_std_args(tmp_path, "a2c", extra=A2C_FAST + ["env.id=multidiscrete_dummy"]))
+
+
 SAC_FAST = [
     "algo.per_rank_batch_size=8",
     "algo.mlp_keys.encoder=[state]",
