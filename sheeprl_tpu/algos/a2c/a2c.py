@@ -22,7 +22,6 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.a2c.agent import build_agent, forward_with_actions
-from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
 from sheeprl_tpu.algos.a2c.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
@@ -45,17 +44,26 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int):
     n_heads = 1 if agent.is_continuous else len(agent.actions_dim)
     split_sizes = np.cumsum(np.asarray(agent.actions_dim[:-1], dtype=np.int64)).tolist()
 
-    def minibatch_grads(params, batch):
+    def minibatch_grads(params, batch, weight):
+        # `weight` zeroes padded rows so the single accumulated-gradient step
+        # counts every real sample exactly once (the reference instead emits a
+        # ragged last minibatch, a2c.py:61-100)
         obs = {k: batch[k].astype(jnp.float32) for k in agent.mlp_keys}
         if agent.is_continuous:
             actions = [batch["actions"]]
         else:
             actions = jnp.split(batch["actions"], split_sizes, axis=-1) if n_heads > 1 else [batch["actions"]]
+        w = weight[:, None]
 
         def loss_fn(p):
             logprobs, _, values = forward_with_actions(agent, p, obs, actions)
-            pg = policy_loss(logprobs, batch["advantages"], loss_reduction)
-            v = value_loss(values, batch["returns"], loss_reduction)
+            pg_elem = -(logprobs * batch["advantages"]) * w
+            v_elem = ((values - batch["returns"]) ** 2) * w
+            if loss_reduction == "mean":
+                denom = jnp.maximum(w.sum(), 1.0)
+                pg, v = pg_elem.sum() / denom, v_elem.sum() / denom
+            else:  # sum
+                pg, v = pg_elem.sum(), v_elem.sum()
             return pg + v, (pg, v)
 
         (_, (pg, v)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -64,16 +72,20 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int):
     def local_train(params, opt_state, data, key):
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
         perm = jax.random.permutation(key, local_batch)
-        perm = jnp.resize(perm, (padded,))
-        batches = jax.tree.map(lambda x: x[perm.reshape(n_mb, mb_size)], data)
+        pad = padded - local_batch
+        idx = jnp.concatenate([perm, jnp.zeros((pad,), dtype=perm.dtype)])
+        weights = jnp.concatenate([jnp.ones((local_batch,), jnp.float32), jnp.zeros((pad,), jnp.float32)])
+        batches = jax.tree.map(lambda x: x[idx.reshape(n_mb, mb_size)], data)
+        mb_weights = weights.reshape(n_mb, mb_size)
 
-        def body(acc, batch):
-            grads, pg, v = minibatch_grads(params, batch)
+        def body(acc, xs):
+            batch, w = xs
+            grads, pg, v = minibatch_grads(params, batch, w)
             acc = jax.tree.map(jnp.add, acc, grads)
             return acc, (pg, v)
 
         zero = jax.tree.map(jnp.zeros_like, params)
-        grads, losses = jax.lax.scan(body, zero, batches)
+        grads, losses = jax.lax.scan(body, zero, (batches, mb_weights))
         grads = jax.lax.pmean(grads, "dp")
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -1,0 +1,138 @@
+"""DroQ agent (reference: ``sheeprl/algos/droq/agent.py``; paper
+arXiv:2110.02034 — dropout + LayerNorm Q ensembles enabling high replay
+ratios).
+
+Same functional layout as SAC: the critic ensemble is one ``nn.vmap``-ed
+module (stacked params, batched MXU matmul) instead of a ModuleList loop, and
+dropout masks are split per ensemble member via the vmap rng axis — matching
+the reference where each DROQCritic draws independent masks. Dropout is
+*active* in both the online and target critic passes (the DroQ estimator)."""
+
+from __future__ import annotations
+
+import dataclasses
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor, SACAgent, SACPlayer
+from sheeprl_tpu.models import MLP
+
+__all__ = ["DROQCritic", "DROQCriticEnsemble", "DROQAgent", "build_agent"]
+
+
+class DROQCritic(nn.Module):
+    """Q(s, a) MLP with per-layer Dropout and LayerNorm
+    (reference: ``agent.py:20-60``)."""
+
+    num_critics: int = 1
+    hidden_size: int = 256
+    dropout: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            layer_norm=True,
+            norm_args=({"eps": 1e-5}, {"eps": 1e-5}),
+            dropout=self.dropout,
+            dtype=self.dtype,
+            name="model",
+        )(x, deterministic=deterministic)
+
+
+class DROQCriticEnsemble(nn.Module):
+    """Vmapped DroQ critic ensemble; params AND dropout rngs are split over
+    the ensemble axis. Output ``(batch, n)``."""
+
+    n: int = 2
+    hidden_size: int = 256
+    dropout: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        ensemble = nn.vmap(
+            DROQCritic,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.n,
+        )(num_critics=1, hidden_size=self.hidden_size, dropout=self.dropout, dtype=self.dtype, name="qfs")
+        q = ensemble(obs, action, deterministic)
+        return q[..., 0, :]
+
+
+@dataclasses.dataclass(frozen=True)
+class DROQAgent(SACAgent):
+    """SACAgent with a dropout-bearing critic: Q evaluations thread a dropout
+    rng, and the TD target also runs the target ensemble with live dropout
+    (reference: the target critics stay in train mode, ``droq.py:99-117``)."""
+
+    def q_values_droq(self, critic_params, obs, action, key) -> jax.Array:
+        return self.critic.apply(
+            critic_params, obs, action, False, rngs={"dropout": key}
+        )
+
+    def next_target_q_droq(self, params, next_obs, rewards, terminated, gamma, key) -> jax.Array:
+        k_act, k_drop = jax.random.split(key)
+        next_action, next_logp = self.sample_action(params["actor"], next_obs, k_act)
+        q_t = self.q_values_droq(params["target_critic"], next_obs, next_action, k_drop)
+        alpha = jnp.exp(params["log_alpha"])
+        min_q = jnp.min(q_t, axis=-1, keepdims=True) - alpha * next_logp
+        return rewards + (1.0 - terminated) * gamma * min_q
+
+
+def build_agent(
+    fabric,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DROQAgent, Dict[str, Any], SACPlayer]:
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+
+    actor = SACActor(action_dim=act_dim, hidden_size=int(cfg.algo.actor.hidden_size), dtype=fabric.precision.compute_dtype)
+    critic = DROQCriticEnsemble(
+        n=int(cfg.algo.critic.n),
+        hidden_size=int(cfg.algo.critic.hidden_size),
+        dropout=float(cfg.algo.critic.dropout),
+        dtype=fabric.precision.compute_dtype,
+    )
+    agent = DROQAgent(
+        actor=actor,
+        critic=critic,
+        action_scale=np.asarray((action_space.high - action_space.low) / 2.0, dtype=np.float32),
+        action_bias=np.asarray((action_space.high + action_space.low) / 2.0, dtype=np.float32),
+        target_entropy=-float(act_dim),
+        tau=float(cfg.algo.tau),
+    )
+
+    key = jax.random.PRNGKey(cfg.seed)
+    k_actor, k_critic, k_drop = jax.random.split(key, 3)
+    dummy_obs = jnp.zeros((1, obs_dim), dtype=jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), dtype=jnp.float32)
+    actor_params = actor.init(k_actor, dummy_obs)
+    critic_params = critic.init({"params": k_critic, "dropout": k_drop}, dummy_obs, dummy_act)
+    params = {
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree.map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], dtype=jnp.float32)),
+    }
+    if agent_state is not None:
+        params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params, agent_state)
+    params = fabric.put_replicated(params)
+    player = SACPlayer(agent)
+    return agent, params, player

@@ -1,0 +1,32 @@
+"""DroQ evaluation entrypoint (reference: ``sheeprl/algos/droq/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.droq.agent import build_agent
+from sheeprl_tpu.algos.droq.utils import test
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+__all__ = ["evaluate_droq"]
+
+
+@register_evaluation(algorithms="droq")
+def evaluate_droq(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, fabric.global_rank)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    env.close()
+
+    _, params, player = build_agent(fabric, cfg, observation_space, action_space, state["agent"])
+    test(player, params, fabric, cfg, log_dir, writer=logger)
+    logger.close()

@@ -1,0 +1,22 @@
+"""DroQ host-side helpers (reference: ``sheeprl/algos/droq/utils.py`` — the
+evaluation protocol and obs preparation are SAC's)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test  # noqa: F401  (shared with SAC)
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.algos.sac.utils import log_models_from_checkpoint as _sac_impl
+
+    return _sac_impl(fabric, env, cfg, state)

@@ -1,0 +1,420 @@
+"""SAC-AE agent (reference: ``sheeprl/algos/sac_ae/agent.py``; paper
+arXiv:1910.01741 — pixel SAC regularized by an autoencoder).
+
+Weight-tying layout (reference ties tensors in-place, ``agent.py:333-339``):
+the critic owns the full encoder (conv trunk + fc head + mlp trunk); the
+actor reuses the SAME trunk params with gradients stopped and applies its OWN
+private fc head over the conv features (the reference ties only
+``cnn_encoder.model``/``mlp_encoder.model``, leaving the actor's ``fc``
+private). In functional JAX this is one ``encoder`` params tree applied by
+both paths plus a small ``actor_enc_head`` tree — no tying machinery.
+
+The Q ensemble is a single vmapped module over (features, action) like SAC's.
+Target critic = separate ``target_encoder``/``target_qfs`` trees with distinct
+EMA taus (``algo.tau`` for Qs, ``algo.encoder.tau`` for the encoder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models import CNN, DeCNN, MLP
+
+__all__ = [
+    "SACAEEncoder",
+    "ActorEncoderHead",
+    "SACAEActorHead",
+    "SACAEQEnsemble",
+    "SACAEDecoder",
+    "SACAEAgent",
+    "SACAEPlayer",
+    "build_agent",
+]
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -10.0
+
+
+class SACAEEncoder(nn.Module):
+    """Full (critic) encoder: 4-conv trunk + fc/LayerNorm/tanh head over
+    pixels, MLP trunk over vectors (reference: ``agent.py:26-121``).
+
+    ``trunk`` exposes the pre-head activations so the actor can attach its
+    private head to stopped-gradient trunk features."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    features_dim: int = 64
+    channels_multiplier: int = 16
+    dense_units: int = 64
+    mlp_layers: int = 2
+    layer_norm: bool = False
+    dtype: Any = None
+
+    def setup(self):
+        if self.cnn_keys:
+            self.conv = CNN(
+                hidden_channels=[32 * self.channels_multiplier] * 4,
+                layer_args=[
+                    {"kernel_size": 3, "stride": 2},
+                    {"kernel_size": 3, "stride": 1},
+                    {"kernel_size": 3, "stride": 1},
+                    {"kernel_size": 3, "stride": 1},
+                ],
+                activation="relu",
+                dtype=self.dtype,
+            )
+            self.fc = nn.Dense(self.features_dim, dtype=self.dtype)
+            self.ln = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
+        if self.mlp_keys:
+            self.mlp = MLP(
+                hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                activation="relu",
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+
+    def trunk(self, obs: Dict[str, jax.Array]) -> Tuple[Optional[jax.Array], Optional[jax.Array]]:
+        cnn_flat = None
+        mlp_feat = None
+        if self.cnn_keys:
+            x = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-1)
+            cnn_flat = self.conv(x).reshape(x.shape[0], -1)
+        if self.mlp_keys:
+            v = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            mlp_feat = self.mlp(v)
+        return cnn_flat, mlp_feat
+
+    def head(self, cnn_flat: jax.Array) -> jax.Array:
+        return jnp.tanh(self.ln(self.fc(cnn_flat)))
+
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        cnn_flat, mlp_feat = self.trunk(obs)
+        parts = []
+        if cnn_flat is not None:
+            parts.append(self.head(cnn_flat))
+        if mlp_feat is not None:
+            parts.append(mlp_feat)
+        return jnp.concatenate(parts, axis=-1)
+
+
+class ActorEncoderHead(nn.Module):
+    """The actor's private fc/LayerNorm/tanh over (detached) conv-trunk
+    features (the non-tied ``fc`` of the reference actor encoder)."""
+
+    features_dim: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, cnn_flat: jax.Array) -> jax.Array:
+        x = nn.Dense(self.features_dim, dtype=self.dtype)(cnn_flat)
+        return jnp.tanh(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x))
+
+
+class SACAEActorHead(nn.Module):
+    """Actor MLP + mean/log-std heads over encoder features; log-std squashed
+    by tanh into [LOG_STD_MIN, LOG_STD_MAX] (reference: ``agent.py:265-285``)."""
+
+    action_dim: int
+    hidden_size: int = 1024
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, feat: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu", dtype=self.dtype, name="model")(feat)
+        mean = nn.Dense(self.action_dim, dtype=self.dtype, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, dtype=self.dtype, name="fc_logstd")(x)
+        log_std = jnp.tanh(log_std)
+        log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1.0)
+        return mean, log_std
+
+
+class _QFunction(nn.Module):
+    hidden_size: int = 1024
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, feat: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([feat, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=1,
+            activation="relu",
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class SACAEQEnsemble(nn.Module):
+    """Vmapped Q ensemble over encoder features. Output ``(batch, n)``."""
+
+    n: int = 2
+    hidden_size: int = 1024
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, feat: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            _QFunction,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.n,
+        )(hidden_size=self.hidden_size, dtype=self.dtype, name="qfs")
+        return ensemble(feat, action)[..., 0, :]
+
+
+class SACAEDecoder(nn.Module):
+    """MultiDecoder: deconv pixel reconstruction + MLP vector heads, both from
+    the full latent (reference: ``agent.py:122-203``)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels: Sequence[int]  # per-key output channels
+    mlp_dims: Sequence[int]  # per-key output dims
+    conv_output_shape: Tuple[int, int, int]  # (H, W, C) of the encoder trunk
+    channels_multiplier: int = 16
+    dense_units: int = 64
+    mlp_layers: int = 2
+    layer_norm: bool = False
+    screen_size: int = 64
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            h, w, c = self.conv_output_shape
+            x = nn.Dense(h * w * c, dtype=self.dtype, name="fc")(latent)
+            x = x.reshape(-1, h, w, c)
+            x = DeCNN(
+                hidden_channels=[32 * self.channels_multiplier] * 3,
+                layer_args={"kernel_size": 3, "stride": 1},
+                activation="relu",
+                dtype=self.dtype,
+                name="deconv",
+            )(x)
+            from sheeprl_tpu.models.blocks import _ConvTranspose
+
+            x = _ConvTranspose(
+                features=int(sum(self.cnn_channels)),
+                kernel_size=(3, 3),
+                strides=(2, 2),
+                padding=0,
+                output_padding=1,
+                dtype=self.dtype,
+                name="to_obs",
+            )(x)
+            splits = np.cumsum(np.asarray(self.cnn_channels[:-1], dtype=np.int64)).tolist()
+            parts = jnp.split(x, splits, axis=-1) if len(self.cnn_keys) > 1 else [x]
+            out.update({k: p for k, p in zip(self.cnn_keys, parts)})
+        if self.mlp_keys:
+            y = MLP(
+                hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                activation="relu",
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+                name="mlp",
+            )(latent)
+            for i, (k, d) in enumerate(zip(self.mlp_keys, self.mlp_dims)):
+                out[k] = nn.Dense(int(d), dtype=self.dtype, name=f"head_{i}")(y)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SACAEAgent:
+    """Functional ops over the params tree ``{encoder, actor_enc_head, actor,
+    qfs, target_encoder, target_qfs, decoder, log_alpha}``."""
+
+    encoder: SACAEEncoder
+    actor_enc_head: Optional[ActorEncoderHead]
+    actor: SACAEActorHead
+    qfs: SACAEQEnsemble
+    decoder: SACAEDecoder
+    action_scale: Any
+    action_bias: Any
+    target_entropy: float
+    tau: float
+    encoder_tau: float
+
+    # -- features ------------------------------------------------------------
+    def critic_features(self, enc_params, obs) -> jax.Array:
+        return self.encoder.apply(enc_params, obs)
+
+    def actor_features(self, params, obs) -> jax.Array:
+        """Trunk features are ALWAYS gradient-stopped on the actor path (the
+        reference detaches them in the actor update; in every other context
+        no gradient flows anyway)."""
+        cnn_flat, mlp_feat = self.encoder.apply(params["encoder"], obs, method=SACAEEncoder.trunk)
+        parts = []
+        if cnn_flat is not None:
+            parts.append(self.actor_enc_head.apply(params["actor_enc_head"], jax.lax.stop_gradient(cnn_flat)))
+        if mlp_feat is not None:
+            parts.append(jax.lax.stop_gradient(mlp_feat))
+        return jnp.concatenate(parts, axis=-1)
+
+    # -- actor ---------------------------------------------------------------
+    def sample_action(self, params, obs, key) -> Tuple[jax.Array, jax.Array]:
+        feat = self.actor_features(params, obs)
+        mean, log_std = self.actor.apply(params["actor"], feat)
+        std = jnp.exp(log_std)
+        scale = jnp.asarray(self.action_scale, dtype=mean.dtype)
+        bias = jnp.asarray(self.action_bias, dtype=mean.dtype)
+        x = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+        y = jnp.tanh(x)
+        action = y * scale + bias
+        log_prob = -0.5 * (((x - mean) / std) ** 2 + 2.0 * jnp.log(std) + jnp.log(2.0 * jnp.pi))
+        log_prob = log_prob - jnp.log(scale * (1.0 - y**2) + 1e-6)
+        return action, log_prob.sum(-1, keepdims=True)
+
+    def greedy_action(self, params, obs) -> jax.Array:
+        feat = self.actor_features(params, obs)
+        mean, _ = self.actor.apply(params["actor"], feat)
+        return jnp.tanh(mean) * jnp.asarray(self.action_scale, dtype=mean.dtype) + jnp.asarray(
+            self.action_bias, dtype=mean.dtype
+        )
+
+    # -- critic --------------------------------------------------------------
+    def q_values(self, params, obs, action) -> jax.Array:
+        feat = self.critic_features(params["encoder"], obs)
+        return self.qfs.apply(params["qfs"], feat, action)
+
+    def next_target_q(self, params, next_obs, rewards, terminated, gamma, key) -> jax.Array:
+        next_action, next_logp = self.sample_action(params, next_obs, key)
+        feat_t = self.encoder.apply(params["target_encoder"], next_obs)
+        q_t = self.qfs.apply(params["target_qfs"], feat_t, next_action)
+        alpha = jnp.exp(params["log_alpha"])
+        min_q = jnp.min(q_t, axis=-1, keepdims=True) - alpha * next_logp
+        return rewards + (1.0 - terminated) * gamma * min_q
+
+    # -- EMA -----------------------------------------------------------------
+    def ema(self, params, flag: jax.Array):
+        def mix(tau):
+            return lambda p, t: flag * (tau * p + (1.0 - tau) * t) + (1.0 - flag) * t
+
+        return {
+            **params,
+            "target_qfs": jax.tree.map(mix(self.tau), params["qfs"], params["target_qfs"]),
+            "target_encoder": jax.tree.map(mix(self.encoder_tau), params["encoder"], params["target_encoder"]),
+        }
+
+
+class SACAEPlayer:
+    """Host-side inference wrapper over the actor path
+    (reference: ``agent.py:440-495``)."""
+
+    def __init__(self, agent: SACAEAgent):
+        self.agent = agent
+        self._sample = jax.jit(lambda p, o, k: agent.sample_action(p, o, k)[0])
+        self._greedy = jax.jit(agent.greedy_action)
+
+    def get_actions(self, params, obs, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:
+        if greedy:
+            return self._greedy(params, obs)
+        return self._sample(params, obs, key)
+
+    def __call__(self, params, obs, key) -> jax.Array:
+        return self.get_actions(params, obs, key)
+
+
+def build_agent(
+    fabric,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACAEAgent, Dict[str, Any], SACAEPlayer]:
+    act_dim = int(prod(action_space.shape))
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_channels = [int(prod(obs_space[k].shape[2:] or (1,))) for k in cnn_keys]  # NHWC: channels last
+    mlp_dims = [int(prod(obs_space[k].shape)) for k in mlp_keys]
+    screen = int(cfg.env.screen_size)
+
+    dtype = fabric.precision.compute_dtype
+    encoder = SACAEEncoder(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        features_dim=int(cfg.algo.encoder.features_dim),
+        channels_multiplier=int(cfg.algo.encoder.cnn_channels_multiplier),
+        dense_units=int(cfg.algo.encoder.dense_units),
+        mlp_layers=int(cfg.algo.encoder.mlp_layers),
+        layer_norm=bool(cfg.algo.encoder.layer_norm),
+        dtype=dtype,
+    )
+    # conv trunk output: 4 convs (s2,s1,s1,s1, k3, VALID) from screen_size
+    s = screen
+    for stride in (2, 1, 1, 1):
+        s = (s - 3) // stride + 1
+    conv_output_shape = (s, s, 32 * int(cfg.algo.encoder.cnn_channels_multiplier))
+    features_out = (int(cfg.algo.encoder.features_dim) if cnn_keys else 0) + (
+        int(cfg.algo.encoder.dense_units) if mlp_keys else 0
+    )
+
+    actor_enc_head = ActorEncoderHead(features_dim=int(cfg.algo.encoder.features_dim), dtype=dtype) if cnn_keys else None
+    actor = SACAEActorHead(action_dim=act_dim, hidden_size=int(cfg.algo.actor.hidden_size), dtype=dtype)
+    qfs = SACAEQEnsemble(n=int(cfg.algo.critic.n), hidden_size=int(cfg.algo.critic.hidden_size), dtype=dtype)
+    decoder = SACAEDecoder(
+        cnn_keys=tuple(cfg.algo.cnn_keys.decoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.decoder),
+        cnn_channels=tuple(cnn_channels),
+        mlp_dims=tuple(mlp_dims),
+        conv_output_shape=conv_output_shape,
+        channels_multiplier=int(cfg.algo.decoder.cnn_channels_multiplier),
+        dense_units=int(cfg.algo.decoder.dense_units),
+        mlp_layers=int(cfg.algo.decoder.mlp_layers),
+        layer_norm=bool(cfg.algo.decoder.layer_norm),
+        screen_size=screen,
+        dtype=dtype,
+    )
+    agent = SACAEAgent(
+        encoder=encoder,
+        actor_enc_head=actor_enc_head,
+        actor=actor,
+        qfs=qfs,
+        decoder=decoder,
+        action_scale=np.asarray((action_space.high - action_space.low) / 2.0, dtype=np.float32),
+        action_bias=np.asarray((action_space.high + action_space.low) / 2.0, dtype=np.float32),
+        target_entropy=-float(act_dim),
+        tau=float(cfg.algo.tau),
+        encoder_tau=float(cfg.algo.encoder.tau),
+    )
+
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 5)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, screen, screen, ch), dtype=jnp.float32)
+    for k, d in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, d), dtype=jnp.float32)
+
+    enc_params = encoder.init(keys[0], dummy_obs)
+    dummy_feat = jnp.zeros((1, features_out), dtype=jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), dtype=jnp.float32)
+    params = {
+        "encoder": enc_params,
+        "actor_enc_head": (
+            actor_enc_head.init(keys[1], jnp.zeros((1, int(np.prod(conv_output_shape))), dtype=jnp.float32))
+            if actor_enc_head is not None
+            else {}
+        ),
+        "actor": actor.init(keys[2], dummy_feat),
+        "qfs": qfs.init(keys[3], dummy_feat, dummy_act),
+        "decoder": decoder.init(keys[4], dummy_feat),
+        "log_alpha": jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], dtype=jnp.float32)),
+    }
+    params["target_encoder"] = jax.tree.map(jnp.copy, params["encoder"])
+    params["target_qfs"] = jax.tree.map(jnp.copy, params["qfs"])
+    if agent_state is not None:
+        params = jax.tree.map(lambda t, s_: jnp.asarray(s_, dtype=t.dtype), params, agent_state)
+    params = fabric.put_replicated(params)
+    player = SACAEPlayer(agent)
+    return agent, params, player

@@ -1,0 +1,83 @@
+"""SAC-AE host-side helpers (reference: ``sheeprl/algos/sac_ae/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, bits: int = 8, key: jax.Array | None = None) -> jax.Array:
+    """Bit-reduction preprocessing of pixel targets (arXiv:1807.03039;
+    reference: ``utils.py:68-76``)."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    if key is not None:
+        obs = obs + jax.random.uniform(key, obs.shape, dtype=obs.dtype) / bins
+    return obs - 0.5
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
+) -> Dict[str, jax.Array]:
+    """Pixels → float32 NHWC in [0, 1]; vectors → flat float32."""
+    out = {}
+    for k in obs.keys():
+        v = np.asarray(obs[k], dtype=np.float32)
+        if k in cnn_keys:
+            v = v.reshape(num_envs, *v.shape[-3:]) / 255.0
+        else:
+            v = v.reshape(num_envs, -1)
+        out[k] = jax.device_put(v)
+    return out
+
+
+def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, writer=None) -> None:
+    env = make_env(cfg, None if cfg.seed is None else cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        jobs = prepare_obs(fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder)
+        action = player.get_actions(params, jobs, greedy=True)
+        obs, reward, done, truncated, _ = env.step(np.asarray(action).reshape(env.action_space.shape))
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and writer is not None:
+        writer.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    import mlflow
+
+    from sheeprl_tpu.algos.sac_ae.agent import build_agent
+
+    _, params, _ = build_agent(fabric, cfg, env.observation_space, env.action_space, state["agent"])
+    model_info = {}
+    with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
+        model_info["agent"] = mlflow.log_dict(
+            jax.tree.map(lambda x: np.asarray(x).tolist(), state["agent"]), "agent_params.json"
+        )
+        mlflow.log_dict(dict(cfg.to_log), "config.json")
+    return model_info

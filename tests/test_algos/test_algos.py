@@ -110,6 +110,31 @@ def test_sac_sample_next_obs(tmp_path):
     run(args)
 
 
+@pytest.mark.parametrize("devices", [1, 2])
+def test_sac_ae_dry_run(tmp_path, devices):
+    run(
+        _std_args(
+            tmp_path,
+            "sac_ae",
+            devices=devices,
+            extra=[
+                "algo.per_rank_batch_size=4",
+                "algo.cnn_keys.encoder=[rgb]",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.hidden_size=16",
+                "algo.cnn_channels_multiplier=2",
+                "env.id=continuous_dummy",
+                "env.screen_size=64",
+            ],
+        )
+    )
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_droq_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "droq", devices=devices, extra=SAC_FAST))
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
