@@ -17,6 +17,17 @@ MODELS_TO_REGISTER = {"agent"}
 
 
 def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
-    from sheeprl_tpu.algos.sac.utils import log_models_from_checkpoint as _sac_impl
+    import jax
+    import mlflow
+    import numpy as np
 
-    return _sac_impl(fabric, env, cfg, state)
+    from sheeprl_tpu.algos.droq.agent import build_agent
+
+    _, params, _ = build_agent(fabric, cfg, env.observation_space, env.action_space, state["agent"])
+    model_info = {}
+    with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
+        model_info["agent"] = mlflow.log_dict(
+            jax.tree.map(lambda x: np.asarray(x).tolist(), state["agent"]), "agent_params.json"
+        )
+        mlflow.log_dict(dict(cfg.to_log), "config.json")
+    return model_info

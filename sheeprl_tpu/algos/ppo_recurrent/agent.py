@@ -1,0 +1,257 @@
+"""Recurrent PPO agent (reference: ``sheeprl/algos/ppo_recurrent/agent.py``).
+
+The LSTM is an ``nn.scan``-ned :class:`flax.linen.OptimizedLSTMCell` over the
+time axis — one fused XLA while-loop instead of cuDNN's packed sequences. The
+reference packs padded sequences to skip trailing pad steps
+(``agent.py:67-81``); here pads are simply scanned through and masked out of
+the losses, which is output-equivalent because padding is always trailing.
+
+The player is the same module applied with ``T=1`` and host-carried
+``(hx, cx)`` state (reference ``RecurrentPPOPlayer``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder
+from sheeprl_tpu.models import MLP, MultiEncoder
+
+__all__ = ["RecurrentModel", "RecurrentPPOAgent", "RecurrentPPOPlayer", "build_agent"]
+
+
+class RecurrentModel(nn.Module):
+    """Optional pre-MLP → LSTM scan → optional post-MLP
+    (reference: ``agent.py:18-81``)."""
+
+    lstm_hidden_size: int
+    pre_rnn_mlp: Dict[str, Any]
+    post_rnn_mlp: Dict[str, Any]
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, hx: jax.Array, cx: jax.Array
+    ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+        if self.pre_rnn_mlp.get("apply"):
+            x = MLP(
+                hidden_sizes=(int(self.pre_rnn_mlp["dense_units"]),),
+                activation=self.pre_rnn_mlp.get("activation", "relu"),
+                layer_norm=bool(self.pre_rnn_mlp.get("layer_norm")),
+                dtype=self.dtype,
+                name="pre_mlp",
+            )(x)
+        scan_lstm = nn.scan(
+            nn.OptimizedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        (cx, hx), out = scan_lstm(self.lstm_hidden_size, dtype=self.dtype, name="lstm")((cx, hx), x)
+        if self.post_rnn_mlp.get("apply"):
+            out = MLP(
+                hidden_sizes=(int(self.post_rnn_mlp["dense_units"]),),
+                activation=self.post_rnn_mlp.get("activation", "relu"),
+                layer_norm=bool(self.post_rnn_mlp.get("layer_norm")),
+                dtype=self.dtype,
+                name="post_mlp",
+            )(out)
+        return out, (hx, cx)
+
+
+class RecurrentPPOAgent(nn.Module):
+    """Encoder → LSTM over [features, prev_actions] → actor heads + critic
+    (reference: ``agent.py:83-263``). Inputs are time-major ``(T, B, ...)``."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    encoder_cfg: Dict[str, Any]
+    rnn_cfg: Dict[str, Any]
+    actor_cfg: Dict[str, Any]
+    critic_cfg: Dict[str, Any]
+    screen_size: int = 64
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: Dict[str, jax.Array],
+        prev_actions: jax.Array,
+        hx: jax.Array,
+        cx: jax.Array,
+    ) -> Tuple[List[jax.Array], jax.Array, Tuple[jax.Array, jax.Array]]:
+        T, B = prev_actions.shape[0], prev_actions.shape[1]
+        cnn_encoder = (
+            CNNEncoder(keys=self.cnn_keys, features_dim=self.encoder_cfg["cnn_features_dim"], dtype=self.dtype, name="cnn_encoder")
+            if self.cnn_keys
+            else None
+        )
+        mlp_encoder = (
+            MLPEncoder(
+                keys=self.mlp_keys,
+                features_dim=self.encoder_cfg["mlp_features_dim"],
+                dense_units=self.encoder_cfg["dense_units"],
+                mlp_layers=self.encoder_cfg["mlp_layers"],
+                dense_act=self.encoder_cfg["dense_act"],
+                layer_norm=self.encoder_cfg["layer_norm"],
+                dtype=self.dtype,
+                name="mlp_encoder",
+            )
+            if self.mlp_keys
+            else None
+        )
+        # encoders are batch-shaped; fold time into batch for them
+        flat_obs = {k: v.reshape(T * B, *v.shape[2:]) for k, v in obs.items()}
+        feat = MultiEncoder(cnn_encoder, mlp_encoder, name="feature_extractor")(flat_obs)
+        feat = feat.reshape(T, B, -1)
+
+        rnn_in = jnp.concatenate([feat, prev_actions], axis=-1)
+        out, states = RecurrentModel(
+            lstm_hidden_size=int(self.rnn_cfg["lstm"]["hidden_size"]),
+            pre_rnn_mlp=dict(self.rnn_cfg["pre_rnn_mlp"]),
+            post_rnn_mlp=dict(self.rnn_cfg["post_rnn_mlp"]),
+            dtype=self.dtype,
+            name="rnn",
+        )(rnn_in, hx, cx)
+
+        values = MLP(
+            hidden_sizes=(self.critic_cfg["dense_units"],) * self.critic_cfg["mlp_layers"],
+            output_dim=1,
+            activation=self.critic_cfg["dense_act"],
+            layer_norm=self.critic_cfg["layer_norm"],
+            dtype=self.dtype,
+            name="critic",
+        )(out)
+
+        backbone = MLP(
+            hidden_sizes=(self.actor_cfg["dense_units"],) * self.actor_cfg["mlp_layers"],
+            activation=self.actor_cfg["dense_act"],
+            layer_norm=self.actor_cfg["layer_norm"],
+            dtype=self.dtype,
+            name="actor_backbone",
+        )(out)
+        if self.is_continuous:
+            actor_outs = [nn.Dense(int(sum(self.actions_dim)) * 2, dtype=self.dtype, name="actor_head_0")(backbone)]
+        else:
+            actor_outs = [
+                nn.Dense(int(d), dtype=self.dtype, name=f"actor_head_{i}")(backbone)
+                for i, d in enumerate(self.actions_dim)
+            ]
+        return actor_outs, values, states
+
+
+def _dists(actor_outs: List[jax.Array], is_continuous: bool):
+    from sheeprl_tpu.distributions import Independent, Normal, OneHotCategorical
+
+    if is_continuous:
+        mean, log_std = jnp.split(actor_outs[0], 2, axis=-1)
+        return [Independent(Normal(mean, jnp.exp(log_std)), 1)]
+    return [OneHotCategorical(logits=lo) for lo in actor_outs]
+
+
+def forward_with_actions(
+    agent: RecurrentPPOAgent, params, obs, prev_actions, hx, cx, actions: List[jax.Array]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Train-path forward: logprob/entropy/value of stored actions."""
+    actor_outs, values, _ = agent.apply(params, obs, prev_actions, hx, cx)
+    dists = _dists(actor_outs, agent.is_continuous)
+    if agent.is_continuous:
+        logprob = dists[0].log_prob(actions[0])[..., None]
+        entropy = dists[0].entropy()[..., None]
+    else:
+        logprob = jnp.stack([d.log_prob(a) for d, a in zip(dists, actions)], axis=-1).sum(-1, keepdims=False)[..., None]
+        entropy = jnp.stack([d.entropy() for d in dists], axis=-1).sum(-1, keepdims=False)[..., None]
+    return logprob, entropy, values
+
+
+def sample_actions(
+    agent: RecurrentPPOAgent, params, obs, prev_actions, hx, cx, key, greedy: bool = False
+):
+    """Player forward (T=1): sampled actions, logprob, value, new states."""
+    actor_outs, values, states = agent.apply(params, obs, prev_actions, hx, cx)
+    dists = _dists(actor_outs, agent.is_continuous)
+    if agent.is_continuous:
+        acts = dists[0].mode if greedy else dists[0].sample(key)
+        logprob = dists[0].log_prob(acts)[..., None]
+        return (acts,), logprob, values, states
+    keys = jax.random.split(key, len(dists))
+    acts, logprobs = [], []
+    for d, k in zip(dists, keys):
+        a = d.mode if greedy else d.sample(k)
+        acts.append(a)
+        logprobs.append(d.log_prob(a))
+    logprob = jnp.stack(logprobs, axis=-1).sum(-1, keepdims=False)[..., None]
+    return tuple(acts), logprob, values, states
+
+
+class RecurrentPPOPlayer:
+    """Host-side stepper carrying ``(hx, cx)`` across env steps
+    (reference: ``agent.py:265-360``)."""
+
+    def __init__(self, agent: RecurrentPPOAgent, num_envs: int, rnn_hidden_size: int):
+        self.agent = agent
+        self.num_envs = num_envs
+        self.rnn_hidden_size = rnn_hidden_size
+        self.is_continuous = agent.is_continuous
+        self.actions_dim = agent.actions_dim
+        self._forward = jax.jit(lambda p, o, a, hx, cx, k: sample_actions(agent, p, o, a, hx, cx, k))
+        self._greedy = jax.jit(lambda p, o, a, hx, cx, k: sample_actions(agent, p, o, a, hx, cx, k, greedy=True))
+        self._values = jax.jit(lambda p, o, a, hx, cx: agent.apply(p, o, a, hx, cx)[1:])
+
+    def reset_states(self, n: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+        n = n or self.num_envs
+        z = jnp.zeros((n, self.rnn_hidden_size), dtype=jnp.float32)
+        return z, jnp.copy(z)
+
+    def __call__(self, params, obs, prev_actions, states, key, greedy: bool = False):
+        fn = self._greedy if greedy else self._forward
+        acts, logprob, values, new_states = fn(params, obs, prev_actions, states[0], states[1], key)
+        return acts, logprob, values, new_states
+
+    def get_values(self, params, obs, prev_actions, states):
+        values, new_states = self._values(params, obs, prev_actions, states[0], states[1])
+        return values, new_states
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[RecurrentPPOAgent, Any, RecurrentPPOPlayer]:
+    agent = RecurrentPPOAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.encoder),
+        encoder_cfg=dict(cfg.algo.encoder),
+        rnn_cfg=dict(cfg.algo.rnn),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+        screen_size=cfg.env.screen_size,
+        dtype=fabric.precision.compute_dtype,
+    )
+    hidden = int(cfg.algo.rnn.lstm.hidden_size)
+    dummy_obs = {}
+    for k in list(cfg.algo.cnn_keys.encoder):
+        dummy_obs[k] = jnp.zeros((1, 1, *obs_space[k].shape), dtype=jnp.float32)
+    for k in list(cfg.algo.mlp_keys.encoder):
+        dummy_obs[k] = jnp.zeros((1, 1, int(np.prod(obs_space[k].shape))), dtype=jnp.float32)
+    dummy_actions = jnp.zeros((1, 1, int(sum(actions_dim))), dtype=jnp.float32)
+    z = jnp.zeros((1, hidden), dtype=jnp.float32)
+    params = agent.init(jax.random.PRNGKey(cfg.seed), dummy_obs, dummy_actions, z, z)
+    if agent_state is not None:
+        params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params, agent_state)
+    params = fabric.put_replicated(params)
+    player = RecurrentPPOPlayer(agent, cfg.env.num_envs, hidden)
+    return agent, params, player

@@ -1,0 +1,109 @@
+"""Recurrent PPO host-side helpers
+(reference: ``sheeprl/algos/ppo_recurrent/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss", "Loss/entropy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
+) -> Dict[str, jax.Array]:
+    """Time-major ``(1, num_envs, ...)`` float32 device arrays; pixels
+    normalized to [-0.5, 0.5]."""
+    out = {}
+    for k in obs.keys():
+        v = np.asarray(obs[k], dtype=np.float32)
+        if k in cnn_keys:
+            v = v.reshape(1, num_envs, *v.shape[-3:]) / 255.0 - 0.5
+        else:
+            v = v.reshape(1, num_envs, -1)
+        out[k] = jax.device_put(v)
+    return out
+
+
+def chunk_sequences(
+    local_data: Dict[str, np.ndarray], rollout_steps: int, num_envs: int, seq_len: int
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Split the (T, N, ...) rollout into per-episode slices, chunk each into
+    sequences of at most ``seq_len``, and right-pad to ``(seq_len, S, ...)``
+    with a boolean ``mask`` (reference: ``ppo_recurrent.py:406-445``)."""
+    sequences: List[Dict[str, np.ndarray]] = []
+    lengths: List[int] = []
+    for env_id in range(num_envs):
+        env_data = {k: v[:, env_id] for k, v in local_data.items()}
+        ends = np.nonzero(env_data["dones"].reshape(rollout_steps, -1)[:, 0])[0].tolist()
+        ends.append(rollout_steps)
+        start = 0
+        for stop in ends:
+            if start >= rollout_steps:
+                break
+            # the final pseudo-episode ends at rollout_steps, so the +1 slice
+            # end is clamped by the array (reference: ppo_recurrent.py:414-424)
+            ep = {k: v[start : stop + 1] for k, v in env_data.items()}
+            ep_len = next(iter(ep.values())).shape[0]
+            if ep_len <= 0:
+                start = stop + 1
+                continue
+            for s in range(0, ep_len, seq_len):
+                chunk_len = min(seq_len, ep_len - s)
+                sequences.append({k: v[s : s + chunk_len] for k, v in ep.items()})
+                lengths.append(chunk_len)
+            start = stop + 1
+    S = len(sequences)
+    padded: Dict[str, np.ndarray] = {}
+    for k in local_data.keys():
+        sample_shape = sequences[0][k].shape[1:]
+        arr = np.zeros((seq_len, S, *sample_shape), dtype=np.float32)
+        for i, seq in enumerate(sequences):
+            arr[: lengths[i], i] = seq[k]
+        padded[k] = arr
+    mask = np.zeros((seq_len, S), dtype=np.float32)
+    for i, ln in enumerate(lengths):
+        mask[:ln, i] = 1.0
+    return padded, mask
+
+
+def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, writer=None) -> None:
+    """Greedy evaluation episode threading the recurrent state
+    (reference: ``ppo_recurrent/utils.py``)."""
+    env = make_env(cfg, None if cfg.seed is None else cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    key = jax.random.PRNGKey(cfg.seed or 0)
+    states = player.reset_states(1)
+    prev_actions = np.zeros((1, 1, int(sum(player.actions_dim))), dtype=np.float32)
+    while not done:
+        jobs = prepare_obs(fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        key, subkey = jax.random.split(key)
+        actions, _, _, states = player(params, jobs, jax.device_put(prev_actions), states, subkey, greedy=True)
+        if player.is_continuous:
+            real_actions = np.concatenate([np.asarray(a) for a in actions], axis=-1)
+        else:
+            real_actions = np.concatenate([np.asarray(a).argmax(axis=-1) for a in actions], axis=-1)
+        prev_actions = np.concatenate([np.asarray(a) for a in actions], axis=-1).reshape(1, 1, -1)
+        obs, reward, done, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and writer is not None:
+        writer.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.algos.ppo.utils import log_models_from_checkpoint as _ppo_impl
+
+    return _ppo_impl(fabric, env, cfg, state)

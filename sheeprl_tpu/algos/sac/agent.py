@@ -30,10 +30,32 @@ import numpy as np
 
 from sheeprl_tpu.models import MLP
 
-__all__ = ["SACActor", "SACCritic", "SACCriticEnsemble", "SACAgent", "SACPlayer", "build_agent"]
+__all__ = [
+    "SACActor",
+    "SACCritic",
+    "SACCriticEnsemble",
+    "SACAgent",
+    "SACPlayer",
+    "build_agent",
+    "squashed_gaussian_sample",
+]
 
 LOG_STD_MAX = 2.0
 LOG_STD_MIN = -5.0
+
+
+def squashed_gaussian_sample(
+    mean: jax.Array, std: jax.Array, scale: jax.Array, bias: jax.Array, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Reparameterized tanh-squashed Gaussian sample rescaled to the action
+    bounds, with its log-prob (Eq. 26 of arXiv:1812.05905; reference:
+    ``agent.py:106-143``). Shared by the SAC family."""
+    x = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+    y = jnp.tanh(x)
+    action = y * scale + bias
+    log_prob = -0.5 * (((x - mean) / std) ** 2 + 2.0 * jnp.log(std) + jnp.log(2.0 * jnp.pi))
+    log_prob = log_prob - jnp.log(scale * (1.0 - y**2) + 1e-6)
+    return action, log_prob.sum(-1, keepdims=True)
 
 
 class SACActor(nn.Module):
@@ -122,17 +144,10 @@ class SACAgent:
     def sample_action(
         self, actor_params, obs: jax.Array, key: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:
-        """Reparameterized tanh-squashed sample with its log-prob
-        (Eq. 26 of the SAC paper; reference: ``agent.py:106-143``)."""
         mean, std = self.actor_dist(actor_params, obs)
         scale = jnp.asarray(self.action_scale, dtype=mean.dtype)
         bias = jnp.asarray(self.action_bias, dtype=mean.dtype)
-        x = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
-        y = jnp.tanh(x)
-        action = y * scale + bias
-        log_prob = -0.5 * (((x - mean) / std) ** 2 + 2.0 * jnp.log(std) + jnp.log(2.0 * jnp.pi))
-        log_prob = log_prob - jnp.log(scale * (1.0 - y**2) + 1e-6)
-        return action, log_prob.sum(-1, keepdims=True)
+        return squashed_gaussian_sample(mean, std, scale, bias, key)
 
     def greedy_action(self, actor_params, obs: jax.Array) -> jax.Array:
         mean, _ = self.actor.apply(actor_params, obs)

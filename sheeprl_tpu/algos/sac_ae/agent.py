@@ -264,17 +264,14 @@ class SACAEAgent:
 
     # -- actor ---------------------------------------------------------------
     def sample_action(self, params, obs, key) -> Tuple[jax.Array, jax.Array]:
+        from sheeprl_tpu.algos.sac.agent import squashed_gaussian_sample
+
         feat = self.actor_features(params, obs)
         mean, log_std = self.actor.apply(params["actor"], feat)
         std = jnp.exp(log_std)
         scale = jnp.asarray(self.action_scale, dtype=mean.dtype)
         bias = jnp.asarray(self.action_bias, dtype=mean.dtype)
-        x = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
-        y = jnp.tanh(x)
-        action = y * scale + bias
-        log_prob = -0.5 * (((x - mean) / std) ** 2 + 2.0 * jnp.log(std) + jnp.log(2.0 * jnp.pi))
-        log_prob = log_prob - jnp.log(scale * (1.0 - y**2) + 1e-6)
-        return action, log_prob.sum(-1, keepdims=True)
+        return squashed_gaussian_sample(mean, std, scale, bias, key)
 
     def greedy_action(self, params, obs) -> jax.Array:
         feat = self.actor_features(params, obs)

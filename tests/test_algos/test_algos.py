@@ -135,6 +135,24 @@ def test_droq_dry_run(tmp_path, devices):
     run(_std_args(tmp_path, "droq", devices=devices, extra=SAC_FAST))
 
 
+PPO_REC_FAST = [
+    "algo.rollout_steps=8",
+    "algo.per_rank_sequence_length=4",
+    "algo.per_rank_num_batches=2",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_ppo_recurrent_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "ppo_recurrent", devices=devices, extra=PPO_REC_FAST))
+
+
+def test_ppo_recurrent_continuous(tmp_path):
+    run(_std_args(tmp_path, "ppo_recurrent", extra=PPO_REC_FAST + ["env.id=continuous_dummy"]))
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
