@@ -1,0 +1,836 @@
+"""Dreamer-V3 agent (reference: ``sheeprl/algos/dreamer_v3/agent.py``).
+
+TPU-first structure:
+
+- every network is a flax module; the RSSM is a frozen dataclass of modules
+  plus *pure single-step functions* (``dynamic``/``imagination``) designed to
+  be the body of a ``lax.scan`` — the reference's Python time loops
+  (``dreamer_v3.py:131-145, 234-240``) become two compiled scans;
+- the learnable initial recurrent state is a plain parameter in the world
+  model params tree (reference: ``agent.py:382-389``);
+- the player is the same params applied with batch-shaped inputs — the
+  reference's deep-copied, weight-tied player modules (``agent.py:1225-1236``)
+  are unnecessary in functional JAX;
+- Hafner's initialization (truncated-normal + scaled-uniform output heads,
+  reference ``utils.py:141-188``) is applied by post-init param surgery in
+  :func:`build_agent`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.distributions import (
+    BernoulliSafeMode,
+    Independent,
+    Normal,
+    OneHotCategoricalStraightThrough,
+)
+from sheeprl_tpu.models import MLP, LayerNormGRUCell
+from sheeprl_tpu.models.blocks import _ConvTranspose
+from sheeprl_tpu.ops import symlog
+
+__all__ = [
+    "CNNEncoder",
+    "MLPEncoder",
+    "Encoder",
+    "CNNDecoder",
+    "MLPDecoder",
+    "RecurrentModel",
+    "RSSM",
+    "Actor",
+    "PlayerDV3",
+    "WorldModel",
+    "build_agent",
+    "sample_stochastic",
+    "actor_sample",
+    "actor_dists",
+]
+
+
+class CNNEncoder(nn.Module):
+    """4-stage stride-2 conv encoder, LayerNorm (channel-last) + SiLU per
+    stage, flattened output (reference: ``agent.py:42-99``)."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    stages: int = 4
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)  # (..., H, W, C)
+        lead = x.shape[:-3]
+        x = x.reshape(-1, *x.shape[-3:])
+        for i in range(self.stages):
+            x = nn.Conv(
+                (2**i) * self.channels_multiplier,
+                kernel_size=(4, 4),
+                strides=(2, 2),
+                padding=((1, 1), (1, 1)),
+                use_bias=False,
+                dtype=self.dtype,
+                name=f"conv_{i}",
+            )(x)
+            x = nn.LayerNorm(epsilon=1e-3, dtype=self.dtype, name=f"ln_{i}")(x)
+            x = nn.silu(x)
+        return x.reshape(*lead, -1)
+
+
+class MLPEncoder(nn.Module):
+    """Symlog-squashed vector encoder (reference: ``agent.py:100-152``)."""
+
+    keys: Sequence[str]
+    mlp_layers: int = 4
+    dense_units: int = 512
+    symlog_inputs: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class Encoder(nn.Module):
+    """Multi-modal encoder concatenating CNN and MLP features."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels_multiplier: int
+    mlp_layers: int
+    dense_units: int
+    stages: int = 4
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        parts = []
+        if self.cnn_keys:
+            parts.append(
+                CNNEncoder(
+                    keys=self.cnn_keys,
+                    channels_multiplier=self.cnn_channels_multiplier,
+                    stages=self.stages,
+                    dtype=self.dtype,
+                    name="cnn_encoder",
+                )(obs)
+            )
+        if self.mlp_keys:
+            parts.append(
+                MLPEncoder(
+                    keys=self.mlp_keys,
+                    mlp_layers=self.mlp_layers,
+                    dense_units=self.dense_units,
+                    dtype=self.dtype,
+                    name="mlp_encoder",
+                )(obs)
+            )
+        return jnp.concatenate(parts, axis=-1)
+
+
+class CNNDecoder(nn.Module):
+    """Inverse of :class:`CNNEncoder`: linear projection to a 4×4 feature map
+    then ``stages`` stride-2 transposed convs (reference: ``agent.py:154-227``).
+    Returns one tensor per key, split on channels."""
+
+    keys: Sequence[str]
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    cnn_encoder_output_dim: int
+    stages: int = 4
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        lead = latent.shape[:-1]
+        x = nn.Dense(self.cnn_encoder_output_dim, dtype=self.dtype, name="fc")(latent)
+        x = x.reshape(-1, 4, 4, self.cnn_encoder_output_dim // 16)
+        hidden = [(2**i) * self.channels_multiplier for i in reversed(range(self.stages - 1))]
+        for i, ch in enumerate(hidden):
+            x = _ConvTranspose(
+                features=ch,
+                kernel_size=(4, 4),
+                strides=(2, 2),
+                padding=1,
+                use_bias=False,
+                dtype=self.dtype,
+                name=f"deconv_{i}",
+            )(x)
+            x = nn.LayerNorm(epsilon=1e-3, dtype=self.dtype, name=f"ln_{i}")(x)
+            x = nn.silu(x)
+        x = _ConvTranspose(
+            features=int(sum(self.output_channels)),
+            kernel_size=(4, 4),
+            strides=(2, 2),
+            padding=1,
+            dtype=self.dtype,
+            name="out",
+        )(x)
+        x = x.reshape(*lead, *x.shape[1:])
+        splits = np.cumsum(np.asarray(self.output_channels[:-1], dtype=np.int64)).tolist()
+        parts = jnp.split(x, splits, axis=-1) if len(self.keys) > 1 else [x]
+        return {k: p for k, p in zip(self.keys, parts)}
+
+
+class MLPDecoder(nn.Module):
+    """Inverse of :class:`MLPEncoder` with per-key linear heads
+    (reference: ``agent.py:229-279``)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 512
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            dtype=self.dtype,
+            name="model",
+        )(latent)
+        return {
+            k: nn.Dense(int(d), dtype=self.dtype, name=f"head_{i}")(x)
+            for i, (k, d) in enumerate(zip(self.keys, self.output_dims))
+        }
+
+
+class RecurrentModel(nn.Module):
+    """MLP + LayerNorm-GRU sequence cell (reference: ``agent.py:281-342``)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = MLP(
+            hidden_sizes=(self.dense_units,),
+            activation="silu",
+            layer_norm=True,
+            dtype=self.dtype,
+            name="mlp",
+        )(x)
+        h, _ = LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size,
+            use_bias=False,
+            layer_norm=True,
+            dtype=self.dtype,
+            name="rnn",
+        )(recurrent_state, feat)
+        return h
+
+
+class _StochHead(nn.Module):
+    """One-hidden-layer MLP emitting stochastic-state logits (used by both
+    the transition and representation models)."""
+
+    hidden_size: int
+    stoch_state_size: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = MLP(
+            hidden_sizes=(self.hidden_size,),
+            activation="silu",
+            layer_norm=True,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return nn.Dense(self.stoch_state_size, dtype=self.dtype, name="out")(x)
+
+
+class _PredictionHead(nn.Module):
+    """MLP + linear head (reward / continue / critic share this shape)."""
+
+    output_dim: int
+    mlp_layers: int
+    dense_units: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="out")(x)
+
+
+def _unimix(logits: jax.Array, discrete: int, unimix: float) -> jax.Array:
+    """1% uniform mixing of the stochastic-state categoricals
+    (reference: ``agent.py:437-450``). In/out: flat ``(..., S*D)``."""
+    logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    if unimix > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        uniform = jnp.ones_like(probs) / discrete
+        probs = (1 - unimix) * probs + unimix * uniform
+        logits = jnp.log(probs)
+    return logits.reshape(*logits.shape[:-2], -1)
+
+
+def sample_stochastic(logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True) -> jax.Array:
+    """Straight-through sample (or mode) of the grouped categoricals; flat
+    ``(..., S*D)`` in and out (reference ``compute_stochastic_state``)."""
+    grouped = logits.reshape(*logits.shape[:-1], -1, discrete)
+    dist = OneHotCategoricalStraightThrough(logits=grouped)
+    out = dist.rsample(key) if sample else dist.mode
+    return out.reshape(*out.shape[:-2], -1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RSSM:
+    """Pure single-step RSSM ops over the world-model params tree
+    (reference: ``agent.py:344-594``, incl. the ``DecoupledRSSM`` variant
+    selected via ``decoupled``: the representation model then conditions on
+    the embedded observation only). Every method is scan-body ready."""
+
+    recurrent_model: RecurrentModel
+    representation_model: _StochHead
+    transition_model: _StochHead
+    discrete: int = 32
+    unimix: float = 0.01
+    decoupled: bool = False
+    learnable_initial_state: bool = True
+
+    def get_initial_states(self, wmp, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        init = wmp["initial_recurrent_state"]
+        if not self.learnable_initial_state:
+            init = jax.lax.stop_gradient(init)
+        rec = jnp.tanh(init)
+        rec = jnp.broadcast_to(rec, (*batch_shape, rec.shape[-1]))
+        logits, post = self._transition(wmp, rec, sample_state=False)
+        return rec, post
+
+    def _representation(self, wmp, recurrent_state, embedded_obs, key) -> Tuple[jax.Array, jax.Array]:
+        if self.decoupled:
+            inputs = embedded_obs  # reference DecoupledRSSM._representation (agent.py:582-594)
+        else:
+            inputs = jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        logits = self.representation_model.apply(wmp["representation_model"], inputs)
+        logits = _unimix(logits, self.discrete, self.unimix)
+        return logits, sample_stochastic(logits, self.discrete, key)
+
+    def _transition(self, wmp, recurrent_out, key=None, sample_state: bool = True) -> Tuple[jax.Array, jax.Array]:
+        logits = self.transition_model.apply(wmp["transition_model"], recurrent_out)
+        logits = _unimix(logits, self.discrete, self.unimix)
+        return logits, sample_stochastic(logits, self.discrete, key, sample=sample_state)
+
+    def dynamic(
+        self, wmp, posterior, recurrent_state, action, embedded_obs, is_first, key
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """One dynamic-learning step (reference: ``agent.py:396-436``).
+        All tensors are batch-shaped ``(B, ...)``; ``posterior`` flat."""
+        k_post = key
+        action = (1 - is_first) * action
+        init_rec, init_post = self.get_initial_states(wmp, recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
+        posterior = (1 - is_first) * posterior + is_first * init_post
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_logits = self.transition_model.apply(wmp["transition_model"], recurrent_state)
+        prior_logits = _unimix(prior_logits, self.discrete, self.unimix)
+        posterior_logits, posterior = self._representation(wmp, recurrent_state, embedded_obs, k_post)
+        return recurrent_state, posterior, posterior_logits, prior_logits
+
+    def dynamic_decoupled(
+        self, wmp, posterior, recurrent_state, action, is_first
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Decoupled dynamic step: the posterior is precomputed from the
+        observations alone; only the recurrent state and the prior advance
+        (reference DecoupledRSSM.dynamic, ``agent.py:542-581``)."""
+        action = (1 - is_first) * action
+        init_rec, init_post = self.get_initial_states(wmp, recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
+        posterior = (1 - is_first) * posterior + is_first * init_post
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_logits = self.transition_model.apply(wmp["transition_model"], recurrent_state)
+        prior_logits = _unimix(prior_logits, self.discrete, self.unimix)
+        return recurrent_state, prior_logits
+
+    def imagination(self, wmp, prior, recurrent_state, actions, key) -> Tuple[jax.Array, jax.Array]:
+        """One latent imagination step (reference: ``agent.py:482-500``)."""
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([prior, actions], axis=-1), recurrent_state
+        )
+        _, imagined_prior = self._transition(wmp, recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldModel:
+    """Module bundle + RSSM; all learnables live in one ``world_model`` params
+    tree with keys matching the module names below."""
+
+    encoder: Encoder
+    rssm: RSSM
+    observation_model: Any  # dict {"cnn": CNNDecoder|None, "mlp": MLPDecoder|None}
+    reward_model: _PredictionHead
+    continue_model: _PredictionHead
+
+    def decode(self, wmp, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.observation_model["cnn"] is not None:
+            out.update(self.observation_model["cnn"].apply(wmp["cnn_decoder"], latent))
+        if self.observation_model["mlp"] is not None:
+            out.update(self.observation_model["mlp"].apply(wmp["mlp_decoder"], latent))
+        return out
+
+
+class Actor(nn.Module):
+    """Task actor emitting per-head logits (discrete) or mean/std parameters
+    (continuous) (reference: ``agent.py:694-847``)."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str  # "discrete" | "scaled_normal" | "normal" | "tanh_normal"
+    dense_units: int = 1024
+    mlp_layers: int = 5
+    init_std: float = 0.0
+    min_std: float = 0.1
+    max_std: float = 1.0
+    unimix: float = 0.01
+    action_clip: float = 1.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> List[jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            dtype=self.dtype,
+            name="model",
+        )(state)
+        if self.is_continuous:
+            return [nn.Dense(int(np.sum(self.actions_dim)) * 2, dtype=self.dtype, name="head_0")(x)]
+        return [nn.Dense(int(d), dtype=self.dtype, name=f"head_{i}")(x) for i, d in enumerate(self.actions_dim)]
+
+
+def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
+    """Build the action distributions from the actor outputs."""
+    from sheeprl_tpu.distributions import TanhNormal
+
+    if actor.is_continuous:
+        mean, std = jnp.split(pre_dist[0], 2, axis=-1)
+        if actor.distribution == "scaled_normal":
+            std = (actor.max_std - actor.min_std) * jax.nn.sigmoid(std + actor.init_std) + actor.min_std
+            return [Independent(Normal(jnp.tanh(mean), std), 1)]
+        if actor.distribution == "normal":
+            return [Independent(Normal(mean, std), 1)]
+        # tanh_normal: tanh-squashed Gaussian with the log-det-Jacobian in
+        # log_prob (reference: agent.py:805-810)
+        mean = 5 * jnp.tanh(mean / 5)
+        std = jax.nn.softplus(std + actor.init_std) + actor.min_std
+        return [Independent(TanhNormal(mean, std), 1)]
+
+    dists = []
+    for logits in pre_dist:
+        if actor.unimix > 0.0:
+            probs = jax.nn.softmax(logits, axis=-1)
+            uniform = jnp.ones_like(probs) / probs.shape[-1]
+            probs = (1 - actor.unimix) * probs + actor.unimix * uniform
+            logits = jnp.log(probs)
+        dists.append(OneHotCategoricalStraightThrough(logits=logits))
+    return dists
+
+
+def actor_sample(
+    actor: Actor, actor_params, state: jax.Array, key: jax.Array, greedy: bool = False
+) -> Tuple[List[jax.Array], List[Any]]:
+    """Sample (reparameterized / straight-through) actions from the actor
+    (reference: ``agent.py:783-846``)."""
+    pre_dist = actor.apply(actor_params, state)
+    dists = actor_dists(actor, pre_dist)
+    actions: List[jax.Array] = []
+    if actor.is_continuous:
+        d = dists[0]
+        act = d.mode if greedy else d.rsample(key)
+        if actor.action_clip > 0.0:
+            clip = jnp.full_like(act, actor.action_clip)
+            act = act * jax.lax.stop_gradient(clip / jnp.maximum(clip, jnp.abs(act)))
+        actions.append(act)
+    else:
+        keys = jax.random.split(key, len(dists))
+        for d, k in zip(dists, keys):
+            actions.append(d.mode if greedy else d.rsample(k))
+    return actions, dists
+
+
+class PlayerDV3:
+    """Host-side stateful player carrying ``(actions, recurrent, stochastic)``
+    per env (reference: ``agent.py:596-693``)."""
+
+    def __init__(
+        self,
+        world_model: WorldModel,
+        actor: Actor,
+        actions_dim: Sequence[int],
+        num_envs: int,
+        stochastic_size: int,
+        recurrent_state_size: int,
+        discrete_size: int = 32,
+        actor_type: Optional[str] = None,
+    ):
+        self.world_model = world_model
+        self.actor = actor
+        self.actions_dim = actions_dim
+        self.num_envs = num_envs
+        self.stochastic_size = stochastic_size
+        self.recurrent_state_size = recurrent_state_size
+        self.discrete_size = discrete_size
+        self.actor_type = actor_type
+        self.is_continuous = actor.is_continuous
+        self.actions = None
+        self.recurrent_state = None
+        self.stochastic_state = None
+
+        rssm = world_model.rssm
+        encoder = world_model.encoder
+
+        def _init(params, n):
+            rec, post = rssm.get_initial_states(params["world_model"], (n,))
+            return rec, post
+
+        def _step(params, obs, actions, rec, stoch, key, greedy):
+            wmp = params["world_model"]
+            emb = encoder.apply(wmp["encoder"], obs)
+            rec = rssm.recurrent_model.apply(
+                wmp["recurrent_model"], jnp.concatenate([stoch, actions], axis=-1), rec
+            )
+            k_repr, k_act = jax.random.split(key)
+            _, stoch = rssm._representation(wmp, rec, emb, k_repr)
+            acts, _ = actor_sample(actor, params["actor"], jnp.concatenate([stoch, rec], axis=-1), k_act, greedy)
+            return acts, jnp.concatenate(acts, axis=-1), rec, stoch
+
+        self._init_fn = jax.jit(_init, static_argnums=(1,))
+        self._step_fn = jax.jit(_step, static_argnums=(6,))
+
+    def init_states(self, params, reset_envs: Optional[Sequence[int]] = None) -> None:
+        if reset_envs is None or len(reset_envs) == 0:
+            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32)
+            self.recurrent_state, self.stochastic_state = self._init_fn(params, self.num_envs)
+        else:
+            idx = jnp.asarray(list(reset_envs))
+            rec, post = self._init_fn(params, len(reset_envs))
+            self.actions = self.actions.at[idx].set(0.0)
+            self.recurrent_state = self.recurrent_state.at[idx].set(rec)
+            self.stochastic_state = self.stochastic_state.at[idx].set(post)
+
+    def get_actions(self, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
+        acts, self.actions, self.recurrent_state, self.stochastic_state = self._step_fn(
+            params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy
+        )
+        return acts
+
+
+# -- initialization (reference: utils.py:141-188) ----------------------------
+
+
+def _fan_in_out(shape: Sequence[int]) -> Tuple[float, float]:
+    if len(shape) == 2:  # Dense kernel (in, out)
+        return float(shape[0]), float(shape[1])
+    # Conv kernel (kh, kw, in, out)
+    space = float(np.prod(shape[:-2]))
+    return space * shape[-2], space * shape[-1]
+
+
+def hafner_trunc_normal_init(params: Any, key: jax.Array) -> Any:
+    """Re-initialize every Dense/Conv kernel with Hafner's truncated normal
+    and zero every bias (reference ``init_weights``)."""
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    keys = jax.random.split(key, len(leaves))
+
+    def init_leaf(path, leaf, k):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "kernel" and leaf.ndim >= 2:
+            fan_in, fan_out = _fan_in_out(leaf.shape)
+            scale = 1.0 / ((fan_in + fan_out) / 2.0)
+            std = np.sqrt(scale) / 0.87962566103423978
+            return std * jax.random.truncated_normal(k, -2.0, 2.0, leaf.shape, dtype=leaf.dtype)
+        if name == "bias":
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    flat = {jax.tree_util.keystr(p): init_leaf(p, l, k) for (p, l), k in zip(leaves, keys)}
+    return jax.tree_util.tree_map_with_path(lambda p, l: flat[jax.tree_util.keystr(p)], params)
+
+
+def uniform_output_init(params: Any, key: jax.Array, given_scale: float) -> Any:
+    """Re-initialize Dense kernels in a (sub)tree with Hafner's scaled
+    uniform (reference ``uniform_init_weights``)."""
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    keys = jax.random.split(key, len(leaves))
+
+    def init_leaf(path, leaf, k):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "kernel" and leaf.ndim >= 2:
+            fan_in, fan_out = _fan_in_out(leaf.shape)
+            scale = given_scale / ((fan_in + fan_out) / 2.0)
+            limit = np.sqrt(3 * scale)
+            return jax.random.uniform(k, leaf.shape, dtype=leaf.dtype, minval=-limit, maxval=limit)
+        if name == "bias":
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    flat = {jax.tree_util.keystr(p): init_leaf(p, l, k) for (p, l), k in zip(leaves, keys)}
+    return jax.tree_util.tree_map_with_path(lambda p, l: flat[jax.tree_util.keystr(p)], params)
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[WorldModel, Actor, _PredictionHead, Dict[str, Any], PlayerDV3]:
+    """Create modules + the params tree ``{world_model, actor, critic,
+    target_critic}`` (reference: ``agent.py:935-1236``)."""
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    dtype = fabric.precision.compute_dtype
+
+    recurrent_state_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    stochastic_size = int(wm_cfg.stochastic_size)
+    discrete_size = int(wm_cfg.discrete_size)
+    stoch_state_size = stochastic_size * discrete_size
+    latent_state_size = stoch_state_size + recurrent_state_size
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4))
+    screen = int(cfg.env.screen_size)
+    cnn_channels = [int(np.prod(obs_space[k].shape[2:] or (1,))) for k in cnn_keys]  # NHWC channels
+    mlp_dims = [int(np.prod(obs_space[k].shape)) for k in mlp_keys]
+    cnn_encoder_output_dim = (
+        (2 ** (cnn_stages - 1)) * int(wm_cfg.encoder.cnn_channels_multiplier) * 4 * 4 if cnn_keys else 0
+    )
+
+    encoder = Encoder(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
+        mlp_layers=int(wm_cfg.encoder.mlp_layers),
+        dense_units=int(wm_cfg.encoder.dense_units),
+        stages=cnn_stages,
+        dtype=dtype,
+    )
+    encoder_output_dim = (cnn_encoder_output_dim if cnn_keys else 0) + (
+        int(wm_cfg.encoder.dense_units) if mlp_keys else 0
+    )
+
+    recurrent_model = RecurrentModel(
+        recurrent_state_size=recurrent_state_size,
+        dense_units=int(wm_cfg.recurrent_model.dense_units),
+        dtype=dtype,
+    )
+    representation_model = _StochHead(
+        hidden_size=int(wm_cfg.representation_model.hidden_size), stoch_state_size=stoch_state_size, dtype=dtype
+    )
+    transition_model = _StochHead(
+        hidden_size=int(wm_cfg.transition_model.hidden_size), stoch_state_size=stoch_state_size, dtype=dtype
+    )
+    decoupled_rssm = bool(wm_cfg.decoupled_rssm)
+    rssm = RSSM(
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        discrete=discrete_size,
+        unimix=float(cfg.algo.unimix),
+        decoupled=decoupled_rssm,
+        learnable_initial_state=bool(wm_cfg.learnable_initial_recurrent_state),
+    )
+    cnn_decoder = (
+        CNNDecoder(
+            keys=tuple(cfg.algo.cnn_keys.decoder),
+            output_channels=tuple(cnn_channels),
+            channels_multiplier=int(wm_cfg.observation_model.cnn_channels_multiplier),
+            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            stages=cnn_stages,
+            dtype=dtype,
+        )
+        if cfg.algo.cnn_keys.decoder
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoder(
+            keys=tuple(cfg.algo.mlp_keys.decoder),
+            output_dims=tuple(mlp_dims),
+            mlp_layers=int(wm_cfg.observation_model.mlp_layers),
+            dense_units=int(wm_cfg.observation_model.dense_units),
+            dtype=dtype,
+        )
+        if cfg.algo.mlp_keys.decoder
+        else None
+    )
+    reward_model = _PredictionHead(
+        output_dim=int(wm_cfg.reward_model.bins),
+        mlp_layers=int(wm_cfg.reward_model.mlp_layers),
+        dense_units=int(wm_cfg.reward_model.dense_units),
+        dtype=dtype,
+    )
+    continue_model = _PredictionHead(
+        output_dim=1,
+        mlp_layers=int(wm_cfg.discount_model.mlp_layers),
+        dense_units=int(wm_cfg.discount_model.dense_units),
+        dtype=dtype,
+    )
+    world_model = WorldModel(
+        encoder=encoder,
+        rssm=rssm,
+        observation_model={"cnn": cnn_decoder, "mlp": mlp_decoder},
+        reward_model=reward_model,
+        continue_model=continue_model,
+    )
+
+    actor = Actor(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        distribution=(
+            cfg.distribution.get("type", "auto").lower()
+            if cfg.distribution.get("type", "auto").lower() != "auto"
+            else ("scaled_normal" if is_continuous else "discrete")
+        ),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        max_std=float(actor_cfg.get("max_std", 1.0)),
+        unimix=float(cfg.algo.unimix),
+        action_clip=float(actor_cfg.action_clip),
+        dtype=dtype,
+    )
+    critic = _PredictionHead(
+        output_dim=int(critic_cfg.bins),
+        mlp_layers=int(critic_cfg.mlp_layers),
+        dense_units=int(critic_cfg.dense_units),
+        dtype=dtype,
+    )
+
+    # -- init ----------------------------------------------------------------
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 12)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, screen, screen, ch), dtype=jnp.float32)
+    for k, d in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, d), dtype=jnp.float32)
+    dummy_latent = jnp.zeros((1, latent_state_size), dtype=jnp.float32)
+    dummy_rec = jnp.zeros((1, recurrent_state_size), dtype=jnp.float32)
+
+    wmp: Dict[str, Any] = {
+        "encoder": encoder.init(keys[0], dummy_obs),
+        "recurrent_model": recurrent_model.init(
+            keys[1], jnp.zeros((1, stoch_state_size + int(np.sum(actions_dim))), dtype=jnp.float32), dummy_rec
+        ),
+        "representation_model": representation_model.init(
+            keys[2],
+            jnp.zeros(
+                (1, encoder_output_dim + (0 if decoupled_rssm else recurrent_state_size)), dtype=jnp.float32
+            ),
+        ),
+        "transition_model": transition_model.init(keys[3], dummy_rec),
+        "reward_model": reward_model.init(keys[4], dummy_latent),
+        "continue_model": continue_model.init(keys[5], dummy_latent),
+        "initial_recurrent_state": jnp.zeros((recurrent_state_size,), dtype=jnp.float32),
+    }
+    if cnn_decoder is not None:
+        wmp["cnn_decoder"] = cnn_decoder.init(keys[6], dummy_latent)
+    if mlp_decoder is not None:
+        wmp["mlp_decoder"] = mlp_decoder.init(keys[7], dummy_latent)
+    actor_params = actor.init(keys[8], dummy_latent)
+    critic_params = critic.init(keys[9], dummy_latent)
+
+    if cfg.algo.hafner_initialization:
+        init_keys = jax.random.split(keys[10], 12)
+        for i, name in enumerate(
+            ["encoder", "recurrent_model", "representation_model", "transition_model", "reward_model", "continue_model"]
+        ):
+            wmp[name] = hafner_trunc_normal_init(wmp[name], init_keys[i])
+        if cnn_decoder is not None:
+            wmp["cnn_decoder"] = hafner_trunc_normal_init(wmp["cnn_decoder"], init_keys[6])
+        if mlp_decoder is not None:
+            wmp["mlp_decoder"] = hafner_trunc_normal_init(wmp["mlp_decoder"], init_keys[7])
+        actor_params = hafner_trunc_normal_init(actor_params, init_keys[8])
+        critic_params = hafner_trunc_normal_init(critic_params, init_keys[9])
+
+        # scaled-uniform output heads (reference: agent.py:1170-1180)
+        u_keys = jax.random.split(keys[11], 10)
+        p = wmp["transition_model"]["params"]
+        p["out"] = uniform_output_init({"out": p["out"]}, u_keys[0], 1.0)["out"]
+        p = wmp["representation_model"]["params"]
+        p["out"] = uniform_output_init({"out": p["out"]}, u_keys[1], 1.0)["out"]
+        p = wmp["reward_model"]["params"]
+        p["out"] = uniform_output_init({"out": p["out"]}, u_keys[2], 0.0)["out"]
+        p = wmp["continue_model"]["params"]
+        p["out"] = uniform_output_init({"out": p["out"]}, u_keys[3], 1.0)["out"]
+        cp = critic_params["params"]
+        cp["out"] = uniform_output_init({"out": cp["out"]}, u_keys[4], 0.0)["out"]
+        ap = actor_params["params"]
+        for i, hk in enumerate([k for k in ap.keys() if k.startswith("head_")]):
+            ap[hk] = uniform_output_init({hk: ap[hk]}, u_keys[5 + i % 5], 1.0)[hk]
+        if mlp_decoder is not None:
+            dp = wmp["mlp_decoder"]["params"]
+            for i, hk in enumerate([k for k in dp.keys() if k.startswith("head_")]):
+                dp[hk] = uniform_output_init({hk: dp[hk]}, u_keys[5 + i % 5], 1.0)[hk]
+        if cnn_decoder is not None:
+            dp = wmp["cnn_decoder"]["params"]
+            dp["out"] = uniform_output_init({"out": dp["out"]}, u_keys[9], 1.0)["out"]
+
+    params = {
+        "world_model": wmp,
+        "actor": actor_params,
+        "critic": critic_params,
+    }
+    if world_model_state is not None:
+        params["world_model"] = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), params["world_model"], world_model_state
+        )
+    if actor_state is not None:
+        params["actor"] = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["actor"], actor_state)
+    if critic_state is not None:
+        params["critic"] = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["critic"], critic_state)
+    params["target_critic"] = (
+        jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["critic"], target_critic_state)
+        if target_critic_state is not None
+        else jax.tree.map(jnp.copy, params["critic"])
+    )
+    params = fabric.put_replicated(params)
+
+    player = PlayerDV3(
+        world_model,
+        actor,
+        actions_dim,
+        cfg.env.num_envs,
+        stochastic_size,
+        recurrent_state_size,
+        discrete_size=discrete_size,
+    )
+    return world_model, actor, critic, params, player

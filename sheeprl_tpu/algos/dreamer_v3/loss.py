@@ -1,0 +1,57 @@
+"""Dreamer-V3 world-model loss (reference: ``sheeprl/algos/dreamer_v3/loss.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.distributions import Independent, OneHotCategoricalStraightThrough, kl_divergence
+
+__all__ = ["reconstruction_loss"]
+
+
+def reconstruction_loss(
+    po: Dict[str, Any],
+    observations: Dict[str, jax.Array],
+    pr: Any,
+    rewards: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Any] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Eq. 5 of arXiv:2301.04104 with KL balancing and free nats
+    (reference: ``loss.py:9-88``). Logits shaped ``(..., S, D)``."""
+    observation_loss = -sum(po[k].log_prob(observations[k]) for k in po.keys())
+    reward_loss = -pr.log_prob(rewards)
+    dyn_loss = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=jax.lax.stop_gradient(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    dyn_loss = kl_dynamic * jnp.maximum(dyn_loss, kl_free_nats)
+    repr_loss = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=jax.lax.stop_gradient(priors_logits)), 1),
+    )
+    repr_loss = kl_representation * jnp.maximum(repr_loss, kl_free_nats)
+    kl_loss = dyn_loss + repr_loss
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = jnp.mean(kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss)
+    return (
+        rec_loss,
+        kl.mean(),
+        kl_loss.mean(),
+        reward_loss.mean(),
+        observation_loss.mean(),
+        continue_loss.mean(),
+    )

@@ -1,0 +1,131 @@
+"""Dreamer-V3 helpers (reference: ``sheeprl/algos/dreamer_v3/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def init_moments(max_: float = 1e8) -> Dict[str, jax.Array]:
+    """Initial state of the distributed-percentile return normalizer
+    (reference ``Moments``, ``utils.py:40-63``)."""
+    return {"low": jnp.zeros((), jnp.float32), "high": jnp.zeros((), jnp.float32)}
+
+
+def moments_update(
+    state: Dict[str, jax.Array],
+    x: jax.Array,
+    decay: float = 0.99,
+    max_: float = 1e8,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+    axis_name: Optional[str] = None,
+) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+    """EMA of the 5th/95th percentile of the lambda-returns across all
+    devices; returns ``(new_state, offset, invscale)``. Gathers over
+    ``axis_name`` first, matching the reference's ``fabric.all_gather``
+    (``utils.py:56-62``)."""
+    x = jax.lax.stop_gradient(x).astype(jnp.float32)
+    if axis_name is not None:
+        x = jax.lax.all_gather(x, axis_name)
+    x = x.reshape(-1)
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return {"low": new_low, "high": new_high}, new_low, invscale
+
+
+def compute_lambda_values(
+    rewards: jax.Array, values: jax.Array, continues: jax.Array, lmbda: float = 0.95
+) -> jax.Array:
+    """TD(lambda) returns as a reverse ``lax.scan``
+    (reference: ``utils.py:66-78``). All inputs ``(H, B, 1)``."""
+    interm = rewards + continues * values * (1 - lmbda)
+
+    def body(nxt, xs):
+        inter_t, cont_t = xs
+        val = inter_t + cont_t * lmbda * nxt
+        return val, val
+
+    _, vals = jax.lax.scan(body, values[-1], (interm, continues), reverse=True)
+    return vals
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
+) -> Dict[str, jax.Array]:
+    """Batch-shaped ``(num_envs, ...)`` float32 device arrays; pixels NHWC in
+    [-0.5, 0.5] (reference: ``utils.py:81-92`` — the reference keeps a time
+    axis of 1, the functional player here is batch-shaped)."""
+    out = {}
+    for k, v in obs.items():
+        v = np.asarray(v, dtype=np.float32)
+        if k in cnn_keys:
+            v = v.reshape(num_envs, *v.shape[-3:]) / 255.0 - 0.5
+        else:
+            v = v.reshape(num_envs, -1)
+        out[k] = jax.device_put(v)
+    return out
+
+
+def test(
+    player, params, fabric, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True, writer=None
+) -> None:
+    """Evaluation episode with the stateful player
+    (reference: ``utils.py:95-139``)."""
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    saved_num_envs = player.num_envs
+    player.num_envs = 1
+    player.init_states(params)
+    key = jax.random.PRNGKey(cfg.seed or 0)
+    while not done:
+        jobs = prepare_obs(fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        key, subkey = jax.random.split(key)
+        real_actions = player.get_actions(params, jobs, subkey, greedy=greedy)
+        if player.is_continuous:
+            real_actions = np.concatenate([np.asarray(a) for a in real_actions], axis=-1)
+        else:
+            real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in real_actions], axis=-1)
+        obs, reward, done, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = done or truncated or cfg.dry_run
+        cumulative_rew += reward
+    print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and writer is not None:
+        writer.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    player.num_envs = saved_num_envs
+    env.close()
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
+
+    return log_state_dicts_from_checkpoint(
+        cfg, state, models=("world_model", "actor", "critic", "target_critic", "moments")
+    )

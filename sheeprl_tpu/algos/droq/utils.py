@@ -17,17 +17,6 @@ MODELS_TO_REGISTER = {"agent"}
 
 
 def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
-    import jax
-    import mlflow
-    import numpy as np
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
 
-    from sheeprl_tpu.algos.droq.agent import build_agent
-
-    _, params, _ = build_agent(fabric, cfg, env.observation_space, env.action_space, state["agent"])
-    model_info = {}
-    with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
-        model_info["agent"] = mlflow.log_dict(
-            jax.tree.map(lambda x: np.asarray(x).tolist(), state["agent"]), "agent_params.json"
-        )
-        mlflow.log_dict(dict(cfg.to_log), "config.json")
-    return model_info
+    return log_state_dicts_from_checkpoint(cfg, state, models=("agent",))

@@ -148,13 +148,7 @@ class RecurrentPPOAgent(nn.Module):
         return actor_outs, values, states
 
 
-def _dists(actor_outs: List[jax.Array], is_continuous: bool):
-    from sheeprl_tpu.distributions import Independent, Normal, OneHotCategorical
-
-    if is_continuous:
-        mean, log_std = jnp.split(actor_outs[0], 2, axis=-1)
-        return [Independent(Normal(mean, jnp.exp(log_std)), 1)]
-    return [OneHotCategorical(logits=lo) for lo in actor_outs]
+from sheeprl_tpu.algos.ppo.agent import _dists  # noqa: E402  (shared with PPO)
 
 
 def forward_with_actions(

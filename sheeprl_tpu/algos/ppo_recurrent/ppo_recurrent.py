@@ -376,6 +376,10 @@ def main(fabric, cfg: Dict[str, Any]):
             }
             mask = np.concatenate([mask, np.zeros((seq_len, S_pad - S), dtype=mask.dtype)], axis=1)
         padded["mask"] = mask
+        # only the first row of the stored recurrent state restarts each
+        # sequence — drop the rest before shipping to device
+        padded["prev_hx"] = padded["prev_hx"][:1]
+        padded["prev_cx"] = padded["prev_cx"][:1]
         seq_data = {k: jax.device_put(v, data_sharding) for k, v in padded.items()}
 
         s_local = S_pad // fabric.world_size

@@ -53,17 +53,6 @@ from sheeprl_tpu.utils.mlflow import log_models  # noqa: E402  (shared registry 
 
 
 def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
-    if not _IS_MLFLOW_AVAILABLE:
-        raise ModuleNotFoundError("mlflow is not installed")
-    import mlflow
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
 
-    from sheeprl_tpu.algos.sac.agent import build_agent
-
-    _, params, _ = build_agent(fabric, cfg, env.observation_space, env.action_space, state["agent"])
-    model_info = {}
-    with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
-        model_info["agent"] = mlflow.log_dict(
-            jax.tree.map(lambda x: np.asarray(x).tolist(), state["agent"]), "agent_params.json"
-        )
-        mlflow.log_dict(dict(cfg.to_log), "config.json")
-    return model_info
+    return log_state_dicts_from_checkpoint(cfg, state, models=("agent",))

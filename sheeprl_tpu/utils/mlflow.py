@@ -62,6 +62,24 @@ def log_models(cfg, models_to_log, run_id, experiment_id=None, run_name=None):  
     return model_info
 
 
+def log_state_dicts_from_checkpoint(cfg, state: Dict[str, Any], models: tuple = ("agent",)):  # pragma: no cover
+    """Log checkpointed param pytrees to a nested mlflow run (shared by the
+    per-algorithm ``log_models_from_checkpoint`` hooks — each reference algo
+    re-implements this, e.g. ``sheeprl/algos/sac/utils.py:103-140``)."""
+    import jax
+    import numpy as np
+
+    mlflow = _require_mlflow()
+    model_info = {}
+    with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
+        for name in models:
+            model_info[name] = mlflow.log_dict(
+                jax.tree.map(lambda x: np.asarray(x).tolist(), state[name]), f"{name}.json"
+            )
+        mlflow.log_dict(dict(cfg.to_log), "config.json")
+    return model_info
+
+
 def register_model(fabric, log_models_fn: Callable, cfg: Dict[str, Any], models_to_log: Dict[str, Any]):  # pragma: no cover
     mlflow = _require_mlflow()
     tracking_uri = cfg.get("logger", {}).get("tracking_uri")
