@@ -197,6 +197,27 @@ class PPOPlayer:
         self._greedy = jax.jit(lambda p, o, k: sample_actions(agent, p, o, k, greedy=True))
         self._values = jax.jit(lambda p, o: agent.apply(p, o)[1])
 
+        def _rollout_step(params, key, obs):
+            """One fused env-loop dispatch: sample, plus everything the host
+            loop would otherwise compute from the samples (env-format actions,
+            concatenated buffer actions, next key). Keeping the PRNG key as a
+            carried device array removes the per-step host ``random.split``
+            (the round-1 hot-loop bottleneck, see VERDICT.md)."""
+            key, subkey = jax.random.split(key)
+            acts, logprob, values = sample_actions(agent, params, obs, subkey)
+            if agent.is_continuous:
+                env_actions = jnp.concatenate(acts, axis=-1)
+                buf_actions = env_actions
+            else:
+                env_actions = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)
+                buf_actions = jnp.concatenate(acts, axis=-1)
+            return key, env_actions, buf_actions, logprob, values
+
+        self._rollout_step = jax.jit(_rollout_step)
+
+    def rollout_step(self, params, key, obs):
+        return self._rollout_step(params, key, obs)
+
     def __call__(self, params, obs: Dict[str, jax.Array], key: jax.Array):
         return self._forward(params, obs, key)
 

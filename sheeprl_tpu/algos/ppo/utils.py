@@ -24,9 +24,15 @@ def normalize_obs(
 
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
-) -> Dict[str, jax.Array]:
-    """Host numpy obs → normalized float32 device arrays shaped
-    ``(num_envs, ...)`` (reference: ``utils.py:25-37``, NHWC here)."""
+) -> Dict[str, np.ndarray]:
+    """Host numpy obs → normalized float32 arrays shaped ``(num_envs, ...)``
+    (reference: ``utils.py:25-37``, NHWC here).
+
+    Deliberately returns *host* arrays: callers feed them straight into jitted
+    player fns, whose placement follows the (committed) params. An explicit
+    ``device_put`` here would commit every step's obs to the default device —
+    a per-step round-trip when the rollout runs on a different backend than
+    JAX's default (e.g. CPU rollout with a tunneled TPU visible)."""
     out = {}
     for k in obs.keys():
         v = np.asarray(obs[k], dtype=np.float32)
@@ -36,7 +42,7 @@ def prepare_obs(
         else:
             v = v.reshape(num_envs, -1)
         out[k] = v
-    return {k: jax.device_put(v) for k, v in out.items()}
+    return out
 
 
 def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, writer=None) -> None:

@@ -87,7 +87,15 @@ class Fabric:
         mesh_shape: Optional[Sequence[int]] = None,
         callbacks: Optional[Sequence[Any]] = None,
     ) -> None:
-        all_devices = jax.devices()
+        # ``accelerator: cpu`` pins the mesh to host CPU devices — the
+        # reference benchmark configs run on CPU (``fabric.accelerator: cpu``
+        # in sheeprl/configs/exp/ppo_benchmarks.yaml) and, for tiny models,
+        # per-step device round-trips dwarf the compute; anything else defers
+        # to JAX's default platform (TPU when present).
+        if str(accelerator).lower() == "cpu":
+            all_devices = jax.devices("cpu")
+        else:
+            all_devices = jax.devices()
         if devices in ("auto", None, -1):
             n = len(all_devices)
         else:
@@ -127,6 +135,17 @@ class Fabric:
     def device(self) -> jax.Device:
         return self.devices[0]
 
+    @property
+    def local_device(self) -> jax.Device:
+        """First mesh device addressable by THIS process (multi-host meshes
+        contain devices of every host; a non-local default device would fail
+        placement on ranks > 0)."""
+        pid = jax.process_index()
+        for d in self.devices:
+            if d.process_index == pid:
+                return d
+        return self.devices[0]  # pragma: no cover - single-host always matches
+
     # -- rng -----------------------------------------------------------------
     def seed_everything(self, seed: int) -> jax.Array:
         """Seed python/numpy and return the root PRNG key
@@ -165,8 +184,14 @@ class Fabric:
         Unlike Lightning there is no process spawning: JAX multi-host runs are
         started externally (one process per host; ``jax.distributed`` is
         initialized by :func:`sheeprl_tpu.parallel.distributed.maybe_init`).
+
+        The ``default_device`` context pins every *uncommitted* computation
+        (scalar ``jnp.asarray``, jitted fns fed plain numpy, …) to this
+        fabric's platform. Without it, a CPU-fabric run on a host with a
+        remote accelerator visible silently routes stray ops through the
+        accelerator — a ~100 ms round-trip per op when the chip is tunneled.
         """
-        with self.mesh:
+        with jax.default_device(self.local_device), self.mesh:
             return fn(self, *args, **kwargs)
 
     # -- host-side collectives (control plane) -------------------------------
