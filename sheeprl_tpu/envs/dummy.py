@@ -1,10 +1,16 @@
-"""Deterministic fake envs for the test suite
-(reference: ``sheeprl/envs/dummy.py:8-108``). Images are channel-last."""
+"""Deterministic fake envs for the test suite — same capability as the
+reference's dummies (``sheeprl/envs/dummy.py``): a step-counter-valued dict
+(or flat) observation space with one env per action-space kind. Re-designed
+as a single configurable env; the per-action-space classes are thin shells.
+
+Observation semantics: every value equals the current step counter (pixels
+mod 256), so buffer/wrapper tests can assert exact contents. Episodes
+terminate after ``n_steps`` steps. Images are channel-last ``(H, W, C)``.
+"""
 
 from __future__ import annotations
 
-from abc import ABC, abstractmethod
-from typing import Dict, List, Tuple
+from typing import List, Tuple
 
 import gymnasium as gym
 import numpy as np
@@ -12,17 +18,20 @@ import numpy as np
 __all__ = ["ContinuousDummyEnv", "DiscreteDummyEnv", "MultiDiscreteDummyEnv"]
 
 
-class BaseDummyEnv(gym.Env, ABC):
-    @abstractmethod
+class _CounterEnv(gym.Env):
+    """Env whose observations are the step counter broadcast into each space."""
+
     def __init__(
         self,
-        image_size: Tuple[int, int, int] = (64, 64, 3),
-        n_steps: int = 128,
-        vector_shape: Tuple[int] = (10,),
-        dict_obs_space: bool = True,
+        action_space: gym.Space,
+        image_size: Tuple[int, int, int],
+        vector_shape: Tuple[int, ...],
+        n_steps: int,
+        dict_obs_space: bool,
     ):
+        self.action_space = action_space
         self._dict_obs_space = dict_obs_space
-        if self._dict_obs_space:
+        if dict_obs_space:
             self.observation_space = gym.spaces.Dict(
                 {
                     "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
@@ -32,25 +41,26 @@ class BaseDummyEnv(gym.Env, ABC):
         else:
             self.observation_space = gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32)
         self.reward_range = (-np.inf, np.inf)
-        self._current_step = 0
         self._n_steps = n_steps
+        self._t = 0
+
+    def _observe(self):
+        if not self._dict_obs_space:
+            return np.full(self.observation_space.shape, self._t, dtype=np.float32)
+        spaces = self.observation_space.spaces
+        return {
+            "rgb": np.full(spaces["rgb"].shape, self._t % 256, dtype=np.uint8),
+            "state": np.full(spaces["state"].shape, self._t, dtype=np.float32),
+        }
 
     def step(self, action):
-        done = self._current_step == self._n_steps
-        self._current_step += 1
-        return self.get_obs(), 0.0, done, False, {}
-
-    def get_obs(self):
-        if self._dict_obs_space:
-            return {
-                "rgb": np.full(self.observation_space["rgb"].shape, self._current_step % 256, dtype=np.uint8),
-                "state": np.full(self.observation_space["state"].shape, self._current_step, dtype=np.float32),
-            }
-        return np.full(self.observation_space.shape, self._current_step, dtype=np.float32)
+        terminated = self._t == self._n_steps
+        self._t += 1
+        return self._observe(), 0.0, terminated, False, {}
 
     def reset(self, seed=None, options=None):
-        self._current_step = 0
-        return self.get_obs(), {}
+        self._t = 0
+        return self._observe(), {}
 
     def render(self):
         return np.zeros((64, 64, 3), dtype=np.uint8)
@@ -59,40 +69,39 @@ class BaseDummyEnv(gym.Env, ABC):
         pass
 
 
-class ContinuousDummyEnv(BaseDummyEnv):
+class ContinuousDummyEnv(_CounterEnv):
     def __init__(
         self,
         image_size: Tuple[int, int, int] = (64, 64, 3),
         n_steps: int = 128,
-        vector_shape: Tuple[int] = (10,),
+        vector_shape: Tuple[int, ...] = (10,),
         action_dim: int = 2,
         dict_obs_space: bool = True,
     ):
-        self.action_space = gym.spaces.Box(-1.0, 1.0, shape=(action_dim,))
-        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape, dict_obs_space=dict_obs_space)
+        super().__init__(
+            gym.spaces.Box(-1.0, 1.0, shape=(action_dim,)), image_size, vector_shape, n_steps, dict_obs_space
+        )
 
 
-class DiscreteDummyEnv(BaseDummyEnv):
+class DiscreteDummyEnv(_CounterEnv):
     def __init__(
         self,
         image_size: Tuple[int, int, int] = (64, 64, 3),
         n_steps: int = 4,
-        vector_shape: Tuple[int] = (10,),
+        vector_shape: Tuple[int, ...] = (10,),
         action_dim: int = 2,
         dict_obs_space: bool = True,
     ):
-        self.action_space = gym.spaces.Discrete(action_dim)
-        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape, dict_obs_space=dict_obs_space)
+        super().__init__(gym.spaces.Discrete(action_dim), image_size, vector_shape, n_steps, dict_obs_space)
 
 
-class MultiDiscreteDummyEnv(BaseDummyEnv):
+class MultiDiscreteDummyEnv(_CounterEnv):
     def __init__(
         self,
         image_size: Tuple[int, int, int] = (64, 64, 3),
         n_steps: int = 128,
-        vector_shape: Tuple[int] = (10,),
+        vector_shape: Tuple[int, ...] = (10,),
         action_dims: List[int] = [2, 2],
         dict_obs_space: bool = True,
     ):
-        self.action_space = gym.spaces.MultiDiscrete(action_dims)
-        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape, dict_obs_space=dict_obs_space)
+        super().__init__(gym.spaces.MultiDiscrete(action_dims), image_size, vector_shape, n_steps, dict_obs_space)

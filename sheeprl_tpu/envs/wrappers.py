@@ -1,9 +1,16 @@
-"""Environment wrappers (reference: ``sheeprl/envs/wrappers.py:13-342``).
+"""Environment wrappers — capability parity with ``sheeprl/envs/wrappers.py``
+(MaskVelocity, ActionRepeat, RestartOnException, FrameStack,
+RewardAsObservation, GrayscaleRender, ActionsAsObservation), re-designed
+around two shared primitives:
 
-Same capability set as the reference with one layout change: images are
-**channel-last (H, W, C)** throughout — the TPU/XLA-native conv layout — and
-:class:`FrameStack` therefore stacks along the channel axis, producing
-``(H, W, C * num_stack)`` instead of the reference's ``(num_stack, C, H, W)``.
+- :class:`DilatedDeque` — a bounded history that yields every ``dilation``-th
+  entry, backing both frame stacking and action stacking;
+- :func:`encode_action` — one-hot / passthrough encoding of env actions into
+  flat float32 vectors, shared by the action-stack observation.
+
+Images are **channel-last (H, W, C)** throughout — the TPU/XLA conv layout —
+so stacked frames are ``(H, W, C * num_stack)`` rather than the reference's
+``(num_stack, C, H, W)``.
 """
 
 from __future__ import annotations
@@ -11,12 +18,13 @@ from __future__ import annotations
 import copy
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence, SupportsFloat, Tuple, Union
+from typing import Any, Callable, Dict, List, Sequence, Tuple, Union
 
 import gymnasium as gym
 import numpy as np
 
 __all__ = [
+    "DilatedDeque",
     "MaskVelocityWrapper",
     "ActionRepeat",
     "RestartOnException",
@@ -27,67 +35,123 @@ __all__ = [
 ]
 
 
-class MaskVelocityWrapper(gym.ObservationWrapper):
-    """Mask velocity entries of classic-control observations to make the MDP
-    partially observable (reference: ``wrappers.py:13-46``)."""
+class DilatedDeque:
+    """Fixed-capacity history of ``size * dilation`` entries whose snapshot is
+    every ``dilation``-th element (oldest→newest), concatenated on the last
+    axis. ``fill`` primes the whole history with one value (episode reset)."""
 
-    velocity_indices = {
-        "CartPole-v0": np.array([1, 3]),
-        "CartPole-v1": np.array([1, 3]),
-        "MountainCar-v0": np.array([1]),
-        "MountainCarContinuous-v0": np.array([1]),
-        "Pendulum-v1": np.array([2]),
-        "LunarLander-v2": np.array([2, 3, 5]),
-        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
-        "LunarLander-v3": np.array([2, 3, 5]),
-    }
+    def __init__(self, size: int, dilation: int = 1):
+        if size < 1:
+            raise ValueError(f"history size must be >= 1, got {size}")
+        if dilation < 1:
+            raise ValueError(f"dilation must be >= 1, got {dilation}")
+        self.size = size
+        self.dilation = dilation
+        self._buf: deque = deque(maxlen=size * dilation)
+
+    def push(self, item: np.ndarray) -> None:
+        self._buf.append(item)
+
+    def fill(self, item: np.ndarray) -> None:
+        self._buf.clear()
+        self._buf.extend([item] * self._buf.maxlen)
+
+    def pad_with_last(self) -> None:
+        """Re-prime the history with its newest entry (episode-boundary flush
+        without a reset, e.g. DIAMBRA round transitions)."""
+        self.fill(self._buf[-1])
+
+    def snapshot(self) -> np.ndarray:
+        picked = [self._buf[i] for i in range(self.dilation - 1, len(self._buf), self.dilation)]
+        if len(picked) != self.size:
+            raise RuntimeError(f"history holds {len(picked)} strided entries, expected {self.size}")
+        return np.concatenate(picked, axis=-1)
+
+
+def encode_action(action: Any, space: gym.Space) -> np.ndarray:
+    """Flat float32 encoding of an action: identity for Box, one-hot for
+    Discrete, concatenated one-hots for MultiDiscrete."""
+    if isinstance(space, gym.spaces.Box):
+        return np.asarray(action, dtype=np.float32).reshape(-1)
+    if isinstance(space, gym.spaces.Discrete):
+        vec = np.zeros(int(space.n), dtype=np.float32)
+        vec[int(np.asarray(action).item())] = 1.0
+        return vec
+    if isinstance(space, gym.spaces.MultiDiscrete):
+        parts = []
+        for a, n in zip(np.asarray(action).reshape(-1), space.nvec):
+            part = np.zeros(int(n), dtype=np.float32)
+            part[int(a)] = 1.0
+            parts.append(part)
+        return np.concatenate(parts)
+    raise ValueError(f"Unsupported action space for encoding: {type(space)}")
+
+
+# Velocity components of the classic-control state vectors, by env id.
+_VELOCITY_SLOTS: Dict[str, Tuple[int, ...]] = {
+    "CartPole-v0": (1, 3),
+    "CartPole-v1": (1, 3),
+    "MountainCar-v0": (1,),
+    "MountainCarContinuous-v0": (1,),
+    "Pendulum-v1": (2,),
+    "LunarLander-v2": (2, 3, 5),
+    "LunarLanderContinuous-v2": (2, 3, 5),
+    "LunarLander-v3": (2, 3, 5),
+}
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out the velocity entries of classic-control observations, making
+    the MDP partially observable (capability of reference ``wrappers.py:13``)."""
 
     def __init__(self, env: gym.Env):
         super().__init__(env)
-        assert env.unwrapped.spec is not None
-        env_id: str = env.unwrapped.spec.id
-        self.mask = np.ones_like(env.observation_space.sample())
-        try:
-            self.mask[self.velocity_indices[env_id]] = 0.0
-        except KeyError as e:
-            raise NotImplementedError(f"Velocity masking not implemented for {env_id}") from e
+        spec = env.unwrapped.spec
+        if spec is None or spec.id not in _VELOCITY_SLOTS:
+            name = None if spec is None else spec.id
+            raise NotImplementedError(f"Velocity masking not implemented for {name}")
+        self.mask = np.ones(env.observation_space.shape, dtype=np.float32)
+        self.mask[list(_VELOCITY_SLOTS[spec.id])] = 0.0
 
     def observation(self, observation: np.ndarray) -> np.ndarray:
         return observation * self.mask
 
 
 class ActionRepeat(gym.Wrapper):
-    """Repeat the action ``amount`` times, summing rewards
-    (reference: ``wrappers.py:48-73``)."""
+    """Apply each action ``amount`` times, accumulating reward and stopping
+    early on termination (capability of reference ``wrappers.py:48``)."""
 
     def __init__(self, env: gym.Env, amount: int = 1):
         super().__init__(env)
         if amount <= 0:
             raise ValueError("`amount` should be a positive integer")
-        self._amount = amount
+        self._amount = int(amount)
 
     @property
     def action_repeat(self) -> int:
         return self._amount
 
     def step(self, action):
-        done = False
-        truncated = False
-        current_step = 0
-        total_reward = 0.0
-        obs, info = None, {}
-        while current_step < self._amount and not (done or truncated):
+        total = 0.0
+        obs, reward, done, truncated, info = self.env.step(action)
+        total += reward
+        for _ in range(self._amount - 1):
+            if done or truncated:
+                break
             obs, reward, done, truncated, info = self.env.step(action)
-            total_reward += reward
-            current_step += 1
-        return obs, total_reward, done, truncated, info
+            total += reward
+        return obs, total, done, truncated, info
 
 
 class RestartOnException(gym.Wrapper):
-    """Rebuild a crashed env in place and signal via
-    ``info["restart_on_exception"]`` (reference: ``wrappers.py:74-125``) —
-    the framework's failure-detection/recovery mechanism, used by the
-    Dreamer-V3 family to patch the buffer with a truncation."""
+    """Failure detection/recovery: when the wrapped env raises, build a fresh
+    instance in place and surface ``info["restart_on_exception"] = True`` so
+    the training loop can patch its buffer with a truncation (capability of
+    reference ``wrappers.py:74``; consumed by the Dreamer-V3 family).
+
+    A sliding ``window`` (seconds) bounds the tolerated failure rate: more
+    than ``maxfails`` crashes inside one window aborts the run.
+    """
 
     def __init__(
         self,
@@ -97,246 +161,200 @@ class RestartOnException(gym.Wrapper):
         maxfails: int = 2,
         wait: float = 20,
     ):
-        if not isinstance(exceptions, (tuple, list)):
-            exceptions = [exceptions]
         self._env_fn = env_fn
-        self._exceptions = tuple(exceptions)
+        self._exceptions = tuple(exceptions) if isinstance(exceptions, (tuple, list)) else (exceptions,)
         self._window = window
         self._maxfails = maxfails
         self._wait = wait
-        self._last = time.time()
-        self._fails = 0
-        super().__init__(self._env_fn())
+        self._window_start = time.time()
+        self._fail_count = 0
+        super().__init__(env_fn())
 
-    def _register_fail(self, e: Exception, phase: str) -> None:
-        if time.time() > self._last + self._window:
-            self._last = time.time()
-            self._fails = 1
-        else:
-            self._fails += 1
-        if self._fails > self._maxfails:
-            raise RuntimeError(f"The env crashed too many times: {self._fails}")
-        gym.logger.warn(f"{phase} - Restarting env after crash with {type(e).__name__}: {e}")
+    def _recover(self, exc: Exception, phase: str) -> None:
+        now = time.time()
+        if now - self._window_start > self._window:
+            self._window_start = now
+            self._fail_count = 0
+        self._fail_count += 1
+        if self._fail_count > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fail_count}")
+        gym.logger.warn(f"{phase} - Restarting env after crash with {type(exc).__name__}: {exc}")
         time.sleep(self._wait)
+        self.env = self._env_fn()
 
-    def step(self, action) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+    def step(self, action):
         try:
             return self.env.step(action)
-        except self._exceptions as e:
-            self._register_fail(e, "STEP")
-            self.env = self._env_fn()
-            new_obs, info = self.env.reset()
-            info.update({"restart_on_exception": True})
-            return new_obs, 0.0, False, False, info
+        except self._exceptions as exc:
+            self._recover(exc, "STEP")
+            obs, info = self.env.reset()
+            return obs, 0.0, False, False, {**info, "restart_on_exception": True}
 
-    def reset(self, *, seed=None, options=None) -> Tuple[Any, Dict[str, Any]]:
+    def reset(self, *, seed=None, options=None):
         try:
             return self.env.reset(seed=seed, options=options)
-        except self._exceptions as e:
-            self._register_fail(e, "RESET")
-            self.env = self._env_fn()
-            new_obs, info = self.env.reset(seed=seed, options=options)
-            info.update({"restart_on_exception": True})
-            return new_obs, info
+        except self._exceptions as exc:
+            self._recover(exc, "RESET")
+            obs, info = self.env.reset(seed=seed, options=options)
+            return obs, {**info, "restart_on_exception": True}
+
+
+def _is_diambra_episode_flush(info: Dict[str, Any], done: bool) -> bool:
+    """DIAMBRA signals round/stage/game transitions through info instead of
+    ``done``; the frame history must be re-primed there so stacks never span
+    a boundary."""
+    if info.get("env_domain") != "DIAMBRA":
+        return False
+    flags = ("round_done", "stage_done", "game_done")
+    if not all(f in info for f in flags):
+        return False
+    return any(info[f] for f in flags) and not done
 
 
 class FrameStack(gym.Wrapper):
-    """Stack the last ``num_stack`` frames of each pixel key along the channel
-    axis, with optional dilation (reference: ``wrappers.py:126-184``).
-
-    Output per key is ``(H, W, C * num_stack)`` — channel-last.
-    """
+    """Stack the last ``num_stack`` (optionally dilated) frames of each pixel
+    key along the channel axis → ``(H, W, C * num_stack)`` (capability of
+    reference ``wrappers.py:126``, channel-last here)."""
 
     def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1) -> None:
         super().__init__(env)
         if num_stack <= 0:
             raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
-        if not isinstance(env.observation_space, gym.spaces.Dict):
-            raise RuntimeError(
-                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
-            )
-        self._num_stack = num_stack
-        self._dilation = dilation
-        self._cnn_keys = []
-        self.observation_space = copy.deepcopy(self.env.observation_space)
-        for k, v in self.env.observation_space.spaces.items():
-            if cnn_keys and k in cnn_keys and len(v.shape) == 3:
-                self._cnn_keys.append(k)
-                h, w, c = v.shape
-                self.observation_space[k] = gym.spaces.Box(
-                    np.repeat(v.low, num_stack, axis=-1),
-                    np.repeat(v.high, num_stack, axis=-1),
-                    (h, w, c * num_stack),
-                    v.dtype,
-                )
-        if len(self._cnn_keys) == 0:
+        space = env.observation_space
+        if not isinstance(space, gym.spaces.Dict):
+            raise RuntimeError(f"Expected an observation space of type gym.spaces.Dict, got: {type(space)}")
+        stackable = [k for k, v in space.spaces.items() if k in (cnn_keys or ()) and len(v.shape) == 3]
+        if not stackable:
             raise RuntimeError("Specify at least one valid cnn key to be stacked")
-        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
-
-    def _get_obs(self, key: str) -> np.ndarray:
-        frames_subset = list(self._frames[key])[self._dilation - 1 :: self._dilation]
-        assert len(frames_subset) == self._num_stack
-        return np.concatenate(frames_subset, axis=-1)
+        self._histories = {k: DilatedDeque(num_stack, dilation) for k in stackable}
+        self.observation_space = copy.deepcopy(space)
+        for k in stackable:
+            v = space[k]
+            self.observation_space[k] = gym.spaces.Box(
+                np.repeat(v.low, num_stack, axis=-1),
+                np.repeat(v.high, num_stack, axis=-1),
+                (*v.shape[:-1], v.shape[-1] * num_stack),
+                v.dtype,
+            )
 
     def step(self, action):
-        obs, reward, done, truncated, infos = self.env.step(action)
-        for k in self._cnn_keys:
-            self._frames[k].append(obs[k])
-            if (
-                "env_domain" in infos
-                and infos["env_domain"] == "DIAMBRA"
-                and len({"round_done", "stage_done", "game_done"}.intersection(infos.keys())) == 3
-                and (infos["round_done"] or infos["stage_done"] or infos["game_done"])
-                and not (done or truncated)
-            ):
-                for _ in range(self._num_stack * self._dilation - 1):
-                    self._frames[k].append(obs[k])
-            obs[k] = self._get_obs(k)
-        return obs, reward, done, truncated, infos
+        obs, reward, done, truncated, info = self.env.step(action)
+        flush = _is_diambra_episode_flush(info, done or truncated)
+        for k, hist in self._histories.items():
+            hist.push(obs[k])
+            if flush:
+                hist.pad_with_last()
+            obs[k] = hist.snapshot()
+        return obs, reward, done, truncated, info
 
     def reset(self, *, seed=None, options=None, **kwargs):
-        obs, infos = self.env.reset(seed=seed, **kwargs)
-        for k in self._cnn_keys:
-            self._frames[k].clear()
-            for _ in range(self._num_stack * self._dilation):
-                self._frames[k].append(obs[k])
-            obs[k] = self._get_obs(k)
-        return obs, infos
+        obs, info = self.env.reset(seed=seed, **kwargs)
+        for k, hist in self._histories.items():
+            hist.fill(obs[k])
+            obs[k] = hist.snapshot()
+        return obs, info
 
 
 class RewardAsObservationWrapper(gym.Wrapper):
-    """Expose the last reward as a ``reward`` observation key
-    (reference: ``wrappers.py:185-243``)."""
+    """Feed the last reward back as a ``reward`` observation key; non-dict
+    spaces are dict-ified with the original obs under ``obs`` (capability of
+    reference ``wrappers.py:185``)."""
 
     def __init__(self, env: gym.Env) -> None:
         super().__init__(env)
-        reward_range = getattr(self.env, "reward_range", None) or (-np.inf, np.inf)
-        if isinstance(self.env.observation_space, gym.spaces.Dict):
-            self.observation_space = gym.spaces.Dict(
-                {
-                    "reward": gym.spaces.Box(*reward_range, (1,), np.float32),
-                    **{k: v for k, v in self.env.observation_space.items()},
-                }
-            )
+        low, high = getattr(self.env, "reward_range", None) or (-np.inf, np.inf)
+        reward_box = gym.spaces.Box(low, high, (1,), np.float32)
+        inner = self.env.observation_space
+        if isinstance(inner, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict({"reward": reward_box, **dict(inner.items())})
         else:
-            self.observation_space = gym.spaces.Dict(
-                {"obs": self.env.observation_space, "reward": gym.spaces.Box(*reward_range, (1,), np.float32)}
-            )
+            self.observation_space = gym.spaces.Dict({"obs": inner, "reward": reward_box})
 
-    def _convert_obs(self, obs: Any, reward: Union[float, np.ndarray]) -> Dict[str, Any]:
-        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
-        if isinstance(obs, dict):
-            obs["reward"] = reward_obs
-        else:
-            obs = {"obs": obs, "reward": reward_obs}
-        return obs
+    def _attach(self, obs: Any, reward: Any) -> Dict[str, Any]:
+        out = obs if isinstance(obs, dict) else {"obs": obs}
+        out["reward"] = np.asarray(reward, dtype=np.float32).reshape(-1)
+        return out
 
     def step(self, action):
-        obs, reward, done, truncated, infos = self.env.step(action)
-        return self._convert_obs(obs, copy.deepcopy(reward)), reward, done, truncated, infos
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._attach(obs, copy.deepcopy(reward)), reward, done, truncated, info
 
     def reset(self, *, seed=None, options=None):
-        obs, infos = self.env.reset(seed=seed, options=options)
-        return self._convert_obs(obs, 0), infos
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._attach(obs, 0.0), info
 
 
 class GrayscaleRenderWrapper(gym.Wrapper):
-    """Promote 2-D render frames to 3-channel so video encoders accept them
-    (reference: ``wrappers.py:244-257``)."""
+    """Promote 2-D / single-channel render frames to HxWx3 so video encoders
+    accept them (capability of reference ``wrappers.py:244``)."""
 
     def render(self):
         frame = super().render()
         if isinstance(frame, np.ndarray):
-            if len(frame.shape) == 2:
+            if frame.ndim == 2:
                 frame = frame[..., np.newaxis]
-            if len(frame.shape) == 3 and frame.shape[-1] == 1:
-                frame = frame.repeat(3, axis=-1)
+            if frame.ndim == 3 and frame.shape[-1] == 1:
+                frame = np.repeat(frame, 3, axis=-1)
         return frame
 
 
 class ActionsAsObservationWrapper(gym.Wrapper):
-    """Expose the last ``num_stack`` executed actions as an ``action_stack``
-    observation key (reference: ``wrappers.py:258-342``)."""
+    """Expose the last ``num_stack`` executed actions (one-hot / raw for
+    continuous) as a flat ``action_stack`` observation key (capability of
+    reference ``wrappers.py:258``)."""
 
     def __init__(self, env: gym.Env, num_stack: int, noop: Union[float, int, List[int]], dilation: int = 1):
         super().__init__(env)
         if num_stack < 1:
             raise ValueError(
-                f"The number of actions to the `action_stack` observation must be greater or equal than 1, got: {num_stack}"
+                f"The number of actions to the `action_stack` observation must be greater or equal than 1, "
+                f"got: {num_stack}"
             )
         if dilation < 1:
             raise ValueError(f"The actions stack dilation argument must be greater than zero, got: {dilation}")
         if not isinstance(noop, (int, float, list)):
             raise ValueError(f"The noop action must be an integer or float or list, got: {noop} ({type(noop)})")
-        self._num_stack = num_stack
-        self._dilation = dilation
-        self._actions = deque(maxlen=num_stack * dilation)
-        self._is_continuous = isinstance(self.env.action_space, gym.spaces.Box)
-        self._is_multidiscrete = isinstance(self.env.action_space, gym.spaces.MultiDiscrete)
+        space = self.env.action_space
+        self._validate_noop(noop, space)
+        if isinstance(space, gym.spaces.Box):
+            self._noop_vec = np.full((space.shape[0],), noop, dtype=np.float32)
+        else:
+            self._noop_vec = encode_action(noop, space)
+        self._history = DilatedDeque(num_stack, dilation)
+        dim = self._noop_vec.shape[0]
+        if isinstance(space, gym.spaces.Box):
+            low = np.resize(space.low, dim * num_stack)
+            high = np.resize(space.high, dim * num_stack)
+        else:
+            low, high = 0.0, 1.0
         self.observation_space = copy.deepcopy(self.env.observation_space)
-        if self._is_continuous:
-            if isinstance(noop, list):
-                raise ValueError(f"The noop actions must be a float for continuous action spaces, got: {noop}")
-            self._action_shape = self.env.action_space.shape[0]
-            low = np.resize(self.env.action_space.low, self._action_shape * num_stack)
-            high = np.resize(self.env.action_space.high, self._action_shape * num_stack)
-        elif self._is_multidiscrete:
+        self.observation_space["action_stack"] = gym.spaces.Box(
+            low=low, high=high, shape=(dim * num_stack,), dtype=np.float32
+        )
+
+    @staticmethod
+    def _validate_noop(noop, space: gym.Space) -> None:
+        if isinstance(space, gym.spaces.Box) and isinstance(noop, list):
+            raise ValueError(f"The noop actions must be a float for continuous action spaces, got: {noop}")
+        if isinstance(space, gym.spaces.MultiDiscrete):
             if not isinstance(noop, list):
                 raise ValueError(f"The noop actions must be a list for multi-discrete action spaces, got: {noop}")
-            if len(self.env.action_space.nvec) != len(noop):
+            if len(space.nvec) != len(noop):
                 raise RuntimeError(
                     "The number of noop actions must equal the number of actions of the environment. "
-                    f"Got env_action_space = {self.env.action_space.nvec} and noop = {noop}"
+                    f"Got env_action_space = {space.nvec} and noop = {noop}"
                 )
-            low, high = 0, 1
-            self._action_shape = int(sum(self.env.action_space.nvec))
-        else:
-            if isinstance(noop, (list, float)):
-                raise ValueError(f"The noop actions must be an integer for discrete action spaces, got: {noop}")
-            low, high = 0, 1
-            self._action_shape = int(self.env.action_space.n)
-        self.observation_space["action_stack"] = gym.spaces.Box(
-            low=low, high=high, shape=(self._action_shape * num_stack,), dtype=np.float32
-        )
-        if self._is_continuous:
-            self.noop = np.full((self._action_shape,), noop, dtype=np.float32)
-        elif self._is_multidiscrete:
-            # (the reference indexes `noop[act]` here — wrappers.py:307 — which
-            # crashes for noop values >= len(noop); `act` is already the value)
-            noops = []
-            for act, n in zip(noop, self.env.action_space.nvec):
-                noops.append(np.zeros((n,), dtype=np.float32))
-                noops[-1][act] = 1.0
-            self.noop = np.concatenate(noops, axis=-1)
-        else:
-            self.noop = np.zeros((self._action_shape,), dtype=np.float32)
-            self.noop[noop] = 1.0
+        if isinstance(space, gym.spaces.Discrete) and isinstance(noop, (list, float)):
+            raise ValueError(f"The noop actions must be an integer for discrete action spaces, got: {noop}")
 
     def step(self, action):
-        if self._is_continuous:
-            self._actions.append(np.asarray(action, dtype=np.float32).reshape(-1))
-        elif self._is_multidiscrete:
-            one_hots = []
-            for act, n in zip(action, self.env.action_space.nvec):
-                one_hots.append(np.zeros((n,), dtype=np.float32))
-                one_hots[-1][act] = 1.0
-            self._actions.append(np.concatenate(one_hots, axis=-1))
-        else:
-            one_hot = np.zeros((self._action_shape,), dtype=np.float32)
-            one_hot[action] = 1.0
-            self._actions.append(one_hot)
+        self._history.push(encode_action(action, self.env.action_space))
         obs, reward, done, truncated, info = super().step(action)
-        obs["action_stack"] = self._get_actions_stack()
+        obs["action_stack"] = self._history.snapshot()
         return obs, reward, done, truncated, info
 
     def reset(self, *, seed=None, options=None):
         obs, info = super().reset(seed=seed, options=options)
-        self._actions.clear()
-        for _ in range(self._num_stack * self._dilation):
-            self._actions.append(self.noop)
-        obs["action_stack"] = self._get_actions_stack()
+        self._history.fill(self._noop_vec)
+        obs["action_stack"] = self._history.snapshot()
         return obs, info
-
-    def _get_actions_stack(self) -> np.ndarray:
-        actions_stack = list(self._actions)[self._dilation - 1 :: self._dilation]
-        return np.concatenate(actions_stack, axis=-1).astype(np.float32)

@@ -1,0 +1,190 @@
+"""Wrapper behavior tests (reference analogue: ``tests/test_envs/``)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    DilatedDeque,
+    FrameStack,
+    MaskVelocityWrapper,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+
+def test_dilated_deque_snapshot_strides():
+    dq = DilatedDeque(size=2, dilation=2)
+    for i in range(4):
+        dq.push(np.array([i]))
+    # entries [0,1,2,3], stride-2 picks indices 1,3
+    assert dq.snapshot().tolist() == [1, 3]
+    dq.fill(np.array([7]))
+    assert dq.snapshot().tolist() == [7, 7]
+
+
+def test_frame_stack_channel_last():
+    env = FrameStack(DiscreteDummyEnv(n_steps=16), num_stack=3, cnn_keys=["rgb"])
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (64, 64, 9)
+    assert env.observation_space["rgb"].shape == (64, 64, 9)
+    # reset primes the stack with copies of frame 0
+    assert (obs["rgb"] == 0).all()
+    obs, *_ = env.step(0)  # t becomes 1 → newest channel-block is 1
+    assert (obs["rgb"][..., :3] == 0).all() and (obs["rgb"][..., 6:] == 1).all()
+
+
+def test_frame_stack_dilation():
+    env = FrameStack(DiscreteDummyEnv(n_steps=64), num_stack=2, cnn_keys=["rgb"], dilation=2)
+    env.reset()
+    for t in (1, 2, 3, 4):
+        obs, *_ = env.step(0)
+    # history holds [1,2,3,4]; stride-2 snapshot = frames 2 and 4
+    assert (obs["rgb"][..., :3] == 2).all() and (obs["rgb"][..., 3:] == 4).all()
+
+
+def test_frame_stack_requires_cnn_keys():
+    with pytest.raises(RuntimeError, match="at least one valid cnn key"):
+        FrameStack(DiscreteDummyEnv(), num_stack=2, cnn_keys=[])
+
+
+def test_action_repeat_accumulates_and_stops_early():
+    class CountingEnv(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (1,))
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self.t = 0
+
+        def reset(self, seed=None, options=None):
+            self.t = 0
+            return np.zeros(1), {}
+
+        def step(self, action):
+            self.t += 1
+            return np.zeros(1), 1.0, self.t >= 5, False, {}
+
+    env = ActionRepeat(CountingEnv(), amount=3)
+    env.reset()
+    assert env.action_repeat == 3
+    _, reward, done, *_ = env.step(0)
+    assert reward == 3.0 and not done
+    env.step(0)  # t: 4,5 → terminates after 2 inner steps
+    assert env.env.t == 5
+
+
+def test_action_repeat_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        ActionRepeat(DiscreteDummyEnv(), amount=0)
+
+
+@pytest.mark.parametrize(
+    "env_fn, noop, expected_dim",
+    [
+        (lambda: DiscreteDummyEnv(action_dim=3), 0, 3),
+        (lambda: MultiDiscreteDummyEnv(action_dims=[2, 3]), [0, 0], 5),
+        (lambda: ContinuousDummyEnv(action_dim=2), 0.0, 2),
+    ],
+)
+def test_actions_as_observation_spaces(env_fn, noop, expected_dim):
+    env = ActionsAsObservationWrapper(env_fn(), num_stack=4, noop=noop)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (expected_dim * 4,)
+    assert env.observation_space["action_stack"].shape == (expected_dim * 4,)
+    action = env.action_space.sample()
+    obs, *_ = env.step(action)
+    assert obs["action_stack"].shape == (expected_dim * 4,)
+
+
+def test_actions_as_observation_one_hot_content():
+    env = ActionsAsObservationWrapper(DiscreteDummyEnv(action_dim=3), num_stack=2, noop=1)
+    obs, _ = env.reset()
+    # noop = action 1 → [0,1,0] twice
+    assert obs["action_stack"].tolist() == [0, 1, 0, 0, 1, 0]
+    obs, *_ = env.step(2)
+    assert obs["action_stack"].tolist() == [0, 1, 0, 0, 0, 1]
+
+
+def test_actions_as_observation_noop_validation():
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=2, noop=[0])
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(ContinuousDummyEnv(), num_stack=2, noop=[0.0])
+    with pytest.raises(RuntimeError):
+        ActionsAsObservationWrapper(MultiDiscreteDummyEnv(action_dims=[2, 2]), num_stack=2, noop=[0])
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=0, noop=0)
+
+
+def test_reward_as_observation_dict_and_flat():
+    env = RewardAsObservationWrapper(DiscreteDummyEnv())
+    obs, _ = env.reset()
+    assert obs["reward"].tolist() == [0.0]
+    obs, *_ = env.step(0)
+    assert "reward" in obs and obs["reward"].shape == (1,)
+
+    flat = RewardAsObservationWrapper(gym.make("CartPole-v1"))
+    obs, _ = flat.reset()
+    assert set(obs.keys()) == {"obs", "reward"}
+
+
+def test_mask_velocity():
+    env = MaskVelocityWrapper(gym.make("CartPole-v1"))
+    obs, _ = env.reset(seed=0)
+    assert obs[1] == 0.0 and obs[3] == 0.0
+
+    class NoSpec(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (4,))
+        action_space = gym.spaces.Discrete(2)
+
+    with pytest.raises(NotImplementedError):
+        MaskVelocityWrapper(NoSpec())
+
+
+def test_restart_on_exception_recovers_and_flags():
+    class Flaky(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (1,))
+        action_space = gym.spaces.Discrete(2)
+        crashes = 0
+
+        def reset(self, seed=None, options=None):
+            return np.zeros(1), {}
+
+        def step(self, action):
+            Flaky.crashes += 1
+            if Flaky.crashes == 1:
+                raise RuntimeError("boom")
+            return np.zeros(1), 1.0, False, False, {}
+
+    env = RestartOnException(lambda: Flaky(), wait=0.0, maxfails=3)
+    env.reset()
+    obs, reward, done, truncated, info = env.step(0)
+    assert info.get("restart_on_exception") is True
+    assert reward == 0.0 and not done and not truncated
+    # subsequent steps hit the healthy path
+    _, reward, _, _, info = env.step(0)
+    assert reward == 1.0 and "restart_on_exception" not in info
+
+
+def test_restart_on_exception_gives_up():
+    def make():
+        class AlwaysCrash(gym.Env):
+            observation_space = gym.spaces.Box(-1, 1, (1,))
+            action_space = gym.spaces.Discrete(2)
+
+            def reset(self, seed=None, options=None):
+                return np.zeros(1), {}
+
+            def step(self, action):
+                raise RuntimeError("boom")
+
+        return AlwaysCrash()
+
+    env = RestartOnException(make, wait=0.0, maxfails=1)
+    env.reset()
+    env.step(0)  # first crash tolerated
+    with pytest.raises(RuntimeError, match="crashed too many times"):
+        env.step(0)
