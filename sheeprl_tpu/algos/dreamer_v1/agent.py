@@ -1,0 +1,420 @@
+"""Dreamer-V1 agent (reference: ``sheeprl/algos/dreamer_v1/agent.py``).
+
+Architecture deltas vs V2 (whose conv encoder/decoder and prediction heads
+are reused directly — V1 is the same Hafner conv stack without LayerNorm):
+
+- CONTINUOUS Gaussian latent: the transition/representation heads emit
+  ``2 * stochastic_size`` (mean, raw std); std = softplus(raw) + min_std
+  (reference ``utils.compute_stochastic_state``);
+- a plain GRU recurrent cell (no LayerNorm; reference ``agent.py:31-61``);
+- no ``is_first`` handling in ``dynamic`` (V1 predates it);
+- the actor is the V2 actor with ``tanh_normal`` as the continuous default
+  and epsilon exploration noise (``expl_amount = 0.3``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    Actor,
+    Encoder,
+    CNNDecoder,
+    MLPDecoder,
+    _PredictionHead,
+    actor_dists,  # noqa: F401  (re-exported for the train step)
+    actor_sample,
+    add_exploration_noise,
+    xavier_normal_init,
+)
+from sheeprl_tpu.distributions import Independent, Normal
+from sheeprl_tpu.models import MLP
+
+__all__ = [
+    "RecurrentModel",
+    "RSSM",
+    "PlayerDV1",
+    "WorldModel",
+    "build_agent",
+    "actor_sample",
+    "actor_dists",
+    "compute_stochastic_state",
+]
+
+
+class RecurrentModel(nn.Module):
+    """Linear + activation + plain GRU (reference: ``agent.py:31-61``)."""
+
+    recurrent_state_size: int
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        from sheeprl_tpu.models import get_activation
+
+        feat = nn.Dense(self.recurrent_state_size, dtype=self.dtype, name="fc")(x)
+        feat = get_activation(self.activation)(feat)
+        h, _ = nn.GRUCell(features=self.recurrent_state_size, dtype=self.dtype, name="rnn")(
+            recurrent_state, feat
+        )
+        return h
+
+
+class _GaussianStateHead(nn.Module):
+    """One-hidden-layer MLP emitting (mean, raw-std) of the continuous
+    stochastic state (reference transition/representation models,
+    ``agent.py:395-421``)."""
+
+    hidden_size: int
+    stochastic_size: int
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = MLP(
+            hidden_sizes=(self.hidden_size,),
+            activation=self.activation,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return nn.Dense(2 * self.stochastic_size, dtype=self.dtype, name="out")(x)
+
+
+def compute_stochastic_state(
+    mean_std: jax.Array, key: Optional[jax.Array], min_std: float = 0.1
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """Split (mean, raw std), squash std and reparameterize-sample
+    (reference ``utils.compute_stochastic_state``)."""
+    mean, std = jnp.split(mean_std, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    dist = Independent(Normal(mean, std), 1)
+    state = dist.rsample(key) if key is not None else mean
+    return (mean, std), state
+
+
+@dataclasses.dataclass(frozen=True)
+class RSSM:
+    """Scan-body-ready single-step continuous-latent RSSM
+    (reference: ``agent.py:64-217``)."""
+
+    recurrent_model: RecurrentModel
+    representation_model: _GaussianStateHead
+    transition_model: _GaussianStateHead
+    min_std: float = 0.1
+
+    def _representation(self, wmp, recurrent_state, embedded_obs, key):
+        mean_std = self.representation_model.apply(
+            wmp["representation_model"], jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        )
+        return compute_stochastic_state(mean_std, key, self.min_std)
+
+    def _transition(self, wmp, recurrent_out, key):
+        mean_std = self.transition_model.apply(wmp["transition_model"], recurrent_out)
+        return compute_stochastic_state(mean_std, key, self.min_std)
+
+    def dynamic(self, wmp, posterior, recurrent_state, action, embedded_obs, key):
+        """One dynamic-learning step — no ``is_first`` resets in V1
+        (reference: ``agent.py:97-134``)."""
+        k_prior, k_post = jax.random.split(key)
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_mean_std, _ = self._transition(wmp, recurrent_state, k_prior)
+        posterior_mean_std, posterior = self._representation(wmp, recurrent_state, embedded_obs, k_post)
+        return recurrent_state, posterior, posterior_mean_std, prior_mean_std
+
+    def imagination(self, wmp, stochastic_state, recurrent_state, actions, key):
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([stochastic_state, actions], axis=-1), recurrent_state
+        )
+        _, imagined_prior = self._transition(wmp, recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldModel:
+    encoder: Encoder
+    rssm: RSSM
+    observation_model: Any
+    reward_model: _PredictionHead
+    continue_model: Optional[_PredictionHead]
+
+    def decode(self, wmp, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.observation_model["cnn"] is not None:
+            out.update(self.observation_model["cnn"].apply(wmp["cnn_decoder"], latent))
+        if self.observation_model["mlp"] is not None:
+            out.update(self.observation_model["mlp"].apply(wmp["mlp_decoder"], latent))
+        return out
+
+
+class PlayerDV1:
+    """Stateful env-side player; zero initial states
+    (reference: ``agent.py:219-327``)."""
+
+    def __init__(
+        self,
+        world_model: WorldModel,
+        actor: Actor,
+        actions_dim: Sequence[int],
+        num_envs: int,
+        stochastic_size: int,
+        recurrent_state_size: int,
+        expl_amount: float = 0.0,
+        actor_type: Optional[str] = None,
+    ):
+        self.world_model = world_model
+        self.actor = actor
+        self.actions_dim = actions_dim
+        self.num_envs = num_envs
+        self.stochastic_size = stochastic_size
+        self.recurrent_state_size = recurrent_state_size
+        self.expl_amount = expl_amount
+        self.actor_type = actor_type
+        self.is_continuous = actor.is_continuous
+        self.actions = None
+        self.recurrent_state = None
+        self.stochastic_state = None
+
+        rssm = world_model.rssm
+        encoder = world_model.encoder
+
+        def _step(params, obs, actions, rec, stoch, key, greedy, expl):
+            wmp = params["world_model"]
+            emb = encoder.apply(wmp["encoder"], obs)
+            rec = rssm.recurrent_model.apply(
+                wmp["recurrent_model"], jnp.concatenate([stoch, actions], axis=-1), rec
+            )
+            k_repr, k_act, k_expl = jax.random.split(key, 3)
+            _, stoch = rssm._representation(wmp, rec, emb, k_repr)
+            acts, _ = actor_sample(actor, params["actor"], jnp.concatenate([stoch, rec], axis=-1), k_act, greedy)
+            if not greedy and expl > 0.0:
+                acts = add_exploration_noise(acts, expl, k_expl, actor.is_continuous)
+            return acts, jnp.concatenate(acts, axis=-1), rec, stoch
+
+        self._step_fn = jax.jit(_step, static_argnums=(6, 7))
+
+    def init_states(self, params=None, reset_envs: Optional[Sequence[int]] = None) -> None:
+        if reset_envs is None or len(reset_envs) == 0:
+            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32)
+            self.recurrent_state = jnp.zeros((self.num_envs, self.recurrent_state_size), dtype=jnp.float32)
+            self.stochastic_state = jnp.zeros((self.num_envs, self.stochastic_size), dtype=jnp.float32)
+        else:
+            idx = jnp.asarray(list(reset_envs))
+            self.actions = self.actions.at[idx].set(0.0)
+            self.recurrent_state = self.recurrent_state.at[idx].set(0.0)
+            self.stochastic_state = self.stochastic_state.at[idx].set(0.0)
+
+    def get_actions(self, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
+        acts, self.actions, self.recurrent_state, self.stochastic_state = self._step_fn(
+            params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy,
+            float(self.expl_amount),
+        )
+        return acts
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[WorldModel, Actor, _PredictionHead, Dict[str, Any], PlayerDV1]:
+    """Create modules + the params tree ``{world_model, actor, critic}``
+    (reference: ``agent.py:329-534``) — V1 has no target critic."""
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    dtype = fabric.precision.compute_dtype
+
+    recurrent_state_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    stochastic_size = int(wm_cfg.stochastic_size)
+    latent_state_size = stochastic_size + recurrent_state_size
+    dense_act = str(cfg.algo.dense_act)
+    cnn_act = str(cfg.algo.cnn_act)
+    use_continues = bool(wm_cfg.use_continues)
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    screen = int(cfg.env.screen_size)
+    cnn_channels = [int(np.prod(obs_space[k].shape[2:] or (1,))) for k in cnn_keys]
+    mlp_dims = [int(np.prod(obs_space[k].shape)) for k in mlp_keys]
+    cnn_encoder_output_dim = 8 * int(wm_cfg.encoder.cnn_channels_multiplier) * 2 * 2 if cnn_keys else 0
+
+    encoder = Encoder(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
+        mlp_layers=int(wm_cfg.encoder.mlp_layers),
+        dense_units=int(wm_cfg.encoder.dense_units),
+        layer_norm=False,
+        activation=dense_act,
+        cnn_activation=cnn_act,
+        dtype=dtype,
+    )
+    encoder_output_dim = cnn_encoder_output_dim + (int(wm_cfg.encoder.dense_units) if mlp_keys else 0)
+
+    recurrent_model = RecurrentModel(
+        recurrent_state_size=recurrent_state_size, activation=dense_act, dtype=dtype
+    )
+    representation_model = _GaussianStateHead(
+        hidden_size=int(wm_cfg.representation_model.hidden_size),
+        stochastic_size=stochastic_size,
+        activation=dense_act,
+        dtype=dtype,
+    )
+    transition_model = _GaussianStateHead(
+        hidden_size=int(wm_cfg.transition_model.hidden_size),
+        stochastic_size=stochastic_size,
+        activation=dense_act,
+        dtype=dtype,
+    )
+    rssm = RSSM(
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        min_std=float(wm_cfg.min_std),
+    )
+    cnn_decoder = (
+        CNNDecoder(
+            keys=tuple(cfg.algo.cnn_keys.decoder),
+            output_channels=tuple(cnn_channels),
+            channels_multiplier=int(wm_cfg.observation_model.cnn_channels_multiplier),
+            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            layer_norm=False,
+            activation=cnn_act,
+            dtype=dtype,
+        )
+        if cfg.algo.cnn_keys.decoder
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoder(
+            keys=tuple(cfg.algo.mlp_keys.decoder),
+            output_dims=tuple(mlp_dims),
+            mlp_layers=int(wm_cfg.observation_model.mlp_layers),
+            dense_units=int(wm_cfg.observation_model.dense_units),
+            layer_norm=False,
+            activation=dense_act,
+            dtype=dtype,
+        )
+        if cfg.algo.mlp_keys.decoder
+        else None
+    )
+    reward_model = _PredictionHead(
+        output_dim=1,
+        mlp_layers=int(wm_cfg.reward_model.mlp_layers),
+        dense_units=int(wm_cfg.reward_model.dense_units),
+        activation=dense_act,
+        dtype=dtype,
+    )
+    continue_model = (
+        _PredictionHead(
+            output_dim=1,
+            mlp_layers=int(wm_cfg.discount_model.mlp_layers),
+            dense_units=int(wm_cfg.discount_model.dense_units),
+            activation=dense_act,
+            dtype=dtype,
+        )
+        if use_continues
+        else None
+    )
+    world_model = WorldModel(
+        encoder=encoder,
+        rssm=rssm,
+        observation_model={"cnn": cnn_decoder, "mlp": mlp_decoder},
+        reward_model=reward_model,
+        continue_model=continue_model,
+    )
+
+    dist_type = cfg.distribution.get("type", "auto").lower()
+    if dist_type == "auto":
+        dist_type = "tanh_normal" if is_continuous else "discrete"
+    actor = Actor(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        distribution=dist_type,
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=False,
+        activation=dense_act,
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        dtype=dtype,
+    )
+    critic = _PredictionHead(
+        output_dim=1,
+        mlp_layers=int(critic_cfg.mlp_layers),
+        dense_units=int(critic_cfg.dense_units),
+        activation=dense_act,
+        dtype=dtype,
+    )
+
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 12)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, screen, screen, ch), dtype=jnp.float32)
+    for k, d in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, d), dtype=jnp.float32)
+    dummy_latent = jnp.zeros((1, latent_state_size), dtype=jnp.float32)
+    dummy_rec = jnp.zeros((1, recurrent_state_size), dtype=jnp.float32)
+
+    wmp: Dict[str, Any] = {
+        "encoder": encoder.init(keys[0], dummy_obs),
+        "recurrent_model": recurrent_model.init(
+            keys[1], jnp.zeros((1, stochastic_size + int(np.sum(actions_dim))), dtype=jnp.float32), dummy_rec
+        ),
+        "representation_model": representation_model.init(
+            keys[2], jnp.zeros((1, encoder_output_dim + recurrent_state_size), dtype=jnp.float32)
+        ),
+        "transition_model": transition_model.init(keys[3], dummy_rec),
+        "reward_model": reward_model.init(keys[4], dummy_latent),
+    }
+    if continue_model is not None:
+        wmp["continue_model"] = continue_model.init(keys[5], dummy_latent)
+    if cnn_decoder is not None:
+        wmp["cnn_decoder"] = cnn_decoder.init(keys[6], dummy_latent)
+    if mlp_decoder is not None:
+        wmp["mlp_decoder"] = mlp_decoder.init(keys[7], dummy_latent)
+    actor_params = actor.init(keys[8], dummy_latent)
+    critic_params = critic.init(keys[9], dummy_latent)
+
+    init_keys = jax.random.split(keys[10], len(wmp) + 2)
+    for i, name in enumerate(sorted(wmp.keys())):
+        wmp[name] = xavier_normal_init(wmp[name], init_keys[i])
+    actor_params = xavier_normal_init(actor_params, init_keys[-2])
+    critic_params = xavier_normal_init(critic_params, init_keys[-1])
+
+    params = {"world_model": wmp, "actor": actor_params, "critic": critic_params}
+    if world_model_state is not None:
+        params["world_model"] = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), params["world_model"], world_model_state
+        )
+    if actor_state is not None:
+        params["actor"] = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["actor"], actor_state)
+    if critic_state is not None:
+        params["critic"] = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["critic"], critic_state)
+    params = fabric.put_replicated(params)
+
+    player = PlayerDV1(
+        world_model,
+        actor,
+        actions_dim,
+        cfg.env.num_envs,
+        stochastic_size,
+        recurrent_state_size,
+        expl_amount=float(actor_cfg.get("expl_amount", 0.0)),
+    )
+    return world_model, actor, critic, params, player

@@ -1,0 +1,50 @@
+"""Dreamer-V1 losses (reference: ``sheeprl/algos/dreamer_v1/loss.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.distributions import kl_divergence
+
+__all__ = ["reconstruction_loss", "actor_loss", "critic_loss"]
+
+
+def actor_loss(discounted_lambda_values: jax.Array) -> jax.Array:
+    """Eq. 7 of arXiv:1912.01603 — maximize the (discounted) lambda returns
+    via dynamics backprop only (reference: ``loss.py:27-38``)."""
+    return -jnp.mean(discounted_lambda_values)
+
+
+def critic_loss(qv: Any, lambda_values: jax.Array, discount: jax.Array) -> jax.Array:
+    """Eq. 8 of arXiv:1912.01603 (reference: ``loss.py:9-24``)."""
+    return -jnp.mean(discount * qv.log_prob(lambda_values))
+
+
+def reconstruction_loss(
+    qo: Dict[str, Any],
+    observations: Dict[str, jax.Array],
+    qr: Any,
+    rewards: jax.Array,
+    posteriors_dist: Any,
+    priors_dist: Any,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc: Optional[Any] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Eq. 10 of arXiv:1912.01603 — plain Gaussian KL with free nats, no
+    balancing (reference: ``loss.py:41-98``)."""
+    observation_loss = -sum(qo[k].log_prob(observations[k]).mean() for k in qo.keys())
+    reward_loss = -qr.log_prob(rewards).mean()
+    kl = kl_divergence(posteriors_dist, priors_dist).mean()
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * qc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,61 @@
+"""Dreamer-V1 helpers (reference: ``sheeprl/algos/dreamer_v1/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# The stateful-player test loop and obs preparation are identical to V2's.
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test  # noqa: F401
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Params/exploration_amount",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    last_values: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """V1's lambda-return recursion, gradients kept (reference:
+    ``utils.py:42-78``): H inputs produce H-1 outputs; the next-state value
+    is ``values[t+1] * (1 - lmbda)`` except at the last step, where the full
+    ``last_values`` bootstraps."""
+    horizon = rewards.shape[0]
+    next_values = jnp.concatenate([values[1 : horizon - 1] * (1 - lmbda), last_values[None]], axis=0)
+    delta = rewards[: horizon - 1] + next_values * continues[: horizon - 1]
+
+    def body(agg, xs):
+        delta_t, cont_t = xs
+        val = delta_t + lmbda * cont_t * agg
+        return val, val
+
+    _, vals = jax.lax.scan(
+        body, jnp.zeros_like(last_values), (delta, continues[: horizon - 1]), reverse=True
+    )
+    return vals
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
+
+    return log_state_dicts_from_checkpoint(cfg, state, models=("world_model", "actor", "critic"))

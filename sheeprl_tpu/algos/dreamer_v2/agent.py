@@ -1,0 +1,780 @@
+"""Dreamer-V2 agent (reference: ``sheeprl/algos/dreamer_v2/agent.py``).
+
+Same TPU-first structure as the V3 agent (pure scan-ready RSSM step functions
+over a single params tree), with the V2 architecture deltas:
+
+- ELU activations and *optional* LayerNorm (reference config
+  ``configs/algo/dreamer_v2.yaml``: ``layer_norm: False``);
+- VALID-padded conv stacks: encoder 4x (k4, s2) (``agent.py:60-79``),
+  decoder deconvs with kernels (5, 5, 6, 6) from a 1x1 feature map
+  (``agent.py:160-190``);
+- no unimix on the stochastic-state categoricals;
+- zero (non-learnable) initial recurrent/stochastic states: ``is_first``
+  *zeroes* the carried state (reference ``RSSM.dynamic``, ``agent.py:333-369``);
+- actor distributions: ``trunc_normal`` default for continuous spaces, with
+  the reference's 100-sample argmax trick for greedy continuous actions
+  (``agent.py:536-545``);
+- Xavier-normal init of every kernel (reference ``utils.init_weights``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.distributions import (
+    Independent,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    TanhNormal,
+    TruncatedNormal,
+)
+from sheeprl_tpu.models import MLP, LayerNormGRUCell
+from sheeprl_tpu.models.blocks import _ConvTranspose
+
+__all__ = [
+    "CNNEncoder",
+    "MLPEncoder",
+    "Encoder",
+    "CNNDecoder",
+    "MLPDecoder",
+    "RecurrentModel",
+    "RSSM",
+    "Actor",
+    "PlayerDV2",
+    "WorldModel",
+    "build_agent",
+    "actor_sample",
+    "actor_dists",
+    "add_exploration_noise",
+    "xavier_normal_init",
+]
+
+
+class CNNEncoder(nn.Module):
+    """4x (k4, s2, VALID) conv stack, optional LayerNorm, flattened output
+    (reference: ``agent.py:31-82``). 64x64 -> 2x2x(8*mult)."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        from sheeprl_tpu.models import get_activation
+
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)  # NHWC
+        lead = x.shape[:-3]
+        x = x.reshape(-1, *x.shape[-3:])
+        for i, mult in enumerate((1, 2, 4, 8)):
+            x = nn.Conv(
+                mult * self.channels_multiplier,
+                kernel_size=(4, 4),
+                strides=(2, 2),
+                padding="VALID",
+                use_bias=not self.layer_norm,
+                dtype=self.dtype,
+                name=f"conv_{i}",
+            )(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+            x = get_activation(self.activation)(x)
+        return x.reshape(*lead, -1)
+
+
+class MLPEncoder(nn.Module):
+    """Vector encoder (reference: ``agent.py:84-128``); no symlog in V2."""
+
+    keys: Sequence[str]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class Encoder(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels_multiplier: int
+    mlp_layers: int
+    dense_units: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    cnn_activation: Optional[str] = None  # defaults to `activation` (V1 uses relu convs + elu denses)
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        parts = []
+        if self.cnn_keys:
+            parts.append(
+                CNNEncoder(
+                    keys=self.cnn_keys,
+                    channels_multiplier=self.cnn_channels_multiplier,
+                    layer_norm=self.layer_norm,
+                    activation=self.cnn_activation or self.activation,
+                    dtype=self.dtype,
+                    name="cnn_encoder",
+                )(obs)
+            )
+        if self.mlp_keys:
+            parts.append(
+                MLPEncoder(
+                    keys=self.mlp_keys,
+                    mlp_layers=self.mlp_layers,
+                    dense_units=self.dense_units,
+                    layer_norm=self.layer_norm,
+                    activation=self.activation,
+                    dtype=self.dtype,
+                    name="mlp_encoder",
+                )(obs)
+            )
+        return jnp.concatenate(parts, axis=-1)
+
+
+class CNNDecoder(nn.Module):
+    """Linear to a 1x1 feature map then 4 VALID deconvs with kernels
+    (5, 5, 6, 6) back to 64x64 (reference: ``agent.py:130-196``)."""
+
+    keys: Sequence[str]
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    cnn_encoder_output_dim: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        from sheeprl_tpu.models import get_activation
+
+        lead = latent.shape[:-1]
+        x = nn.Dense(self.cnn_encoder_output_dim, dtype=self.dtype, name="fc")(latent)
+        x = x.reshape(-1, 1, 1, self.cnn_encoder_output_dim)
+        hidden = [4 * self.channels_multiplier, 2 * self.channels_multiplier, self.channels_multiplier]
+        kernels = (5, 5, 6, 6)
+        for i, ch in enumerate(hidden):
+            x = _ConvTranspose(
+                features=ch,
+                kernel_size=(kernels[i], kernels[i]),
+                strides=(2, 2),
+                padding=0,
+                use_bias=not self.layer_norm,
+                dtype=self.dtype,
+                name=f"deconv_{i}",
+            )(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+            x = get_activation(self.activation)(x)
+        x = _ConvTranspose(
+            features=int(sum(self.output_channels)),
+            kernel_size=(kernels[-1], kernels[-1]),
+            strides=(2, 2),
+            padding=0,
+            dtype=self.dtype,
+            name="out",
+        )(x)
+        x = x.reshape(*lead, *x.shape[1:])
+        splits = np.cumsum(np.asarray(self.output_channels[:-1], dtype=np.int64)).tolist()
+        parts = jnp.split(x, splits, axis=-1) if len(self.keys) > 1 else [x]
+        return {k: p for k, p in zip(self.keys, parts)}
+
+
+class MLPDecoder(nn.Module):
+    """Per-key linear heads over a shared MLP (reference: ``agent.py:198-245``)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="model",
+        )(x=latent)
+        return {
+            k: nn.Dense(int(d), dtype=self.dtype, name=f"head_{i}")(x)
+            for i, (k, d) in enumerate(zip(self.keys, self.output_dims))
+        }
+
+
+class RecurrentModel(nn.Module):
+    """MLP + LayerNorm-GRU (reference: ``agent.py:247-298``; the GRU always
+    carries LayerNorm in V2, the MLP's is config-driven)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    layer_norm: bool = True
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = MLP(
+            hidden_sizes=(self.dense_units,),
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="mlp",
+        )(x)
+        h, _ = LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size,
+            use_bias=True,
+            layer_norm=True,
+            dtype=self.dtype,
+            name="rnn",
+        )(recurrent_state, feat)
+        return h
+
+
+class _StochMLP(nn.Module):
+    """One-hidden-layer MLP emitting flat stochastic-state logits (the V2
+    transition/representation heads, reference ``agent.py:929-960``)."""
+
+    hidden_size: int
+    stoch_state_size: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = MLP(
+            hidden_sizes=(self.hidden_size,),
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return nn.Dense(self.stoch_state_size, dtype=self.dtype, name="out")(x)
+
+
+def sample_stochastic(logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True) -> jax.Array:
+    """Straight-through sample (or mode) of the grouped categoricals — no
+    unimix in V2 (reference ``utils.compute_stochastic_state``)."""
+    grouped = logits.reshape(*logits.shape[:-1], -1, discrete)
+    dist = OneHotCategoricalStraightThrough(logits=grouped)
+    out = dist.rsample(key) if sample else dist.mode
+    return out.reshape(*out.shape[:-2], -1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RSSM:
+    """Scan-body-ready single-step RSSM ops (reference: ``agent.py:301-415``).
+    ``is_first`` zeroes the carried state — V2 has no learnable initial
+    state."""
+
+    recurrent_model: RecurrentModel
+    representation_model: _StochMLP
+    transition_model: _StochMLP
+    discrete: int = 32
+
+    def _representation(self, wmp, recurrent_state, embedded_obs, key) -> Tuple[jax.Array, jax.Array]:
+        logits = self.representation_model.apply(
+            wmp["representation_model"], jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        )
+        return logits, sample_stochastic(logits, self.discrete, key)
+
+    def _transition(self, wmp, recurrent_out, key) -> Tuple[jax.Array, jax.Array]:
+        logits = self.transition_model.apply(wmp["transition_model"], recurrent_out)
+        return logits, sample_stochastic(logits, self.discrete, key)
+
+    def dynamic(
+        self, wmp, posterior, recurrent_state, action, embedded_obs, is_first, key
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """One dynamic-learning step; all tensors batch-shaped, posterior flat
+        (reference: ``agent.py:333-369``)."""
+        k_prior, k_post = jax.random.split(key)
+        action = (1 - is_first) * action
+        posterior = (1 - is_first) * posterior
+        recurrent_state = (1 - is_first) * recurrent_state
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_logits, _ = self._transition(wmp, recurrent_state, k_prior)
+        posterior_logits, posterior = self._representation(wmp, recurrent_state, embedded_obs, k_post)
+        return recurrent_state, posterior, posterior_logits, prior_logits
+
+    def imagination(self, wmp, prior, recurrent_state, actions, key) -> Tuple[jax.Array, jax.Array]:
+        recurrent_state = self.recurrent_model.apply(
+            wmp["recurrent_model"], jnp.concatenate([prior, actions], axis=-1), recurrent_state
+        )
+        _, imagined_prior = self._transition(wmp, recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+class _PredictionHead(nn.Module):
+    """MLP + linear head (reward / continue / critic, reference
+    ``agent.py:972-1005, 1033-1045``)."""
+
+    output_dim: int
+    mlp_layers: int
+    dense_units: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="out")(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldModel:
+    encoder: Encoder
+    rssm: RSSM
+    observation_model: Any  # {"cnn": CNNDecoder|None, "mlp": MLPDecoder|None}
+    reward_model: _PredictionHead
+    continue_model: Optional[_PredictionHead]
+
+    def decode(self, wmp, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.observation_model["cnn"] is not None:
+            out.update(self.observation_model["cnn"].apply(wmp["cnn_decoder"], latent))
+        if self.observation_model["mlp"] is not None:
+            out.update(self.observation_model["mlp"].apply(wmp["mlp_decoder"], latent))
+        return out
+
+
+class Actor(nn.Module):
+    """V2 task actor (reference: ``agent.py:416-560``). ``trunc_normal`` is
+    the continuous default; heads emit logits / mean-std parameters."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str  # "discrete" | "trunc_normal" | "normal" | "tanh_normal"
+    dense_units: int = 400
+    mlp_layers: int = 4
+    layer_norm: bool = False
+    activation: str = "elu"
+    init_std: float = 0.0
+    min_std: float = 0.1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> List[jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="model",
+        )(state)
+        if self.is_continuous:
+            return [nn.Dense(int(np.sum(self.actions_dim)) * 2, dtype=self.dtype, name="head_0")(x)]
+        return [nn.Dense(int(d), dtype=self.dtype, name=f"head_{i}")(x) for i, d in enumerate(self.actions_dim)]
+
+
+def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
+    """Action distributions from the actor heads (reference forward,
+    ``agent.py:506-560``)."""
+    if actor.is_continuous:
+        mean, std = jnp.split(pre_dist[0], 2, axis=-1)
+        if actor.distribution == "tanh_normal":
+            mean = 5 * jnp.tanh(mean / 5)
+            std = jax.nn.softplus(std + actor.init_std) + actor.min_std
+            return [Independent(TanhNormal(mean, std), 1)]
+        if actor.distribution == "normal":
+            return [Independent(Normal(mean, std), 1)]
+        # trunc_normal (the V2 continuous default)
+        std = 2 * jax.nn.sigmoid((std + actor.init_std) / 2) + actor.min_std
+        return [Independent(TruncatedNormal(jnp.tanh(mean), std, -1.0, 1.0), 1)]
+    return [OneHotCategoricalStraightThrough(logits=lo) for lo in pre_dist]
+
+
+def actor_sample(
+    actor: Actor, actor_params, state: jax.Array, key: jax.Array, greedy: bool = False
+) -> Tuple[List[jax.Array], List[Any]]:
+    """Sample actions; greedy continuous uses the reference's 100-sample
+    argmax-of-log-prob trick (``agent.py:536-545``)."""
+    pre_dist = actor.apply(actor_params, state)
+    dists = actor_dists(actor, pre_dist)
+    actions: List[jax.Array] = []
+    if actor.is_continuous:
+        d = dists[0]
+        if greedy:
+            samples = d.rsample(key, (100,))
+            log_prob = d.log_prob(samples)
+            idx = jnp.argmax(log_prob, axis=0)
+            act = jnp.take_along_axis(samples, idx[None, ..., None], axis=0)[0]
+        else:
+            act = d.rsample(key)
+        actions.append(act)
+    else:
+        keys = jax.random.split(key, len(dists))
+        for d, k in zip(dists, keys):
+            actions.append(d.mode if greedy else d.rsample(k))
+    return actions, dists
+
+
+def add_exploration_noise(
+    actions: Sequence[jax.Array], expl_amount, key: jax.Array, is_continuous: bool
+) -> Tuple[jax.Array, ...]:
+    """Epsilon-style exploration (reference: ``agent.py:547-560``): continuous
+    → clipped Gaussian jitter; discrete → uniform resample with prob eps.
+    ``expl_amount`` may be a traced scalar (amount 0 is then the identity by
+    construction, so no Python branch is needed)."""
+    if isinstance(expl_amount, (int, float)) and expl_amount <= 0.0:
+        return tuple(actions)
+    if is_continuous:
+        cat = jnp.concatenate(list(actions), axis=-1)
+        noise = jax.random.normal(key, cat.shape) * expl_amount
+        return (jnp.clip(cat + noise, -1, 1),)
+    out = []
+    keys = jax.random.split(key, 2 * len(actions))
+    for i, act in enumerate(actions):
+        sample = OneHotCategorical(logits=jnp.zeros_like(act)).sample(keys[2 * i])
+        replace = jax.random.uniform(keys[2 * i + 1], act.shape[:1]) < expl_amount
+        out.append(jnp.where(replace[..., None], sample, act))
+    return tuple(out)
+
+
+class PlayerDV2:
+    """Stateful env-side player carrying ``(actions, recurrent, stochastic)``
+    per env; zero initial states (reference: ``agent.py:736-832``)."""
+
+    def __init__(
+        self,
+        world_model: WorldModel,
+        actor: Actor,
+        actions_dim: Sequence[int],
+        num_envs: int,
+        stochastic_size: int,
+        recurrent_state_size: int,
+        discrete_size: int = 32,
+        expl_amount: float = 0.0,
+        actor_type: Optional[str] = None,
+    ):
+        self.world_model = world_model
+        self.actor = actor
+        self.actions_dim = actions_dim
+        self.num_envs = num_envs
+        self.stochastic_size = stochastic_size
+        self.recurrent_state_size = recurrent_state_size
+        self.discrete_size = discrete_size
+        self.expl_amount = expl_amount
+        self.actor_type = actor_type
+        self.is_continuous = actor.is_continuous
+        self.actions = None
+        self.recurrent_state = None
+        self.stochastic_state = None
+
+        rssm = world_model.rssm
+        encoder = world_model.encoder
+
+        def _step(params, obs, actions, rec, stoch, key, greedy, expl):
+            wmp = params["world_model"]
+            emb = encoder.apply(wmp["encoder"], obs)
+            rec = rssm.recurrent_model.apply(
+                wmp["recurrent_model"], jnp.concatenate([stoch, actions], axis=-1), rec
+            )
+            k_repr, k_act, k_expl = jax.random.split(key, 3)
+            _, stoch = rssm._representation(wmp, rec, emb, k_repr)
+            acts, _ = actor_sample(actor, params["actor"], jnp.concatenate([stoch, rec], axis=-1), k_act, greedy)
+            if not greedy and expl > 0.0:
+                acts = add_exploration_noise(acts, expl, k_expl, actor.is_continuous)
+            return acts, jnp.concatenate(acts, axis=-1), rec, stoch
+
+        self._step_fn = jax.jit(_step, static_argnums=(6, 7))
+
+    def init_states(self, params=None, reset_envs: Optional[Sequence[int]] = None) -> None:
+        stoch_flat = self.stochastic_size * self.discrete_size
+        if reset_envs is None or len(reset_envs) == 0:
+            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32)
+            self.recurrent_state = jnp.zeros((self.num_envs, self.recurrent_state_size), dtype=jnp.float32)
+            self.stochastic_state = jnp.zeros((self.num_envs, stoch_flat), dtype=jnp.float32)
+        else:
+            idx = jnp.asarray(list(reset_envs))
+            self.actions = self.actions.at[idx].set(0.0)
+            self.recurrent_state = self.recurrent_state.at[idx].set(0.0)
+            self.stochastic_state = self.stochastic_state.at[idx].set(0.0)
+
+    def get_actions(self, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
+        acts, self.actions, self.recurrent_state, self.stochastic_state = self._step_fn(
+            params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy,
+            float(self.expl_amount),
+        )
+        return acts
+
+
+def xavier_normal_init(params: Any, key: jax.Array) -> Any:
+    """Re-initialize every Dense/Conv kernel with Xavier normal and zero every
+    bias (reference ``utils.init_weights`` mode="normal")."""
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    keys = jax.random.split(key, len(leaves))
+
+    def init_leaf(path, leaf, k):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "kernel" and leaf.ndim >= 2:
+            if leaf.ndim == 2:
+                fan_in, fan_out = leaf.shape
+            else:
+                space = int(np.prod(leaf.shape[:-2]))
+                fan_in, fan_out = space * leaf.shape[-2], space * leaf.shape[-1]
+            std = np.sqrt(2.0 / (fan_in + fan_out))
+            return std * jax.random.normal(k, leaf.shape, dtype=leaf.dtype)
+        if name == "bias":
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    flat = {jax.tree_util.keystr(p): init_leaf(p, l, k) for (p, l), k in zip(leaves, keys)}
+    return jax.tree_util.tree_map_with_path(lambda p, l: flat[jax.tree_util.keystr(p)], params)
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+    actor_cls: Optional[type] = None,
+) -> Tuple[WorldModel, Actor, _PredictionHead, Dict[str, Any], PlayerDV2]:
+    """Create modules + the params tree ``{world_model, actor, critic,
+    target_critic}`` (reference: ``agent.py:862-1112``)."""
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    dtype = fabric.precision.compute_dtype
+
+    recurrent_state_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    stochastic_size = int(wm_cfg.stochastic_size)
+    discrete_size = int(wm_cfg.discrete_size)
+    stoch_state_size = stochastic_size * discrete_size
+    latent_state_size = stoch_state_size + recurrent_state_size
+    layer_norm = bool(cfg.algo.layer_norm)
+    act = str(cfg.algo.dense_act)
+    use_continues = bool(wm_cfg.use_continues)
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    screen = int(cfg.env.screen_size)
+    cnn_channels = [int(np.prod(obs_space[k].shape[2:] or (1,))) for k in cnn_keys]  # NHWC channels
+    mlp_dims = [int(np.prod(obs_space[k].shape)) for k in mlp_keys]
+    # V2's VALID 4-stage stack: 64 -> 31 -> 14 -> 6 -> 2
+    cnn_encoder_output_dim = 8 * int(wm_cfg.encoder.cnn_channels_multiplier) * 2 * 2 if cnn_keys else 0
+
+    encoder = Encoder(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
+        mlp_layers=int(wm_cfg.encoder.mlp_layers),
+        dense_units=int(wm_cfg.encoder.dense_units),
+        layer_norm=layer_norm,
+        activation=act,
+        dtype=dtype,
+    )
+    encoder_output_dim = cnn_encoder_output_dim + (int(wm_cfg.encoder.dense_units) if mlp_keys else 0)
+
+    recurrent_model = RecurrentModel(
+        recurrent_state_size=recurrent_state_size,
+        dense_units=int(wm_cfg.recurrent_model.dense_units),
+        layer_norm=bool(wm_cfg.recurrent_model.layer_norm),
+        activation=act,
+        dtype=dtype,
+    )
+    representation_model = _StochMLP(
+        hidden_size=int(wm_cfg.representation_model.hidden_size),
+        stoch_state_size=stoch_state_size,
+        layer_norm=layer_norm,
+        activation=act,
+        dtype=dtype,
+    )
+    transition_model = _StochMLP(
+        hidden_size=int(wm_cfg.transition_model.hidden_size),
+        stoch_state_size=stoch_state_size,
+        layer_norm=layer_norm,
+        activation=act,
+        dtype=dtype,
+    )
+    rssm = RSSM(
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        discrete=discrete_size,
+    )
+    cnn_decoder = (
+        CNNDecoder(
+            keys=tuple(cfg.algo.cnn_keys.decoder),
+            output_channels=tuple(cnn_channels),
+            channels_multiplier=int(wm_cfg.observation_model.cnn_channels_multiplier),
+            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            layer_norm=layer_norm,
+            activation=act,
+            dtype=dtype,
+        )
+        if cfg.algo.cnn_keys.decoder
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoder(
+            keys=tuple(cfg.algo.mlp_keys.decoder),
+            output_dims=tuple(mlp_dims),
+            mlp_layers=int(wm_cfg.observation_model.mlp_layers),
+            dense_units=int(wm_cfg.observation_model.dense_units),
+            layer_norm=layer_norm,
+            activation=act,
+            dtype=dtype,
+        )
+        if cfg.algo.mlp_keys.decoder
+        else None
+    )
+    reward_model = _PredictionHead(
+        output_dim=1,
+        mlp_layers=int(wm_cfg.reward_model.mlp_layers),
+        dense_units=int(wm_cfg.reward_model.dense_units),
+        layer_norm=layer_norm,
+        activation=act,
+        dtype=dtype,
+    )
+    continue_model = (
+        _PredictionHead(
+            output_dim=1,
+            mlp_layers=int(wm_cfg.discount_model.mlp_layers),
+            dense_units=int(wm_cfg.discount_model.dense_units),
+            layer_norm=layer_norm,
+            activation=act,
+            dtype=dtype,
+        )
+        if use_continues
+        else None
+    )
+    world_model = WorldModel(
+        encoder=encoder,
+        rssm=rssm,
+        observation_model={"cnn": cnn_decoder, "mlp": mlp_decoder},
+        reward_model=reward_model,
+        continue_model=continue_model,
+    )
+
+    dist_type = cfg.distribution.get("type", "auto").lower()
+    if dist_type == "auto":
+        dist_type = "trunc_normal" if is_continuous else "discrete"
+    actor_cls = actor_cls or Actor
+    actor = actor_cls(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        distribution=dist_type,
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=layer_norm,
+        activation=act,
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        dtype=dtype,
+    )
+    critic = _PredictionHead(
+        output_dim=1,
+        mlp_layers=int(critic_cfg.mlp_layers),
+        dense_units=int(critic_cfg.dense_units),
+        layer_norm=layer_norm,
+        activation=act,
+        dtype=dtype,
+    )
+
+    # -- init (Xavier normal everywhere, reference utils.init_weights) -------
+    keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 12)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, screen, screen, ch), dtype=jnp.float32)
+    for k, d in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, d), dtype=jnp.float32)
+    dummy_latent = jnp.zeros((1, latent_state_size), dtype=jnp.float32)
+    dummy_rec = jnp.zeros((1, recurrent_state_size), dtype=jnp.float32)
+
+    wmp: Dict[str, Any] = {
+        "encoder": encoder.init(keys[0], dummy_obs),
+        "recurrent_model": recurrent_model.init(
+            keys[1], jnp.zeros((1, stoch_state_size + int(np.sum(actions_dim))), dtype=jnp.float32), dummy_rec
+        ),
+        "representation_model": representation_model.init(
+            keys[2], jnp.zeros((1, encoder_output_dim + recurrent_state_size), dtype=jnp.float32)
+        ),
+        "transition_model": transition_model.init(keys[3], dummy_rec),
+        "reward_model": reward_model.init(keys[4], dummy_latent),
+    }
+    if continue_model is not None:
+        wmp["continue_model"] = continue_model.init(keys[5], dummy_latent)
+    if cnn_decoder is not None:
+        wmp["cnn_decoder"] = cnn_decoder.init(keys[6], dummy_latent)
+    if mlp_decoder is not None:
+        wmp["mlp_decoder"] = mlp_decoder.init(keys[7], dummy_latent)
+    actor_params = actor.init(keys[8], dummy_latent)
+    critic_params = critic.init(keys[9], dummy_latent)
+
+    init_keys = jax.random.split(keys[10], len(wmp) + 2)
+    for i, name in enumerate(sorted(wmp.keys())):
+        wmp[name] = xavier_normal_init(wmp[name], init_keys[i])
+    actor_params = xavier_normal_init(actor_params, init_keys[-2])
+    critic_params = xavier_normal_init(critic_params, init_keys[-1])
+
+    params = {
+        "world_model": wmp,
+        "actor": actor_params,
+        "critic": critic_params,
+    }
+    if world_model_state is not None:
+        params["world_model"] = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), params["world_model"], world_model_state
+        )
+    if actor_state is not None:
+        params["actor"] = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["actor"], actor_state)
+    if critic_state is not None:
+        params["critic"] = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["critic"], critic_state)
+    params["target_critic"] = (
+        jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params["critic"], target_critic_state)
+        if target_critic_state is not None
+        else jax.tree.map(jnp.copy, params["critic"])
+    )
+    params = fabric.put_replicated(params)
+
+    player = PlayerDV2(
+        world_model,
+        actor,
+        actions_dim,
+        cfg.env.num_envs,
+        stochastic_size,
+        recurrent_state_size,
+        discrete_size=discrete_size,
+        expl_amount=float(actor_cfg.get("expl_amount", 0.0)),
+    )
+    return world_model, actor, critic, params, player

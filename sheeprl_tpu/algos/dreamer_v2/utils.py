@@ -1,0 +1,105 @@
+"""Dreamer-V2 helpers (reference: ``sheeprl/algos/dreamer_v2/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: Optional[jax.Array] = None,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """V2-style TD(lambda) returns as a reverse ``lax.scan``
+    (reference: ``utils.py:87-107``). ``continues`` already carries gamma;
+    ``bootstrap`` is the value of the state after the last input row.
+    All inputs ``(H, B, 1)``."""
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1:])
+    next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def body(nxt, xs):
+        inp_t, cont_t = xs
+        val = inp_t + cont_t * lmbda * nxt
+        return val, val
+
+    _, vals = jax.lax.scan(body, bootstrap[0], (inputs, continues), reverse=True)
+    return vals
+
+
+def prepare_obs(
+    fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
+) -> Dict[str, np.ndarray]:
+    """Batch-shaped ``(num_envs, ...)`` float32 host arrays; pixels NHWC in
+    [-0.5, 0.5] (reference: ``utils.py:110-121``)."""
+    out = {}
+    for k, v in obs.items():
+        v = np.asarray(v, dtype=np.float32)
+        if k in cnn_keys:
+            v = v.reshape(num_envs, *v.shape[-3:]) / 255.0 - 0.5
+        else:
+            v = v.reshape(num_envs, -1)
+        out[k] = v
+    return out
+
+
+def test(
+    player, params, fabric, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True, writer=None
+) -> None:
+    """Evaluation episode with the stateful player (reference: ``utils.py:124-168``)."""
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    saved_num_envs = player.num_envs
+    player.num_envs = 1
+    player.init_states(params)
+    key = jax.random.PRNGKey(cfg.seed or 0)
+    while not done:
+        jobs = prepare_obs(fabric, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        key, subkey = jax.random.split(key)
+        real_actions = player.get_actions(params, jobs, subkey, greedy=greedy)
+        if player.is_continuous:
+            real_actions = np.concatenate([np.asarray(a) for a in real_actions], axis=-1)
+        else:
+            real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in real_actions], axis=-1)
+        obs, reward, done, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = done or truncated or cfg.dry_run
+        cumulative_rew += reward
+    print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and writer is not None:
+        writer.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    player.num_envs = saved_num_envs
+    env.close()
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
+
+    return log_state_dicts_from_checkpoint(cfg, state, models=("world_model", "actor", "critic", "target_critic"))

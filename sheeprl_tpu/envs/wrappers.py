@@ -248,7 +248,7 @@ class FrameStack(gym.Wrapper):
         return obs, reward, done, truncated, info
 
     def reset(self, *, seed=None, options=None, **kwargs):
-        obs, info = self.env.reset(seed=seed, **kwargs)
+        obs, info = self.env.reset(seed=seed, options=options, **kwargs)
         for k, hist in self._histories.items():
             hist.fill(obs[k])
             obs[k] = hist.snapshot()

@@ -187,6 +187,73 @@ def test_dreamer_v3_decoupled_rssm(tmp_path):
     run(_std_args(tmp_path, "dreamer_v3", extra=DREAMER_FAST + ["algo.world_model.decoupled_rssm=True"]))
 
 
+DREAMER_V2_FAST = [
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=2",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "env.screen_size=64",
+]
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_dreamer_v2_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "dreamer_v2", devices=devices, extra=DREAMER_V2_FAST))
+
+
+def test_dreamer_v2_continuous(tmp_path):
+    run(_std_args(tmp_path, "dreamer_v2", extra=DREAMER_V2_FAST + ["env.id=continuous_dummy"]))
+
+
+def test_dreamer_v2_episode_buffer(tmp_path):
+    run(
+        _std_args(
+            tmp_path,
+            "dreamer_v2",
+            extra=DREAMER_V2_FAST + ["buffer.type=episode", "algo.per_rank_sequence_length=1"],
+        )
+    )
+
+
+def test_dreamer_v2_use_continues(tmp_path):
+    run(_std_args(tmp_path, "dreamer_v2", extra=DREAMER_V2_FAST + ["algo.world_model.use_continues=True"]))
+
+
+DREAMER_V1_FAST = [
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=2",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "env.screen_size=64",
+]
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_dreamer_v1_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "dreamer_v1", devices=devices, extra=DREAMER_V1_FAST))
+
+
+def test_dreamer_v1_continuous(tmp_path):
+    run(_std_args(tmp_path, "dreamer_v1", extra=DREAMER_V1_FAST + ["env.id=continuous_dummy"]))
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])

@@ -1,0 +1,84 @@
+"""Numeric cross-checks of the lambda-return recursions against slow Python
+reference implementations (the formulas in
+``sheeprl/algos/dreamer_v{1,2,3}/utils.py``)."""
+
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values as lambda_v1
+from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values as lambda_v2
+from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values as lambda_v3
+
+
+def _slow_v2(rewards, values, continues, bootstrap, lmbda):
+    horizon = rewards.shape[0]
+    agg = bootstrap[0]
+    next_vals = np.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_vals * (1 - lmbda)
+    out = []
+    for i in reversed(range(horizon)):
+        agg = inputs[i] + continues[i] * lmbda * agg
+        out.append(agg)
+    return np.stack(list(reversed(out)), axis=0)
+
+
+def _slow_v3(rewards, values, continues, lmbda):
+    horizon = rewards.shape[0]
+    interm = rewards + continues * values * (1 - lmbda)
+    agg = values[-1]
+    out = []
+    for i in reversed(range(horizon)):
+        agg = interm[i] + continues[i] * lmbda * agg
+        out.append(agg)
+    return np.stack(list(reversed(out)), axis=0)
+
+
+def _slow_v1(rewards, values, continues, last_values, lmbda):
+    horizon = rewards.shape[0]
+    agg = np.zeros_like(last_values)
+    out = []
+    for step in reversed(range(horizon - 1)):
+        if step == horizon - 2:
+            next_values = last_values
+        else:
+            next_values = values[step + 1] * (1 - lmbda)
+        delta = rewards[step] + next_values * continues[step]
+        agg = delta + lmbda * continues[step] * agg
+        out.append(agg)
+    return np.stack(list(reversed(out)), axis=0)
+
+
+def _rand(shape, rng):
+    return rng.normal(size=shape).astype(np.float32)
+
+
+def test_lambda_v2_matches_reference_formula():
+    rng = np.random.default_rng(0)
+    H, B = 7, 3
+    rewards, values = _rand((H, B, 1), rng), _rand((H, B, 1), rng)
+    continues = (rng.uniform(size=(H, B, 1)) > 0.1).astype(np.float32) * 0.99
+    bootstrap = _rand((1, B, 1), rng)
+    got = np.asarray(lambda_v2(rewards, values, continues, bootstrap, lmbda=0.95))
+    want = _slow_v2(rewards, values, continues, bootstrap, 0.95)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lambda_v3_matches_reference_formula():
+    rng = np.random.default_rng(1)
+    H, B = 6, 4
+    rewards, values = _rand((H, B, 1), rng), _rand((H, B, 1), rng)
+    continues = (rng.uniform(size=(H, B, 1)) > 0.1).astype(np.float32) * 0.997
+    got = np.asarray(lambda_v3(rewards, values, continues, lmbda=0.95))
+    want = _slow_v3(rewards, values, continues, 0.95)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lambda_v1_matches_reference_formula():
+    rng = np.random.default_rng(2)
+    H, B = 8, 2
+    rewards, values = _rand((H, B, 1), rng), _rand((H, B, 1), rng)
+    continues = np.full((H, B, 1), 0.99, dtype=np.float32)
+    last_values = values[-1]
+    got = np.asarray(lambda_v1(rewards, values, continues, last_values, lmbda=0.95))
+    want = _slow_v1(rewards, values, continues, last_values, 0.95)
+    assert got.shape == (H - 1, B, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
