@@ -1,0 +1,113 @@
+"""Plan2Explore on Dreamer-V1 — agent builders
+(reference: ``sheeprl/algos/p2e_dv1/agent.py``).
+
+The Dreamer-V1 agent plus: an exploration actor, ONE exploration critic (no
+target network in V1), and a vmapped-stacked ensemble of forward models
+predicting the next EMBEDDED OBSERVATION from ``(latent, action)`` — the
+original Plan2Explore disagreement target (reference ``agent.py:125-140``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import (
+    PlayerDV1,
+    WorldModel,
+    build_agent as build_dv1_agent,
+)
+from sheeprl_tpu.algos.dreamer_v2.agent import Actor, _PredictionHead, xavier_normal_init
+
+__all__ = ["build_agent", "ensembles_apply", "PlayerDV1"]
+
+
+def ensembles_apply(module: _PredictionHead, stacked_params, x: jax.Array) -> jax.Array:
+    """Apply all N stacked ensemble members to the same input → (N, ...)."""
+    return jax.vmap(lambda p: module.apply(p, x))(stacked_params)
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critic_exploration_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[WorldModel, _PredictionHead, Actor, _PredictionHead, Dict[str, Any], PlayerDV1]:
+    """Build the P2E-DV1 module set + one params tree
+    (reference: ``agent.py:40-210``)."""
+    wm_cfg = cfg.algo.world_model
+    dtype = fabric.precision.compute_dtype
+    act = str(cfg.algo.dense_act)
+    stochastic_size = int(wm_cfg.stochastic_size)
+    latent_state_size = stochastic_size + int(wm_cfg.recurrent_model.recurrent_state_size)
+
+    world_model, actor, critic, dv1_params, player = build_dv1_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+    )
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_encoder_output_dim = 8 * int(wm_cfg.encoder.cnn_channels_multiplier) * 2 * 2 if cnn_keys else 0
+    encoder_output_dim = cnn_encoder_output_dim + (int(wm_cfg.encoder.dense_units) if mlp_keys else 0)
+
+    key = jax.random.PRNGKey(cfg.seed + 5)
+    dummy_latent = jnp.zeros((1, latent_state_size), dtype=jnp.float32)
+    k_act, k_crit, k_ens = jax.random.split(key, 3)
+
+    actor_exploration_params = xavier_normal_init(actor.init(k_act, dummy_latent), jax.random.fold_in(k_act, 1))
+    if actor_exploration_state is not None:
+        actor_exploration_params = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), actor_exploration_params, actor_exploration_state
+        )
+    critic_exploration_params = xavier_normal_init(critic.init(k_crit, dummy_latent), jax.random.fold_in(k_crit, 1))
+    if critic_exploration_state is not None:
+        critic_exploration_params = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), critic_exploration_params, critic_exploration_state
+        )
+
+    ens_cfg = cfg.algo.ensembles
+    ens_module = _PredictionHead(
+        output_dim=encoder_output_dim,
+        mlp_layers=int(ens_cfg.mlp_layers),
+        dense_units=int(ens_cfg.dense_units),
+        activation=act,
+        dtype=dtype,
+    )
+    dummy_in = jnp.zeros((1, latent_state_size + int(np.sum(actions_dim))), dtype=jnp.float32)
+    members = []
+    for k in jax.random.split(k_ens, int(ens_cfg.n)):
+        k_init, k_xav = jax.random.split(k)
+        members.append(xavier_normal_init(ens_module.init(k_init, dummy_in), k_xav))
+    ens_params = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+    if ensembles_state is not None:
+        ens_params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), ens_params, ensembles_state)
+
+    params = {
+        "world_model": dv1_params["world_model"],
+        "actor_task": dv1_params["actor"],
+        "critic_task": dv1_params["critic"],
+        "actor_exploration": actor_exploration_params,
+        "critic_exploration": critic_exploration_params,
+        "ensembles": ens_params,
+    }
+    params = fabric.put_replicated(params)
+
+    player.actor_type = str(cfg.algo.player.actor_type)
+    return world_model, ens_module, actor, critic, params, player

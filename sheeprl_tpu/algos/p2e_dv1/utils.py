@@ -1,0 +1,46 @@
+"""P2E-DV1 helpers (reference: ``sheeprl/algos/p2e_dv1/utils.py``)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v1.utils import (  # noqa: F401
+    compute_lambda_values,
+    prepare_obs,
+    test,
+)
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/value_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+    "Rewards/intrinsic",
+}
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critic_exploration",
+    "actor_task",
+    "critic_task",
+}
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
+
+    return log_state_dicts_from_checkpoint(
+        cfg, state, models=("world_model", "ensembles", "actor_task", "critic_task", "actor_exploration")
+    )

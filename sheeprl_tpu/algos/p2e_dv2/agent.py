@@ -1,0 +1,121 @@
+"""Plan2Explore on Dreamer-V2 — agent builders
+(reference: ``sheeprl/algos/p2e_dv2/agent.py``).
+
+The Dreamer-V2 agent plus: an exploration actor, ONE exploration critic with
+its target network, and a vmapped-stacked ensemble of forward models
+predicting the next stochastic state from ``(latent, action)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    Actor,
+    PlayerDV2,
+    WorldModel,
+    _PredictionHead,
+    build_agent as build_dv2_agent,
+    xavier_normal_init,
+)
+
+__all__ = ["build_agent", "ensembles_apply", "PlayerDV2"]
+
+
+def ensembles_apply(module: _PredictionHead, stacked_params, x: jax.Array) -> jax.Array:
+    """Apply all N stacked ensemble members to the same input → (N, ...)."""
+    return jax.vmap(lambda p: module.apply(p, x))(stacked_params)
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    target_critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critic_exploration_state: Optional[Dict[str, Any]] = None,
+    target_critic_exploration_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[WorldModel, _PredictionHead, Actor, _PredictionHead, Dict[str, Any], PlayerDV2]:
+    """Build the P2E-DV2 module set + one params tree
+    (reference: ``agent.py:30-250``)."""
+    wm_cfg = cfg.algo.world_model
+    dtype = fabric.precision.compute_dtype
+    layer_norm = bool(cfg.algo.layer_norm)
+    act = str(cfg.algo.dense_act)
+    stoch_state_size = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    latent_state_size = stoch_state_size + int(wm_cfg.recurrent_model.recurrent_state_size)
+
+    world_model, actor, critic, dv2_params, player = build_dv2_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+
+    key = jax.random.PRNGKey(cfg.seed + 5)
+    dummy_latent = jnp.zeros((1, latent_state_size), dtype=jnp.float32)
+    k_act, k_crit, k_ens = jax.random.split(key, 3)
+
+    actor_exploration_params = xavier_normal_init(actor.init(k_act, dummy_latent), jax.random.fold_in(k_act, 1))
+    if actor_exploration_state is not None:
+        actor_exploration_params = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), actor_exploration_params, actor_exploration_state
+        )
+    critic_exploration_params = xavier_normal_init(critic.init(k_crit, dummy_latent), jax.random.fold_in(k_crit, 1))
+    if critic_exploration_state is not None:
+        critic_exploration_params = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), critic_exploration_params, critic_exploration_state
+        )
+    target_critic_exploration_params = (
+        jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), critic_exploration_params, target_critic_exploration_state)
+        if target_critic_exploration_state is not None
+        else jax.tree.map(jnp.copy, critic_exploration_params)
+    )
+
+    ens_cfg = cfg.algo.ensembles
+    ens_module = _PredictionHead(
+        output_dim=stoch_state_size,
+        mlp_layers=int(ens_cfg.mlp_layers),
+        dense_units=int(ens_cfg.dense_units),
+        layer_norm=layer_norm,
+        activation=act,
+        dtype=dtype,
+    )
+    dummy_in = jnp.zeros((1, latent_state_size + int(np.sum(actions_dim))), dtype=jnp.float32)
+    members = []
+    for k in jax.random.split(k_ens, int(ens_cfg.n)):
+        k_init, k_xav = jax.random.split(k)
+        members.append(xavier_normal_init(ens_module.init(k_init, dummy_in), k_xav))
+    ens_params = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+    if ensembles_state is not None:
+        ens_params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), ens_params, ensembles_state)
+
+    params = {
+        "world_model": dv2_params["world_model"],
+        "actor_task": dv2_params["actor"],
+        "critic_task": dv2_params["critic"],
+        "target_critic_task": dv2_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critic_exploration": critic_exploration_params,
+        "target_critic_exploration": target_critic_exploration_params,
+        "ensembles": ens_params,
+    }
+    params = fabric.put_replicated(params)
+
+    player.actor_type = str(cfg.algo.player.actor_type)
+    return world_model, ens_module, actor, critic, params, player

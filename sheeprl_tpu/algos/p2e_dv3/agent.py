@@ -1,0 +1,166 @@
+"""Plan2Explore on Dreamer-V3 — agent builders
+(reference: ``sheeprl/algos/p2e_dv3/agent.py``).
+
+Everything model-side is the Dreamer-V3 agent plus:
+
+- an *ensemble* of N forward models predicting the next stochastic state
+  from ``(latent, action)`` — their disagreement (variance) is the intrinsic
+  reward (reference: ``agent.py:174-195``). TPU-first: the N member param
+  trees are STACKED and applied with ``jax.vmap`` — one batched matmul per
+  layer instead of N sequential module calls;
+- a second (exploration) actor and a DICT of exploration critics
+  ``{name: {weight, reward_type}}``, each with its own target network
+  (reference: ``p2e_dv3_exploration.py:617-650``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    PlayerDV3,
+    WorldModel,
+    _PredictionHead,
+    build_agent as build_dv3_agent,
+    hafner_trunc_normal_init,
+    uniform_output_init,
+)
+
+__all__ = ["build_agent", "ensembles_apply", "PlayerDV3"]
+
+
+def ensembles_apply(module: _PredictionHead, stacked_params, x: jax.Array) -> jax.Array:
+    """Apply all N stacked ensemble members to the same input → (N, ...)."""
+    return jax.vmap(lambda p: module.apply(p, x))(stacked_params)
+
+
+def _build_ensembles(
+    cfg, key: jax.Array, input_dim: int, output_dim: int, dtype
+) -> Tuple[_PredictionHead, Any]:
+    """N forward models with per-member init seeds, stacked into one tree
+    (reference: ``agent.py:174-195`` — each member seeded differently)."""
+    ens_cfg = cfg.algo.ensembles
+    module = _PredictionHead(
+        output_dim=output_dim,
+        mlp_layers=int(ens_cfg.mlp_layers),
+        dense_units=int(ens_cfg.dense_units),
+        dtype=dtype,
+    )
+    dummy = jnp.zeros((1, input_dim), dtype=jnp.float32)
+    members = []
+    for k in jax.random.split(key, int(ens_cfg.n)):
+        k_init, k_hafner, k_out = jax.random.split(k, 3)
+        p = module.init(k_init, dummy)
+        if cfg.algo.hafner_initialization:
+            p = hafner_trunc_normal_init(p, k_hafner)
+            inner = p["params"]
+            inner["out"] = uniform_output_init({"out": inner["out"]}, k_out, 1.0)["out"]
+        members.append(p)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+    return module, stacked
+
+
+def build_agent(
+    fabric,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    target_critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critics_exploration_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[WorldModel, _PredictionHead, Actor, _PredictionHead, Dict[str, Dict[str, Any]], Dict[str, Any], PlayerDV3]:
+    """Build the P2E-DV3 module set + one params tree:
+
+    ``{world_model, actor_task, critic_task, target_critic_task,
+    actor_exploration, critics_exploration: {name: {module, target}},
+    ensembles}``
+
+    (reference: ``agent.py:27-260``). Returns
+    ``(world_model, ensembles_module, actor (shared class), critic_module,
+    critics_exploration_spec, params, player)``.
+    """
+    wm_cfg = cfg.algo.world_model
+    dtype = fabric.precision.compute_dtype
+    stoch_state_size = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    recurrent_state_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    latent_state_size = stoch_state_size + recurrent_state_size
+
+    world_model, actor, critic, dv3_params, player = build_dv3_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+
+    # Exploration actor: same module class/shape, separately initialized
+    # (reference: agent.py:197-215)
+    key = jax.random.PRNGKey(cfg.seed + 5)
+    dummy_latent = jnp.zeros((1, latent_state_size), dtype=jnp.float32)
+    k_act, k_crit, k_ens = jax.random.split(key, 3)
+    actor_exploration_params = actor.init(k_act, dummy_latent)
+    if cfg.algo.hafner_initialization:
+        ka, kb = jax.random.split(k_act)
+        actor_exploration_params = hafner_trunc_normal_init(actor_exploration_params, ka)
+        ap = actor_exploration_params["params"]
+        for i, hk in enumerate([k for k in ap.keys() if k.startswith("head_")]):
+            ap[hk] = uniform_output_init({hk: ap[hk]}, jax.random.fold_in(kb, i), 1.0)[hk]
+    if actor_exploration_state is not None:
+        actor_exploration_params = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype), actor_exploration_params, actor_exploration_state
+        )
+
+    # Exploration critics: one (critic, target) pair per configured head
+    # (reference: p2e_dv3_exploration.py:617-650)
+    critics_spec: Dict[str, Dict[str, Any]] = {}
+    critics_params: Dict[str, Dict[str, Any]] = {}
+    for i, (name, c_cfg) in enumerate(sorted(cfg.algo.critics_exploration.items())):
+        k_i = jax.random.fold_in(k_crit, i)
+        cp = critic.init(k_i, dummy_latent)
+        if cfg.algo.hafner_initialization:
+            ka, kb = jax.random.split(k_i)
+            cp = hafner_trunc_normal_init(cp, ka)
+            inner = cp["params"]
+            inner["out"] = uniform_output_init({"out": inner["out"]}, kb, 0.0)["out"]
+        critics_spec[name] = {"weight": float(c_cfg.weight), "reward_type": str(c_cfg.reward_type)}
+        critics_params[name] = {"module": cp, "target": jax.tree.map(jnp.copy, cp)}
+    if critics_exploration_state is not None:
+        critics_params = jax.tree.map(
+            lambda t, s: jnp.asarray(s, dtype=t.dtype) if hasattr(t, "dtype") else s,
+            critics_params,
+            critics_exploration_state,
+        )
+
+    ens_module, ens_params = _build_ensembles(
+        cfg, k_ens, latent_state_size + int(np.sum(actions_dim)), stoch_state_size, dtype
+    )
+    if ensembles_state is not None:
+        ens_params = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), ens_params, ensembles_state)
+
+    params = {
+        "world_model": dv3_params["world_model"],
+        "actor_task": dv3_params["actor"],
+        "critic_task": dv3_params["critic"],
+        "target_critic_task": dv3_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critics_exploration": critics_params,
+        "ensembles": ens_params,
+    }
+    params = fabric.put_replicated(params)
+
+    player.actor_type = str(cfg.algo.player.actor_type)
+    return world_model, ens_module, actor, critic, critics_spec, params, player

@@ -1,0 +1,49 @@
+"""P2E-DV3 evaluation entrypoint — evaluates the TASK actor
+(reference: ``sheeprl/algos/p2e_dv3/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.dreamer_v3.utils import test
+from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
+from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+__all__ = ["evaluate_p2e_dv3"]
+
+
+@register_evaluation(algorithms=["p2e_dv3_exploration", "p2e_dv3_finetuning"])
+def evaluate_p2e_dv3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, fabric.global_rank)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape if is_continuous else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+
+    cfg.algo.player.actor_type = "task"
+    _, _, _, _, _, params, player = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        world_model_state=state["world_model"],
+        actor_task_state=state["actor_task"],
+    )
+    test_params = {"world_model": params["world_model"], "actor": params["actor_task"]}
+    test(player, test_params, fabric, cfg, log_dir, greedy=False, writer=logger)
+    logger.close()

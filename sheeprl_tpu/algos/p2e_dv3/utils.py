@@ -1,0 +1,55 @@
+"""P2E-DV3 helpers (reference: ``sheeprl/algos/p2e_dv3/utils.py``)."""
+
+from __future__ import annotations
+
+# The stateful-player test loop, obs prep, Moments and lambda-returns are the
+# Dreamer-V3 ones.
+from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
+    compute_lambda_values,
+    init_moments,
+    moments_update,
+    prepare_obs,
+    test,
+)
+from sheeprl_tpu.utils.mlflow import log_models  # noqa: F401  (shared registry helper)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Loss/value_loss_intrinsic",
+    "Loss/value_loss_extrinsic",
+}
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critics_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments_task",
+    "moments_exploration",
+}
+
+
+def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
+    from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
+
+    return log_state_dicts_from_checkpoint(
+        cfg, state, models=("world_model", "ensembles", "actor_task", "critic_task", "actor_exploration")
+    )

@@ -254,6 +254,44 @@ def test_dreamer_v1_continuous(tmp_path):
     run(_std_args(tmp_path, "dreamer_v1", extra=DREAMER_V1_FAST + ["env.id=continuous_dummy"]))
 
 
+# drop the algo-group override (it would clobber p2e_dv3's algo config)
+P2E_DV3_FAST = [a for a in DREAMER_FAST if a != "algo=dreamer_v3_XS"] + [
+    "algo.ensembles.n=3",
+    "algo.per_rank_sequence_length=1",
+]
+P2E_DV2_FAST = DREAMER_V2_FAST + ["algo.ensembles.n=3", "algo.per_rank_sequence_length=1"]
+P2E_DV1_FAST = DREAMER_V1_FAST + ["algo.ensembles.n=3", "algo.per_rank_sequence_length=1"]
+
+
+def _latest_ckpt(root):
+    import glob
+
+    return sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True))[-1]
+
+
+@pytest.mark.parametrize(
+    "algo, fast",
+    [("p2e_dv1", P2E_DV1_FAST), ("p2e_dv2", P2E_DV2_FAST), ("p2e_dv3", P2E_DV3_FAST)],
+)
+def test_p2e_exploration_then_finetuning(tmp_path, algo, fast):
+    """Exploration dry-run → checkpoint → finetuning-from-checkpoint
+    round-trip (mirrors reference ``tests/test_algos/test_algos.py`` p2e
+    coverage + the ``cli`` finetuning config plumbing)."""
+    expl = _std_args(tmp_path, f"{algo}_exploration", extra=fast)
+    expl.remove("checkpoint.save_last=False")
+    expl.append("checkpoint.save_last=True")
+    run(expl)
+    ckpt = _latest_ckpt(f"{tmp_path}/logs")
+    run(
+        _std_args(tmp_path, f"{algo}_finetuning", extra=fast)
+        + [f"checkpoint.exploration_ckpt_path={ckpt}"]
+    )
+
+
+def test_p2e_dv3_exploration_two_devices(tmp_path):
+    run(_std_args(tmp_path, "p2e_dv3_exploration", devices=2, extra=P2E_DV3_FAST))
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
