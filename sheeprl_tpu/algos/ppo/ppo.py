@@ -49,7 +49,7 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 __all__ = ["main", "make_train_step"]
 
 
-def make_train_step(agent, tx, cfg, mesh, local_batch: int):
+def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True):
     """Build the fully-jitted optimization step (see module docstring)."""
     mb_size = int(cfg.algo.per_rank_batch_size)
     n_mb = max(1, -(-local_batch // mb_size))
@@ -116,7 +116,10 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int):
         out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shard_train, donate_argnums=(0, 1))
+    # The decoupled topology disables donation: the player thread still reads
+    # the previous params snapshot while the trainer steps (see
+    # ppo_decoupled.py), and donated buffers would be deleted under it.
+    return jax.jit(shard_train, donate_argnums=(0, 1) if donate else ())
 
 
 @register_algorithm()

@@ -1,0 +1,353 @@
+"""PPO — decoupled player/trainer topology
+(reference: ``sheeprl/algos/ppo/ppo_decoupled.py:623-666``).
+
+The reference dedicates rank-0 to env interaction (the *player*) and ranks
+1..N-1 to a DDP trainer group, moving rollouts as scattered python objects
+and parameters as a broadcast flat vector over NCCL/Gloo. On TPU the
+idiomatic mapping (SURVEY §7 "decoupled topology") is a SINGLE process:
+
+- the *player* is a host thread: env stepping + the jitted policy forward +
+  jitted GAE, completely off the training mesh's critical path;
+- the *trainer* consumes finished rollouts from a bounded queue and runs the
+  SAME fully-jitted ``shard_map`` optimization step as coupled PPO over the
+  device mesh;
+- the object scatter becomes the queue (host RAM), the param-vector
+  broadcast becomes an atomic swap of the params pytree reference — the
+  player's next rollout picks up the freshest published weights, giving the
+  same one-iteration policy lag as the reference topology.
+
+Checkpointing exercises the decoupled hooks: periodic checkpoints are saved
+by the player via ``on_checkpoint_player`` (state assembled by the trainer,
+handed over in-process); the final checkpoint is saved by the trainer via
+``on_checkpoint_trainer`` after the player has exited.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import queue
+import threading
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.ppo import make_train_step
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.ops import gae as gae_op
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, build_aggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+__all__ = ["main"]
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
+    initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
+
+    rank = fabric.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_state(cfg.checkpoint.resume_from)
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if fabric.is_global_zero:
+        logger.log_hyperparams(cfg)
+    print(f"Log dir: {log_dir}")
+
+    envs = vectorize_env(cfg, cfg.seed, rank, log_dir if rank == 0 else None, prefix="train")
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError(
+            "You should specify at least one CNN keys or MLP keys from the cli: "
+            "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
+        )
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    agent, params, player = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space,
+        state["agent"] if state is not None else None,
+    )
+
+    from sheeprl_tpu.optim.builders import build_optimizer
+
+    lr0 = float(cfg.algo.optimizer.lr)
+    tx = optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=lr0)
+    opt_state = tx.init(params)
+    if state is not None:
+        opt_state = jax.tree.map(
+            lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, opt_state, state["optimizer"]
+        )
+    opt_state = fabric.put_replicated(opt_state)
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = build_aggregator(cfg.metric.aggregator)
+
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        cfg.env.num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=obs_keys,
+    )
+
+    start_iter = state["iter_num"] + 1 if state is not None else 1
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    local_batch_global = cfg.algo.rollout_steps * cfg.env.num_envs
+    if local_batch_global % fabric.world_size != 0:
+        raise ValueError(
+            f"rollout_steps*num_envs ({local_batch_global}) must be divisible by the number of devices "
+            f"({fabric.world_size})"
+        )
+    train_fn = make_train_step(agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size, donate=False)
+    gae_fn = jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
+
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    # ------------------------------------------------------------------
+    # Decoupled topology: player thread + trainer loop (module docstring)
+    # ------------------------------------------------------------------
+    rollout_q: "queue.Queue" = queue.Queue(maxsize=2)
+    ckpt_q: "queue.Queue" = queue.Queue()
+    param_box = {"params": params}  # published weights; swapped atomically by the trainer
+    player_errors: list = []
+
+    def player_fn() -> None:
+        policy_step = state["iter_num"] * policy_steps_per_iter if state is not None else 0
+        try:
+            step_data: Dict[str, np.ndarray] = {}
+            next_obs = envs.reset(seed=cfg.seed)[0]
+            for k in obs_keys:
+                step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+            rng = jax.random.PRNGKey(cfg.seed)
+
+            for iter_num in range(start_iter, total_iters + 1):
+                p_snapshot = param_box["params"]
+                ep_infos = []
+                for _ in range(0, cfg.algo.rollout_steps):
+                    policy_step += cfg.env.num_envs
+                    jobs = prepare_obs(fabric, next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                    rng, env_actions, actions_np, logprobs, values = player.rollout_step(p_snapshot, rng, jobs)
+                    real_actions = np.asarray(env_actions)
+                    actions_np = np.asarray(actions_np)
+
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0 and "final_obs" in info:
+                        real_next_obs = {
+                            k: np.stack(
+                                [np.asarray(info["final_obs"][te][k], dtype=np.float32) for te in truncated_envs]
+                            )
+                            for k in obs_keys
+                        }
+                        jnext = prepare_obs(fabric, real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                        vals = np.asarray(player.get_values(p_snapshot, jnext))
+                        rewards = rewards.astype(np.float32)
+                        rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
+                    dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
+                    rewards = np.asarray(rewards, dtype=np.float32).reshape(cfg.env.num_envs, -1)
+
+                    step_data["dones"] = dones[np.newaxis]
+                    step_data["values"] = np.asarray(values)[np.newaxis]
+                    step_data["actions"] = actions_np[np.newaxis]
+                    step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+                    step_data["rewards"] = rewards[np.newaxis]
+                    if cfg.buffer.memmap:
+                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                    next_obs = {}
+                    for k in obs_keys:
+                        _obs = np.asarray(obs[k])
+                        step_data[k] = _obs[np.newaxis]
+                        next_obs[k] = _obs
+
+                    if cfg.metric.log_level > 0 and "final_info" in info:
+                        ep_info = info["final_info"]
+                        if isinstance(ep_info, dict) and "episode" in ep_info:
+                            mask = ep_info.get(
+                                "_episode", np.ones_like(np.asarray(ep_info["episode"]["r"]), dtype=bool)
+                            )
+                            rews = np.asarray(ep_info["episode"]["r"])[mask]
+                            lens = np.asarray(ep_info["episode"]["l"])[mask]
+                            ep_infos.extend(zip(rews.tolist(), lens.tolist()))
+
+                # GAE on the player (reference: ppo_decoupled.py:264-292)
+                local_data = rb.to_tensor()
+                jobs = prepare_obs(fabric, next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                next_values = player.get_values(p_snapshot, jobs)
+                returns, advantages = gae_fn(
+                    local_data["rewards"], local_data["values"], local_data["dones"], next_values
+                )
+                local_data["returns"] = np.asarray(returns)
+                local_data["advantages"] = np.asarray(advantages)
+                flat_data = {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()}
+
+                rollout_q.put({"iter_num": iter_num, "data": flat_data, "ep_infos": ep_infos,
+                               "policy_step": policy_step})
+
+                # Player-side checkpoint save with trainer-provided state
+                # (reference: ppo_decoupled.py:334-343)
+                while not ckpt_q.empty():
+                    req = ckpt_q.get_nowait()
+                    fabric.call("on_checkpoint_player", ckpt_path=req["ckpt_path"], state=req["state"])
+            rollout_q.put(None)
+        except BaseException as e:  # surface crashes to the trainer
+            player_errors.append(e)
+            rollout_q.put(None)
+
+    player_thread = threading.Thread(target=player_fn, name="ppo-player", daemon=True)
+    player_thread.start()
+
+    lr = lr0
+    clip_coef = float(cfg.algo.clip_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+    rng_train = jax.random.PRNGKey(cfg.seed + 1)
+    params_live, opt_live = params, opt_state
+    last_item = None
+
+    while True:
+        item = rollout_q.get()
+        if item is None:
+            break
+        last_item = item
+        iter_num = item["iter_num"]
+        policy_step = item["policy_step"]
+
+        flat_data = fabric.shard_data(item["data"])
+        rng_train, train_key = jax.random.split(rng_train)
+        params_live, opt_live, pg_l, v_l, ent_l = train_fn(
+            params_live, opt_live, flat_data, train_key,
+            jnp.asarray(clip_coef, dtype=jnp.float32), jnp.asarray(ent_coef, dtype=jnp.float32),
+        )
+        # "broadcast" the fresh parameters to the player (reference: :302-305)
+        param_box["params"] = params_live
+
+        if aggregator and not aggregator.disabled:
+            aggregator.update("Loss/policy_loss", pg_l)
+            aggregator.update("Loss/value_loss", v_l)
+            aggregator.update("Loss/entropy_loss", ent_l)
+            for ep_rew, ep_len in item["ep_infos"]:
+                if "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_dict(aggregator.compute(), policy_step)
+                aggregator.reset()
+            logger.log_dict(
+                {"Info/learning_rate": lr, "Info/clip_coef": clip_coef, "Info/ent_coef": ent_coef}, policy_step
+            )
+            last_log = policy_step
+
+        if cfg.algo.anneal_lr:
+            lr = polynomial_decay(iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0)
+            opt_live.hyperparams["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        if cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every:
+            last_checkpoint = policy_step
+            ckpt_q.put(
+                {
+                    "ckpt_path": os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt"),
+                    "state": {
+                        "agent": params_live,
+                        "optimizer": opt_live,
+                        "scheduler": None,
+                        "iter_num": iter_num,
+                        "batch_size": cfg.algo.per_rank_batch_size,
+                        "last_log": last_log,
+                        "last_checkpoint": last_checkpoint,
+                    },
+                }
+            )
+
+    player_thread.join()
+    if player_errors:
+        raise player_errors[0]
+    # Requests enqueued after the player's last rollout are saved here
+    while not ckpt_q.empty():
+        req = ckpt_q.get_nowait()
+        fabric.call("on_checkpoint_player", ckpt_path=req["ckpt_path"], state=req["state"])
+
+    # Final checkpoint by the trainer (reference: ppo_decoupled.py:609-621)
+    if cfg.checkpoint.save_last and last_item is not None:
+        ckpt_state = {
+            "agent": params_live,
+            "optimizer": opt_live,
+            "scheduler": None,
+            "iter_num": last_item["iter_num"],
+            "batch_size": cfg.algo.per_rank_batch_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+        }
+        ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{last_item['policy_step']}_{rank}.ckpt")
+        fabric.call("on_checkpoint_trainer", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, params_live, fabric, cfg, log_dir, writer=logger)
+    logger.close()

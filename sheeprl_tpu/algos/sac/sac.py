@@ -44,7 +44,7 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 __all__ = ["main", "make_train_step"]
 
 
-def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh):
+def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, donate: bool = True):
     """Build the fully-jitted G-gradient-step update (see module docstring).
 
     Inputs at call time: ``data`` pytree shaped ``(G, B, ...)`` with the batch
@@ -120,7 +120,8 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh):
         out_specs=(P(), P(), P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shard_train, donate_argnums=(0, 1, 2, 3))
+    # See ppo.make_train_step: the decoupled player still reads old snapshots.
+    return jax.jit(shard_train, donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
 @register_algorithm()

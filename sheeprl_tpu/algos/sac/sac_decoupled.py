@@ -1,0 +1,323 @@
+"""SAC — decoupled player/trainer topology
+(reference: ``sheeprl/algos/sac/sac_decoupled.py:547-640``).
+
+Same TPU-native mapping as decoupled PPO (one process, player thread +
+trainer mesh — see ``algos/ppo/ppo_decoupled.py``), with the off-policy
+specifics of the reference topology:
+
+- the player owns the REPLAY BUFFER and the ``Ratio`` replay governor: it
+  samples the granted ``G`` batches host-side and ships them through the
+  queue (the reference's ``scatter_object_list`` of sampled chunks);
+- the trainer runs the coupled SAC scanned G-step update and publishes the
+  refreshed params for the player's next action selections;
+- periodic checkpoints are saved by the player (``on_checkpoint_player``,
+  buffer + ratio attached); the final one by the trainer
+  (``on_checkpoint_trainer``).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import queue
+import threading
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import make_train_step
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, build_aggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+__all__ = ["main"]
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    rank = fabric.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_state(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if fabric.is_global_zero:
+        logger.log_hyperparams(cfg)
+    print(f"Log dir: {log_dir}")
+
+    envs = vectorize_env(cfg, cfg.seed, rank, log_dir if rank == 0 else None, prefix="train")
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+
+    agent, params, player = build_agent(
+        fabric, cfg, observation_space, action_space, state["agent"] if state is not None else None
+    )
+
+    critic_tx = build_optimizer(cfg.algo.critic.optimizer)
+    actor_tx = build_optimizer(cfg.algo.actor.optimizer)
+    alpha_tx = build_optimizer(cfg.algo.alpha.optimizer)
+    copt = critic_tx.init(params["critic"])
+    aopt = actor_tx.init(params["actor"])
+    lopt = alpha_tx.init(params["log_alpha"])
+    if state is not None:
+        aopt = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, aopt, state["actor_optimizer"])
+        copt = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, copt, state["qf_optimizer"])
+        lopt = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, lopt, state["alpha_optimizer"])
+    aopt, copt, lopt = (fabric.put_replicated(o) for o in (aopt, copt, lopt))
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = build_aggregator(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs) if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        cfg.env.num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=("observations",),
+    )
+    if state is not None and cfg.buffer.checkpoint:
+        if isinstance(state["rb"], list):
+            rb = state["rb"][0]
+        elif isinstance(state["rb"], ReplayBuffer):
+            rb = state["rb"]
+        else:
+            raise RuntimeError(f"Cannot restore the replay buffer from {type(state['rb'])}")
+
+    start_iter = state["iter_num"] + 1 if state is not None else 1
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state is not None:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size)
+    if batch_size % fabric.world_size != 0:
+        raise ValueError(
+            f"per_rank_batch_size ({batch_size}) must be divisible by the number of devices ({fabric.world_size})"
+        )
+    train_fn = make_train_step(agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=False)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_sharding = NamedSharding(fabric.mesh, P(None, "dp"))
+    ema_modulus = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    # ------------------------------------------------------------------
+    # Decoupled topology: player thread + trainer loop (module docstring)
+    # ------------------------------------------------------------------
+    batch_q: "queue.Queue" = queue.Queue(maxsize=2)
+    ckpt_q: "queue.Queue" = queue.Queue()
+    param_box = {"params": params}
+    player_errors: list = []
+
+    def player_fn() -> None:
+        policy_step = state["iter_num"] * policy_steps_per_iter if state is not None else 0
+        try:
+            rng = jax.random.PRNGKey(cfg.seed)
+            step_data: Dict[str, np.ndarray] = {}
+            obs = envs.reset(seed=cfg.seed)[0]
+
+            for iter_num in range(start_iter, total_iters + 1):
+                policy_step += policy_steps_per_iter
+                ep_infos = []
+                if iter_num <= learning_starts:
+                    actions = envs.action_space.sample()
+                else:
+                    jobs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    rng, subkey = jax.random.split(rng)
+                    actions = np.asarray(player(param_box["params"], jobs, subkey))
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(cfg.env.num_envs, -1)
+
+                if cfg.metric.log_level > 0 and "final_info" in infos:
+                    ep_info = infos["final_info"]
+                    if isinstance(ep_info, dict) and "episode" in ep_info:
+                        mask = ep_info.get("_episode", np.ones_like(np.asarray(ep_info["episode"]["r"]), dtype=bool))
+                        rews = np.asarray(ep_info["episode"]["r"])[mask]
+                        lens = np.asarray(ep_info["episode"]["l"])[mask]
+                        ep_infos.extend(zip(rews.tolist(), lens.tolist()))
+
+                step_data["terminated"] = np.asarray(terminated, dtype=np.uint8).reshape(1, cfg.env.num_envs, -1)
+                step_data["truncated"] = np.asarray(truncated, dtype=np.uint8).reshape(1, cfg.env.num_envs, -1)
+                step_data["actions"] = np.asarray(actions, dtype=np.float32).reshape(1, cfg.env.num_envs, -1)
+                step_data["observations"] = np.concatenate(
+                    [np.asarray(obs[k], dtype=np.float32) for k in mlp_keys], axis=-1
+                ).reshape(1, cfg.env.num_envs, -1)
+                if not cfg.buffer.sample_next_obs:
+                    real_next_obs = copy.deepcopy(next_obs)
+                    if "final_obs" in infos:
+                        for idx, final_obs in enumerate(infos["final_obs"]):
+                            if final_obs is not None:
+                                for k, v in final_obs.items():
+                                    real_next_obs[k][idx] = v
+                    step_data["next_observations"] = np.concatenate(
+                        [np.asarray(real_next_obs[k], dtype=np.float32) for k in mlp_keys], axis=-1
+                    ).reshape(1, cfg.env.num_envs, -1)
+                step_data["rewards"] = rewards[np.newaxis]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                obs = next_obs
+
+                # The player samples and ships the granted batches
+                # (reference: sac_decoupled.py:281-299)
+                if iter_num >= learning_starts:
+                    per_rank_gradient_steps = ratio(policy_step - prefill_steps + policy_steps_per_iter)
+                    if per_rank_gradient_steps > 0:
+                        sample = rb.sample(
+                            batch_size=batch_size,
+                            n_samples=per_rank_gradient_steps,
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                        )
+                        batch_q.put(
+                            {
+                                "iter_num": iter_num,
+                                "policy_step": policy_step,
+                                "data": sample,
+                                "ep_infos": ep_infos,
+                            }
+                        )
+                        ep_infos = []
+
+                while not ckpt_q.empty():
+                    req = ckpt_q.get_nowait()
+                    fabric.call(
+                        "on_checkpoint_player",
+                        ckpt_path=req["ckpt_path"],
+                        state=req["state"],
+                        replay_buffer=rb if cfg.buffer.checkpoint else None,
+                        ratio_state_dict=ratio.state_dict(),
+                    )
+            batch_q.put(None)
+        except BaseException as e:
+            player_errors.append(e)
+            batch_q.put(None)
+
+    player_thread = threading.Thread(target=player_fn, name="sac-player", daemon=True)
+    player_thread.start()
+
+    rng_train = jax.random.PRNGKey(cfg.seed + 1)
+    params_live, aopt_live, copt_live, lopt_live = params, aopt, copt, lopt
+    last_item = None
+
+    while True:
+        item = batch_q.get()
+        if item is None:
+            break
+        last_item = item
+        iter_num = item["iter_num"]
+        policy_step = item["policy_step"]
+
+        data = {k: jax.device_put(np.asarray(v, dtype=np.float32), data_sharding) for k, v in item["data"].items()}
+        rng_train, train_key = jax.random.split(rng_train)
+        ema_flag = jnp.float32(1.0 if iter_num % ema_modulus == 0 else 0.0)
+        params_live, aopt_live, copt_live, lopt_live, qf_l, a_l, al_l = train_fn(
+            params_live, aopt_live, copt_live, lopt_live, data, train_key, ema_flag
+        )
+        param_box["params"] = params_live
+
+        if aggregator and not aggregator.disabled:
+            aggregator.update("Loss/value_loss", qf_l)
+            aggregator.update("Loss/policy_loss", a_l)
+            aggregator.update("Loss/alpha_loss", al_l)
+            for ep_rew, ep_len in item["ep_infos"]:
+                if "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_dict(aggregator.compute(), policy_step)
+                aggregator.reset()
+            last_log = policy_step
+
+        if cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every:
+            last_checkpoint = policy_step
+            ckpt_q.put(
+                {
+                    "ckpt_path": os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt"),
+                    "state": {
+                        "agent": params_live,
+                        "qf_optimizer": copt_live,
+                        "actor_optimizer": aopt_live,
+                        "alpha_optimizer": lopt_live,
+                        "iter_num": iter_num,
+                        "batch_size": batch_size,
+                        "last_log": last_log,
+                        "last_checkpoint": last_checkpoint,
+                    },
+                }
+            )
+
+    player_thread.join()
+    if player_errors:
+        raise player_errors[0]
+    # Requests enqueued after the player's last rollout are saved here
+    while not ckpt_q.empty():
+        req = ckpt_q.get_nowait()
+        fabric.call(
+            "on_checkpoint_player",
+            ckpt_path=req["ckpt_path"],
+            state=req["state"],
+            replay_buffer=rb if cfg.buffer.checkpoint else None,
+            ratio_state_dict=ratio.state_dict(),
+        )
+
+    if cfg.checkpoint.save_last and last_item is not None:
+        ckpt_state = {
+            "agent": params_live,
+            "qf_optimizer": copt_live,
+            "actor_optimizer": aopt_live,
+            "alpha_optimizer": lopt_live,
+            "ratio": ratio.state_dict(),
+            "iter_num": last_item["iter_num"],
+            "batch_size": batch_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+        }
+        ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{last_item['policy_step']}_{rank}.ckpt")
+        fabric.call("on_checkpoint_trainer", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, params_live, fabric, cfg, log_dir, writer=logger)
+    logger.close()

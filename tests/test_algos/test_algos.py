@@ -292,6 +292,36 @@ def test_p2e_dv3_exploration_two_devices(tmp_path):
     run(_std_args(tmp_path, "p2e_dv3_exploration", devices=2, extra=P2E_DV3_FAST))
 
 
+@pytest.mark.parametrize("devices", [1, 2])
+def test_ppo_decoupled_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "ppo_decoupled", devices=devices, extra=PPO_FAST))
+
+
+def test_ppo_decoupled_multi_iteration(tmp_path):
+    """Several player/trainer exchanges + a periodic player-side checkpoint
+    (the decoupled topology's param-publish and on_checkpoint_player paths)."""
+    args = _std_args(tmp_path, "ppo_decoupled", extra=PPO_FAST)
+    args.remove("dry_run=True")
+    args.remove("checkpoint.save_last=False")
+    args += ["algo.total_steps=64", "checkpoint.every=32", "checkpoint.save_last=True"]
+    run(args)
+    import glob
+
+    assert len(glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)) >= 2
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_sac_decoupled_dry_run(tmp_path, devices):
+    run(
+        _std_args(
+            tmp_path,
+            "sac_decoupled",
+            devices=devices,
+            extra=["env.id=continuous_dummy", "algo.mlp_keys.encoder=[state]", "algo.per_rank_batch_size=4"],
+        )
+    )
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
