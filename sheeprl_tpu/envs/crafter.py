@@ -1,0 +1,62 @@
+"""Crafter backend (reference: ``sheeprl/envs/crafter.py:17-66``)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError("crafter is not installed; install it to use the Crafter environments")
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+__all__ = ["CrafterWrapper"]
+
+
+class CrafterWrapper(gym.Env):
+    """Crafter as a gymnasium env with a ``{"rgb": ...}`` dict observation.
+
+    ``id`` selects the reward variant: ``crafter_reward`` or
+    ``crafter_nonreward``. Termination vs truncation follows the env's
+    ``info["discount"]`` (0 at a true death).
+    """
+
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(self, id: str, screen_size: Union[Sequence[int], int], seed: Optional[int] = None) -> None:
+        import crafter
+
+        if id not in {"crafter_reward", "crafter_nonreward"}:
+            raise ValueError(f"Unknown crafter id: {id}")
+        if isinstance(screen_size, int):
+            screen_size = (screen_size,) * 2
+        self._env = crafter.Env(size=tuple(screen_size), seed=seed, reward=(id == "crafter_reward"))
+
+        inner = self._env.observation_space
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = spaces.Discrete(self._env.action_space.n)
+        self.reward_range = getattr(self._env, "reward_range", None) or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self.render_mode = "rgb_array"
+
+    def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        obs, reward, done, info = self._env.step(action)
+        terminated = done and info["discount"] == 0
+        return {"rgb": obs}, reward, terminated, done and not terminated, info
+
+    def reset(self, *, seed=None, options=None) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        if seed is not None:
+            self._env._seed = seed
+        return {"rgb": self._env.reset()}, {}
+
+    def render(self):
+        return self._env.render()
+
+    def close(self) -> None:
+        pass

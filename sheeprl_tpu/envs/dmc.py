@@ -1,0 +1,177 @@
+"""DeepMind Control Suite backend (reference: ``sheeprl/envs/dmc.py:49-280``,
+itself adapted from dmc2gym).
+
+Differences from the reference: implemented as a plain :class:`gym.Env`
+around the dm_env task (the reference subclasses ``gym.Wrapper`` over a
+non-gym object), and pixels are CHANNEL-LAST by default — the repo's conv
+layout. Actions are normalized to [-1, 1] and rescaled to the task's true
+bounds per step.
+"""
+
+from __future__ import annotations
+
+import os
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    raise ModuleNotFoundError(
+        "dm_control is not installed; install it to use the DMC environments"
+    )
+
+# Headless pixel rendering needs a GL backend chosen before mujoco loads;
+# EGL is the one that works on GPU-less/TPU hosts.
+os.environ.setdefault("MUJOCO_GL", "egl")
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+__all__ = ["DMCWrapper"]
+
+
+def _spec_to_box(spec_list, dtype) -> spaces.Box:
+    """Concatenate dm_env specs into one flat Box (reference: ``dmc.py:17-39``)."""
+    from dm_env import specs
+
+    mins, maxs = [], []
+    for s in spec_list:
+        dim = int(np.prod(s.shape))
+        if type(s) is specs.BoundedArray:
+            zeros = np.zeros(dim, dtype=np.float32)
+            mins.append(np.broadcast_to(s.minimum, (dim,)) + zeros)
+            maxs.append(np.broadcast_to(s.maximum, (dim,)) + zeros)
+        elif type(s) is specs.Array:
+            bound = np.inf * np.ones(dim, dtype=np.float32)
+            mins.append(-bound)
+            maxs.append(bound)
+        else:
+            raise ValueError(f"Unrecognized spec: {type(s)}")
+    low = np.concatenate(mins, axis=0).astype(dtype)
+    high = np.concatenate(maxs, axis=0).astype(dtype)
+    return spaces.Box(low, high, dtype=dtype)
+
+
+def _flatten_obs(obs: Dict[Any, Any]) -> np.ndarray:
+    pieces = [np.array([v]) if np.isscalar(v) else np.asarray(v).ravel() for v in obs.values()]
+    return np.concatenate(pieces, axis=0)
+
+
+class DMCWrapper(gym.Env):
+    """dm_control task as a gymnasium env with dict observations.
+
+    Observation keys: ``rgb`` (H, W, 3 uint8, when ``from_pixels``) and/or
+    ``state`` (flat float64 vector, when ``from_vectors``).
+    """
+
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        domain_name: str,
+        task_name: str,
+        from_pixels: bool = False,
+        from_vectors: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        task_kwargs: Optional[Dict[Any, Any]] = None,
+        environment_kwargs: Optional[Dict[Any, Any]] = None,
+        channels_first: bool = False,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        from dm_control import suite
+
+        if not (from_vectors or from_pixels):
+            raise ValueError(
+                "'from_vectors' and 'from_pixels' must not be both False: "
+                f"got {from_vectors} and {from_pixels} respectively."
+            )
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._channels_first = channels_first
+
+        task_kwargs = dict(task_kwargs or {})
+        task_kwargs.pop("random", None)
+        if seed is not None:
+            task_kwargs["random"] = seed
+        self._env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+
+        self._true_action_space = _spec_to_box([self._env.action_spec()], np.float32)
+        self.action_space = spaces.Box(-1.0, 1.0, self._true_action_space.shape, np.float32)
+
+        reward_space = _spec_to_box([self._env.reward_spec()], np.float32)
+        self.reward_range = (reward_space.low.item(), reward_space.high.item())
+
+        obs_space: Dict[str, spaces.Space] = {}
+        if from_pixels:
+            shape = (3, height, width) if channels_first else (height, width, 3)
+            obs_space["rgb"] = spaces.Box(0, 255, shape, np.uint8)
+        if from_vectors:
+            obs_space["state"] = _spec_to_box(self._env.observation_spec().values(), np.float64)
+        self.observation_space = spaces.Dict(obs_space)
+        self.state_space = _spec_to_box(self._env.observation_spec().values(), np.float64)
+
+        self.render_mode = "rgb_array"
+        self.current_state: Optional[np.ndarray] = None
+        self.seed(seed)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._true_action_space.seed(seed)
+        self.action_space.seed(seed)
+        self.observation_space.seed(seed)
+
+    def _denormalize_action(self, action: np.ndarray) -> np.ndarray:
+        """[-1, 1] → the task's true bounds (reference: ``dmc.py:184-191``)."""
+        action = np.asarray(action, dtype=np.float64)
+        true, norm = self._true_action_space, self.action_space
+        scale = (true.high - true.low) / (norm.high - norm.low)
+        return ((action - norm.low) * scale + true.low).astype(np.float32)
+
+    def _get_obs(self, time_step) -> Dict[str, np.ndarray]:
+        obs: Dict[str, np.ndarray] = {}
+        if self._from_pixels:
+            frame = self.render()
+            if self._channels_first:
+                frame = frame.transpose(2, 0, 1).copy()
+            obs["rgb"] = frame
+        if self._from_vectors:
+            obs["state"] = _flatten_obs(time_step.observation)
+        return obs
+
+    def step(self, action) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        time_step = self._env.step(self._denormalize_action(action))
+        self.current_state = _flatten_obs(time_step.observation)
+        reward = float(time_step.reward or 0.0)
+        # dm_env: discount == 0 at true termination; the suite's time limit
+        # ends the episode with discount 1 → truncation
+        terminated = time_step.last() and time_step.discount == 0.0
+        truncated = time_step.last() and not terminated
+        return self._get_obs(time_step), reward, terminated, truncated, {"discount": time_step.discount}
+
+    def reset(self, *, seed=None, options=None) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        if seed is not None:
+            self.seed(seed)
+        time_step = self._env.reset()
+        self.current_state = _flatten_obs(time_step.observation)
+        return self._get_obs(time_step), {}
+
+    def render(self, camera_id: Optional[int] = None) -> np.ndarray:
+        return self._env.physics.render(
+            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
+        )
+
+    def close(self) -> None:
+        self._env.close()

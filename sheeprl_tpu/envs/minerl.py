@@ -1,0 +1,210 @@
+"""MineRL 0.4.x backend (reference: ``sheeprl/envs/minerl.py:48-340``).
+
+Flattens MineRL's dict action space into one Discrete catalogue (no-op +
+one entry per command value + 4 camera buckets), applies sticky attack/jump
+and pitch limits, and exposes per-item inventory/equipment vectors.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed; install minerl==0.4.4 to use the MineRL environments")
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+__all__ = ["MineRLWrapper"]
+
+_NOOP: Dict[str, Any] = {
+    "camera": (0, 0),
+    "forward": 0,
+    "back": 0,
+    "left": 0,
+    "right": 0,
+    "attack": 0,
+    "sprint": 0,
+    "jump": 0,
+    "sneak": 0,
+    "craft": "none",
+    "nearbyCraft": "none",
+    "nearbySmelt": "none",
+    "place": "none",
+    "equip": "none",
+}
+_CAMERA_DELTAS = (np.array([-15, 0]), np.array([15, 0]), np.array([0, -15]), np.array([0, 15]))
+
+
+class MineRLWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array", "human"]}
+
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        break_speed_multiplier: Optional[int] = 100,
+        multihot_inventory: bool = True,
+        **kwargs: Any,
+    ):
+        import minerl  # noqa: F401
+        import minerl.herobraine.hero.spaces as hero_spaces
+        from minerl.herobraine.hero import mc
+
+        from sheeprl_tpu.envs.minerl_envs.specs import (
+            CustomNavigate,
+            CustomObtainDiamond,
+            CustomObtainIronPickaxe,
+        )
+
+        custom_envs = {
+            "custom_navigate": CustomNavigate,
+            "custom_obtain_diamond": CustomObtainDiamond,
+            "custom_obtain_iron_pickaxe": CustomObtainIronPickaxe,
+        }
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        self._sticky_attack = 0 if (break_speed_multiplier or 1) > 1 else (sticky_attack or 0)
+        self._sticky_jump = sticky_jump or 0
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._multihot_inventory = multihot_inventory
+        if "navigate" not in id.lower():
+            kwargs.pop("extreme", None)
+        self._env = custom_envs[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
+
+        # Flatten the MineRL dict action space into one Discrete catalogue
+        # (reference: minerl.py:100-141)
+        self.actions_map: Dict[int, Dict[str, Any]] = {0: {}}
+        act_idx = 1
+        for act in self._env.action_space:
+            space = self._env.action_space[act]
+            if isinstance(space, hero_spaces.Enum):
+                values = sorted(set(space.values.tolist()) - {"none"})
+            elif act != "camera":
+                values = [1]
+            else:
+                values = list(_CAMERA_DELTAS)
+            for v in values:
+                entry = {act: v}
+                if act in {"jump", "sneak", "sprint"}:
+                    entry["forward"] = 1
+                self.actions_map[act_idx] = entry
+                act_idx += 1
+        self.action_space = gym.spaces.Discrete(len(self.actions_map))
+
+        n_all = len(mc.ALL_ITEMS)
+        if multihot_inventory:
+            self.inventory_size = n_all
+            self.inventory_item_to_id = dict(zip(mc.ALL_ITEMS, range(n_all)))
+        else:
+            inv_items = list(self._env.observation_space["inventory"])
+            self.inventory_size = len(inv_items)
+            self.inventory_item_to_id = dict(zip(inv_items, range(self.inventory_size)))
+        obs_space: Dict[str, gym.spaces.Space] = {
+            "rgb": gym.spaces.Box(0, 255, (height, width, 3), np.uint8),
+            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": gym.spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+            "max_inventory": gym.spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+        }
+        if "compass" in self._env.observation_space.spaces:
+            obs_space["compass"] = gym.spaces.Box(-180, 180, (1,), np.float32)
+        if "equipped_items" in self._env.observation_space.spaces:
+            if multihot_inventory:
+                self.equip_size = n_all
+                self.equip_item_to_id = dict(zip(mc.ALL_ITEMS, range(n_all)))
+            else:
+                equip_items = self._env.observation_space["equipped_items"]["mainhand"]["type"].values.tolist()
+                self.equip_size = len(equip_items)
+                self.equip_item_to_id = dict(zip(equip_items, range(self.equip_size)))
+            obs_space["equipment"] = gym.spaces.Box(0.0, 1.0, (self.equip_size,), np.int32)
+        self.observation_space = gym.spaces.Dict(obs_space)
+
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        self._max_inventory = np.zeros(self.inventory_size)
+        self.render_mode = "rgb_array"
+        self.seed(seed)
+
+    # -- conversions (reference: minerl.py:207-288) --------------------------
+    def _convert_action(self, action: np.ndarray) -> Dict[str, Any]:
+        converted = copy.deepcopy(_NOOP)
+        converted.update(self.actions_map[int(np.asarray(action).item())])
+        if self._sticky_attack:
+            if converted["attack"]:
+                self._sticky_attack_counter = self._sticky_attack
+            if self._sticky_attack_counter > 0:
+                converted["attack"] = 1
+                converted["jump"] = 0
+                self._sticky_attack_counter -= 1
+        if self._sticky_jump:
+            if converted["jump"]:
+                self._sticky_jump_counter = self._sticky_jump
+            if self._sticky_jump_counter > 0:
+                converted["jump"] = 1
+                converted["forward"] = 1
+                self._sticky_jump_counter -= 1
+        return converted
+
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        inv = np.zeros(self.inventory_size)
+        for item, quantity in inventory.items():
+            inv[self.inventory_item_to_id[item]] += 1 if item == "air" else quantity
+        self._max_inventory = np.maximum(inv, self._max_inventory)
+        return {"inventory": inv, "max_inventory": self._max_inventory.copy()}
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        equip = np.zeros(self.equip_size, dtype=np.int32)
+        equip[self.equip_item_to_id.get(equipment["mainhand"]["type"], self.equip_item_to_id["air"])] = 1
+        return equip
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        converted = {
+            "rgb": obs["pov"].copy(),
+            "life_stats": np.array(
+                [obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["air"]], dtype=np.float32
+            ),
+            **self._convert_inventory(obs["inventory"]),
+        }
+        if "equipment" in self.observation_space.spaces:
+            converted["equipment"] = self._convert_equipment(obs["equipped_items"])
+        if "compass" in self.observation_space.spaces:
+            converted["compass"] = np.asarray(obs["compass"]["angle"], dtype=np.float32).reshape(-1)
+        return converted
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    def step(self, action):
+        converted = self._convert_action(action)
+        next_pitch = self._pos["pitch"] + converted["camera"][0]
+        next_yaw = ((self._pos["yaw"] + converted["camera"][1]) + 180) % 360 - 180
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            converted["camera"] = np.array([0, converted["camera"][1]])
+            next_pitch = self._pos["pitch"]
+        obs, reward, done, info = self._env.step(converted)
+        self._pos = {"pitch": next_pitch, "yaw": next_yaw}
+        return self._convert_obs(obs), reward, done, False, info
+
+    def reset(self, *, seed=None, options=None):
+        obs = self._env.reset()
+        self._max_inventory = np.zeros(self.inventory_size)
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        return self._convert_obs(obs), {}
+
+    def render(self):
+        return self._env.render(self.render_mode)
+
+    def close(self):
+        self._env.close()

@@ -1,0 +1,257 @@
+"""Custom MineRL env specs (reference: ``sheeprl/envs/minerl_envs/
+{backend,navigate,obtain}.py``, themselves adapted from minerllabs/minerl).
+
+Data-driven reimplementation: the per-task handler lists (observables,
+actionables, rewards, server setup) are declared as tables and assembled by
+one spec class, instead of one subclass per task overriding each
+``create_*`` method. Time limits are intentionally NOT set on the specs —
+the framework's TimeLimit wrapper handles truncation so terminated vs
+truncated stay distinguishable (the reference does the same).
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed; install minerl==0.4.4 to use the MineRL environments")
+
+from typing import Any, Dict, List
+
+from minerl.herobraine.env_spec import EnvSpec
+from minerl.herobraine.hero import handler, handlers
+from minerl.herobraine.hero.mc import INVERSE_KEYMAP
+
+SIMPLE_KEYBOARD_ACTION = ["forward", "back", "left", "right", "jump", "sneak", "sprint", "attack"]
+
+_OBTAIN_INVENTORY = [
+    "dirt", "coal", "torch", "log", "planks", "stick", "crafting_table", "wooden_axe",
+    "wooden_pickaxe", "stone", "cobblestone", "furnace", "stone_axe", "stone_pickaxe",
+    "iron_ore", "iron_ingot", "iron_axe", "iron_pickaxe",
+]
+_OBTAIN_EQUIP = ["air", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe", "iron_axe", "iron_pickaxe"]
+_OBTAIN_PLACE = ["none", "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"]
+_OBTAIN_CRAFT = ["none", "torch", "stick", "planks", "crafting_table"]
+_OBTAIN_NEARBY_CRAFT = [
+    "none", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe", "iron_axe", "iron_pickaxe", "furnace",
+]
+_OBTAIN_SMELT = ["none", "iron_ingot", "coal"]
+
+# Cumulative milestone rewards shared by the obtain tasks
+# (reference: obtain.py:181-196, 260-273)
+_OBTAIN_REWARD_SCHEDULE = [
+    dict(type="log", amount=1, reward=1),
+    dict(type="planks", amount=1, reward=2),
+    dict(type="stick", amount=1, reward=4),
+    dict(type="crafting_table", amount=1, reward=4),
+    dict(type="wooden_pickaxe", amount=1, reward=8),
+    dict(type="cobblestone", amount=1, reward=16),
+    dict(type="furnace", amount=1, reward=32),
+    dict(type="stone_pickaxe", amount=1, reward=32),
+    dict(type="iron_ore", amount=1, reward=64),
+    dict(type="iron_ingot", amount=1, reward=128),
+    dict(type="iron_pickaxe", amount=1, reward=256),
+]
+
+
+class BreakSpeedMultiplier(handler.Handler):
+    """Server-side block-break speedup (reference: ``backend.py:52-61``,
+    adapted from danijar/diamond_env)."""
+
+    def __init__(self, multiplier=1.0):
+        self.multiplier = multiplier
+
+    def to_string(self):
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self):
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
+class _TableDrivenSpec(EnvSpec):
+    """One spec class for every custom task, driven by a ``spec`` dict."""
+
+    def __init__(self, name: str, spec: Dict[str, Any], resolution=(64, 64), break_speed: int = 100, **kwargs):
+        self.resolution = resolution
+        self.break_speed = break_speed
+        self._spec = spec
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(name, max_episode_steps=None, **kwargs)
+
+    # -- agent ----------------------------------------------------------------
+    def create_observables(self) -> List:
+        obs = [
+            handlers.POVObservation(self.resolution),
+            handlers.ObservationFromCurrentLocation(),
+            handlers.ObservationFromLifeStats(),
+        ]
+        if self._spec.get("compass"):
+            obs.append(handlers.CompassObservation(angle=True, distance=False))
+        if self._spec.get("inventory"):
+            obs.append(handlers.FlatInventoryObservation(self._spec["inventory"]))
+        if self._spec.get("equip"):
+            obs.append(
+                handlers.EquippedItemObservation(
+                    items=self._spec["equip"] + ["other"], _default="air", _other="other"
+                )
+            )
+        return obs
+
+    def create_actionables(self) -> List:
+        acts = [
+            handlers.KeybasedCommandAction(k, v) for k, v in INVERSE_KEYMAP.items() if k in SIMPLE_KEYBOARD_ACTION
+        ] + [handlers.CameraAction()]
+        if self._spec.get("place"):
+            acts.append(handlers.PlaceBlock(self._spec["place"], _other="none", _default="none"))
+        if self._spec.get("craft"):
+            acts.append(handlers.EquipAction(["none"] + self._spec["equip"], _other="none", _default="none"))
+            acts.append(handlers.CraftAction(self._spec["craft"], _other="none", _default="none"))
+            acts.append(handlers.CraftNearbyAction(self._spec["nearby_craft"], _other="none", _default="none"))
+            acts.append(handlers.SmeltItemNearby(self._spec["smelt"], _other="none", _default="none"))
+        return acts
+
+    def create_rewardables(self) -> List:
+        return self._spec["rewards"](self._spec)
+
+    def create_agent_start(self) -> List:
+        start = [BreakSpeedMultiplier(self.break_speed)]
+        for item in self._spec.get("start_inventory", []):
+            start.append(handlers.SimpleInventoryAgentStart([item]))
+        return start
+
+    def create_agent_handlers(self) -> List:
+        return self._spec.get("agent_handlers", lambda s: [])(self._spec)
+
+    def create_monitors(self) -> List:
+        return []
+
+    # -- server ---------------------------------------------------------------
+    def create_server_world_generators(self) -> List:
+        if self._spec.get("extreme"):
+            return [handlers.BiomeGenerator(biome=3, force_reset=True)]
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List:
+        return self._spec.get("server_decorators", lambda s: [])(self._spec)
+
+    def create_server_initial_conditions(self) -> List:
+        if self._spec.get("frozen_time"):
+            return [
+                handlers.TimeInitialCondition(allow_passage_of_time=False, start_time=6000),
+                handlers.WeatherInitialCondition("clear"),
+                handlers.SpawningInitialCondition("false"),
+            ]
+        return [
+            handlers.TimeInitialCondition(start_time=6000, allow_passage_of_time=True),
+            handlers.SpawningInitialCondition(allow_spawning=True),
+        ]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == self._spec.get("folder", "none")
+
+    def get_docstring(self):
+        return self._spec.get("doc", "")
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        return sum(rewards) >= self._spec.get("success_threshold", 0.0)
+
+
+def _navigate_rewards(spec):
+    rews = [
+        handlers.RewardForTouchingBlockType(
+            [{"type": "diamond_block", "behaviour": "onceOnly", "reward": 100.0}]
+        )
+    ]
+    if spec.get("dense"):
+        rews.append(handlers.RewardForDistanceTraveledToCompassTarget(reward_per_block=1.0))
+    return rews
+
+
+def _obtain_rewards(spec):
+    reward_handler = (
+        handlers.RewardForCollectingItems if spec.get("dense") else handlers.RewardForCollectingItemsOnce
+    )
+    return [reward_handler(spec["schedule"])]
+
+
+def _navigate_decorators(spec):
+    return [
+        handlers.NavigationDecorator(
+            max_randomized_radius=64,
+            min_randomized_radius=64,
+            block="diamond_block",
+            placement="surface",
+            max_radius=8,
+            min_radius=0,
+            max_randomized_distance=8,
+            min_randomized_distance=0,
+            randomize_compass_location=True,
+        )
+    ]
+
+
+class CustomNavigate(_TableDrivenSpec):
+    """(reference: ``navigate.py:18-96``)"""
+
+    def __init__(self, dense: bool = False, extreme: bool = False, **kwargs):
+        suffix = ("Extreme" if extreme else "") + ("Dense" if dense else "")
+        spec = {
+            "dense": dense,
+            "extreme": extreme,
+            "compass": True,
+            "inventory": ["dirt"],
+            "place": ["none", "dirt"],
+            "rewards": _navigate_rewards,
+            "start_inventory": [dict(type="compass", quantity="1")],
+            "agent_handlers": lambda s: [handlers.AgentQuitFromTouchingBlockType(["diamond_block"])],
+            "server_decorators": _navigate_decorators,
+            "frozen_time": True,
+            "folder": "navigateextreme" if extreme else "navigate",
+            "success_threshold": 160.0 if dense else 100.0,
+        }
+        super().__init__(f"CustomMineRLNavigate{suffix}-v0", spec, **kwargs)
+
+
+class CustomObtainDiamond(_TableDrivenSpec):
+    """(reference: ``obtain.py:172-249``)"""
+
+    def __init__(self, dense: bool = False, **kwargs):
+        spec = {
+            "dense": dense,
+            "inventory": _OBTAIN_INVENTORY,
+            "equip": _OBTAIN_EQUIP,
+            "place": _OBTAIN_PLACE,
+            "craft": _OBTAIN_CRAFT,
+            "nearby_craft": _OBTAIN_NEARBY_CRAFT,
+            "smelt": _OBTAIN_SMELT,
+            "schedule": _OBTAIN_REWARD_SCHEDULE + [dict(type="diamond", amount=1, reward=1024)],
+            "rewards": _obtain_rewards,
+            "agent_handlers": lambda s: [handlers.AgentQuitFromPossessingItem([dict(type="diamond", amount=1)])],
+            "folder": "o_diamond",
+            "success_threshold": 1024.0,
+        }
+        super().__init__(f"CustomMineRLObtainDiamond{'Dense' if dense else ''}-v0", spec, **kwargs)
+
+
+class CustomObtainIronPickaxe(_TableDrivenSpec):
+    """(reference: ``obtain.py:251-326``)"""
+
+    def __init__(self, dense: bool = False, **kwargs):
+        spec = {
+            "dense": dense,
+            "inventory": _OBTAIN_INVENTORY,
+            "equip": _OBTAIN_EQUIP,
+            "place": _OBTAIN_PLACE,
+            "craft": _OBTAIN_CRAFT,
+            "nearby_craft": _OBTAIN_NEARBY_CRAFT,
+            "smelt": _OBTAIN_SMELT,
+            "schedule": _OBTAIN_REWARD_SCHEDULE,
+            "rewards": _obtain_rewards,
+            "agent_handlers": lambda s: [handlers.AgentQuitFromCraftingItem([dict(type="iron_pickaxe", amount=1)])],
+            "folder": "o_iron",
+            "success_threshold": 256.0,
+        }
+        super().__init__(f"CustomMineRLObtainIronPickaxe{'Dense' if dense else ''}-v0", spec, **kwargs)

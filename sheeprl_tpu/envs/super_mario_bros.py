@@ -1,0 +1,65 @@
+"""Super Mario Bros backend (reference: ``sheeprl/envs/super_mario_bros.py:26-73``)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_SUPER_MARIO_BROS_AVAILABLE
+
+if not _IS_SUPER_MARIO_BROS_AVAILABLE:
+    raise ModuleNotFoundError(
+        "gym_super_mario_bros is not installed; install it to use the Super Mario Bros environments"
+    )
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+__all__ = ["SuperMarioBrosWrapper"]
+
+
+class SuperMarioBrosWrapper(gym.Env):
+    """gym_super_mario_bros (old-gym API) as a gymnasium env with a
+    ``{"rgb": ...}`` dict observation and a joypad-restricted discrete action
+    space (``simple`` | ``right_only`` | ``complex``)."""
+
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
+        import gym_super_mario_bros as gsmb
+        from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+        from nes_py.wrappers import JoypadSpace
+
+        moves = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}[action_space]
+        env = gsmb.make(id)
+        env = JoypadSpace(env, moves)
+        self._env = env
+
+        self.render_mode = render_mode
+        inner = env.observation_space
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = spaces.Discrete(env.action_space.n)
+
+    def step(self, action) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        if isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, info = self._env.step(action)
+        is_timelimit = bool(info.get("time", False))
+        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(self, *, seed: Optional[int] = None, options=None) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        obs = self._env.reset()
+        if isinstance(obs, tuple):  # some nes_py versions return (obs, info)
+            obs = obs[0]
+        return {"rgb": np.asarray(obs).copy()}, {}
+
+    def render(self):
+        frame = self._env.render(mode=self.render_mode)
+        if self.render_mode == "rgb_array" and frame is not None:
+            return np.asarray(frame).copy()
+        return None
+
+    def close(self) -> None:
+        self._env.close()
