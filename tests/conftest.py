@@ -15,7 +15,21 @@ import jax  # noqa: E402
 # (the reference's LT_DEVICES analogue needs a local many-device mesh).
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the dreamer/p2e train steps take tens of
+# seconds to compile; caching them across test runs keeps the suite usable.
+_CACHE_DIR = os.environ.get("SHEEPRL_TPU_TEST_CACHE", "/tmp/sheeprl_tpu_xla_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-mark compile-heavy end-to-end tests as ``slow`` so the default
+    verification loop can run ``-m "not slow"`` in well under 5 minutes."""
+    for item in items:
+        if any(s in item.nodeid for s in ("dreamer", "p2e", "multi_iteration", "sac_ae", "droq")):
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture()
