@@ -60,6 +60,7 @@ def resume_from_checkpoint(cfg: DotDict) -> DotDict:
     old_cfg = copy.deepcopy(old_cfg)
     old_cfg.pop("root_dir", None)
     old_cfg.pop("run_name", None)
+    old_cfg.pop("log_root", None)  # repo-specific: keep the resumed run's own log tree
     old_cfg.get("checkpoint", {}).pop("resume_from", None)
     old_cfg.get("algo", {}).pop("learning_starts", None)
     merged = dict(cfg)
