@@ -503,8 +503,13 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     player.init_states(params)
 
+    from sheeprl_tpu.utils.profiler import TraceProfiler
+
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir)
+
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.tick(iter_num)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric):
@@ -682,6 +687,7 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
+    profiler.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, params, fabric, cfg, log_dir, greedy=False, writer=logger)
 

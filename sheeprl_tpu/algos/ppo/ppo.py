@@ -50,7 +50,15 @@ __all__ = ["main", "make_train_step"]
 
 
 def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True):
-    """Build the fully-jitted optimization step (see module docstring)."""
+    """Build the fully-jitted optimization step (see module docstring).
+
+    ``buffer.share_data`` (reference ``ppo.py:40-47,362-366``: all_gather +
+    DistributedSampler) maps to an in-graph ``lax.all_gather`` over ``dp``
+    followed by a COMMON permutation of the global batch, each device taking
+    its own contiguous shard per epoch — identical sampling semantics, but
+    the gather rides the mesh interconnect instead of NCCL.
+    """
+    share_data = bool(cfg.buffer.share_data)
     mb_size = int(cfg.algo.per_rank_batch_size)
     n_mb = max(1, -(-local_batch // mb_size))
     padded = n_mb * mb_size
@@ -91,10 +99,23 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True)
 
     def local_train(params, opt_state, data, key, clip_coef, ent_coef):
         # shapes here are per-device: (local_batch, ...)
-        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+        n_dev = jax.lax.axis_size("dp")
+        if share_data:
+            # every device sees the GLOBAL batch; the sampler key stays
+            # common across devices (the reference's same-seed
+            # DistributedSampler), each device slicing its own shard
+            data = jax.tree.map(lambda x: jax.lax.all_gather(x, "dp", tiled=True), data)
+        else:
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
 
         def epoch_body(carry, epoch_key):
-            perm = jax.random.permutation(epoch_key, local_batch)
+            if share_data:
+                perm = jax.random.permutation(epoch_key, local_batch * n_dev)
+                perm = jax.lax.dynamic_slice_in_dim(
+                    perm, jax.lax.axis_index("dp") * local_batch, local_batch
+                )
+            else:
+                perm = jax.random.permutation(epoch_key, local_batch)
             # cyclic pad up to a whole number of minibatches (handles
             # mb_size > local_batch, e.g. few envs over many devices)
             perm = jnp.resize(perm, (padded,))
@@ -258,7 +279,12 @@ def main(fabric, cfg: Dict[str, Any]):
 
     cnn_keys = cfg.algo.cnn_keys.encoder
 
+    from sheeprl_tpu.utils.profiler import TraceProfiler
+
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir)
+
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.tick(iter_num)
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs
 
@@ -397,6 +423,7 @@ def main(fabric, cfg: Dict[str, Any]):
             fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
 
     envs.close()
+    profiler.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(player, params, fabric, cfg, log_dir, writer=logger)
 

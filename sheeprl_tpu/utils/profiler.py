@@ -1,0 +1,59 @@
+"""Optional ``jax.profiler`` trace hooks (SURVEY §5: the TPU equivalent of
+the reference's timer-only instrumentation is the host-side SPS timers plus
+XLA trace capture).
+
+Config surface (group ``metric``)::
+
+    profiler:
+      enabled: False
+      start_iter: 8      # first traced iteration (lets compiles finish)
+      num_iters: 4       # how many iterations to capture
+
+The trace lands in ``<log_dir>/profiler`` and opens in TensorBoard's or
+Perfetto's trace viewer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Optional
+
+__all__ = ["TraceProfiler"]
+
+
+class TraceProfiler:
+    """Iteration-windowed ``jax.profiler`` trace: call :meth:`tick` once per
+    training iteration; the trace starts/stops itself around the configured
+    window. Safe no-op when disabled."""
+
+    def __init__(self, cfg: Optional[Mapping[str, Any]], log_dir: str):
+        prof_cfg = dict(cfg or {})
+        self.enabled = bool(prof_cfg.get("enabled", False))
+        self.start_iter = int(prof_cfg.get("start_iter", 8))
+        self.num_iters = int(prof_cfg.get("num_iters", 4))
+        self.trace_dir = os.path.join(log_dir, "profiler")
+        self._active = False
+        self._done = False
+
+    def tick(self, iter_num: int) -> None:
+        if not self.enabled or self._done:
+            return
+        import jax
+
+        if not self._active and iter_num >= self.start_iter:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            self._stop_at = iter_num + self.num_iters
+        elif self._active and iter_num >= self._stop_at:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

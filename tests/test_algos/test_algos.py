@@ -322,6 +322,28 @@ def test_sac_decoupled_dry_run(tmp_path, devices):
     )
 
 
+def test_ppo_share_data_two_devices(tmp_path):
+    """buffer.share_data: in-graph all_gather + common-permutation sharded
+    sampling (reference ppo.py:40-47,362-366)."""
+    run(_std_args(tmp_path, "ppo", devices=2, extra=PPO_FAST + ["buffer.share_data=True"]))
+
+
+def test_ppo_profiler_trace(tmp_path):
+    """jax.profiler trace hook produces a trace directory (SURVEY §5)."""
+    args = _std_args(tmp_path, "ppo", extra=PPO_FAST)
+    args.remove("dry_run=True")
+    args += [
+        "algo.total_steps=64",
+        "metric.profiler.enabled=True",
+        "metric.profiler.start_iter=1",
+        "metric.profiler.num_iters=2",
+    ]
+    run(args)
+    import glob
+
+    assert glob.glob(f"{tmp_path}/logs/**/profiler/**/*", recursive=True), "no profiler trace captured"
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
