@@ -94,7 +94,14 @@ class CheckpointCallback:
             rb._open_episodes = state
 
     def _delete_old_checkpoints(self, ckpt_folder: pathlib.Path) -> None:
+        import shutil
+
         ckpts = sorted(ckpt_folder.glob("*.ckpt"), key=os.path.getmtime)
         if len(ckpts) > self.keep_last:
             for f in ckpts[: -self.keep_last]:
                 f.unlink()
+                for sidecar in (f.with_name(f.name + ".arrays"), f.with_name(f.name + ".rb")):
+                    if sidecar.is_dir():
+                        shutil.rmtree(sidecar, ignore_errors=True)
+                    elif sidecar.exists():
+                        sidecar.unlink()
