@@ -1,0 +1,102 @@
+"""REAL multi-process distributed tests (VERDICT: every ``process_count > 1``
+branch was unexercised). Two OS processes, each owning one virtual CPU
+device, form a 2-process JAX distributed runtime: the global mesh spans both
+processes, `psum` rides the (gRPC) cross-process transport, and the fabric's
+control-plane helpers (``broadcast_obj``, ``barrier``, ``local_device``) run
+their multi-process paths.
+
+This is the CPU analogue of a 2-host TPU pod: one process per host,
+``jax.distributed.initialize`` wiring DCN (SURVEY §2.4).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sheeprl_tpu.parallel.distributed import maybe_init
+
+maybe_init()  # env-var driven: SHEEPRL_COORDINATOR/NUM_PROCESSES/PROCESS_ID
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+pid = jax.process_index()
+
+from sheeprl_tpu.parallel.fabric import Fabric
+
+fabric = Fabric(devices=2)
+assert fabric.world_size == 2
+# local_device must be addressable by THIS process (the code-review finding)
+assert fabric.local_device.process_index == pid
+
+# control plane: object broadcast from process 0 + barrier
+obj = fabric.broadcast_obj(np.asarray([42.0 + pid]), src=0)
+assert float(np.asarray(obj)[0]) == 42.0, obj
+fabric.barrier()
+
+# data plane: a psum over the 2-process mesh via shard_map
+def local_sum(x):
+    return jax.lax.psum(x, "dp")
+
+sharded = jax.shard_map(
+    local_sum, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P(), check_vma=False
+)
+from jax.experimental import multihost_utils
+
+host_local = np.full((1,), float(pid + 1), np.float32)  # proc0: [1], proc1: [2]
+global_arr = multihost_utils.host_local_array_to_global_array(host_local, fabric.mesh, P("dp"))
+total = jax.jit(sharded)(global_arr)
+np.testing.assert_allclose(np.asarray(total), [3.0])
+
+print(f"proc {pid} OK")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_mesh_psum_and_control_plane(tmp_path):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "SHEEPRL_COORDINATOR": f"127.0.0.1:{port}",
+                "SHEEPRL_NUM_PROCESSES": "2",
+                "SHEEPRL_PROCESS_ID": str(pid),
+            }
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"proc {pid} OK" in out
