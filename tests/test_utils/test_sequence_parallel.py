@@ -1,0 +1,79 @@
+"""Sequence-parallel attention schedules vs the single-device reference, on
+the 8-device virtual CPU mesh (the ``sp`` axis analogue of an ICI ring)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.ops.attention import reference_attention
+from sheeprl_tpu.parallel.sequence import make_ring_attention, make_ulysses_attention
+
+N_DEV = 8
+B, T, H, D = 2, 64, 8, 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = np.asarray(jax.devices()[:N_DEV])
+    return Mesh(devices, ("sp",))
+
+
+def _qkv(seed):
+    rng = np.random.default_rng(seed)
+    return tuple(rng.normal(size=(B, T, H, D)).astype(np.float32) * 0.5 for _ in range(3))
+
+
+def _shard(mesh, *arrays):
+    sharding = NamedSharding(mesh, P(None, "sp"))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_attention_matches_reference(mesh, causal):
+    q, k, v = _qkv(0)
+    want = np.asarray(reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+    fn = make_ring_attention(mesh, causal=causal)
+    got = np.asarray(fn(*_shard(mesh, q, k, v)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ulysses_attention_matches_reference(mesh, causal):
+    q, k, v = _qkv(1)
+    want = np.asarray(reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+    fn = make_ulysses_attention(mesh, causal=causal)
+    got = np.asarray(fn(*_shard(mesh, q, k, v)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_output_stays_sharded(mesh):
+    q, k, v = _shard(mesh, *_qkv(2))
+    out = make_ring_attention(mesh)(q, k, v)
+    assert out.sharding.spec == P(None, "sp")
+    assert out.shape == (B, T, H, D)
+
+
+def test_ulysses_requires_divisible_heads(mesh):
+    rng = np.random.default_rng(3)
+    bad = tuple(rng.normal(size=(B, T, 6, D)).astype(np.float32) for _ in range(3))  # 6 heads over 8 devices
+    fn = make_ulysses_attention(mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        fn(*_shard(mesh, *bad))
+
+
+def test_ring_attention_gradients_flow(mesh):
+    """The ring schedule must stay differentiable (actor-through-imagination
+    style backprop for a transformer world model)."""
+    q, k, v = _shard(mesh, *_qkv(4))
+    fn = make_ring_attention(mesh, causal=True)
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all()
+        assert np.abs(arr).max() > 0
