@@ -298,6 +298,7 @@ class LayerNormGRUCell(nn.Module):
     use_bias: bool = True
     layer_norm: bool = False
     norm_eps: float = 1e-3
+    use_pallas: Optional[bool] = None  # None = auto (on for TPU backends)
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -312,6 +313,12 @@ class LayerNormGRUCell(nn.Module):
         )(jnp.concatenate([h, x], axis=-1))
         if self.layer_norm:
             fused = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype, name="ln")(fused)
+        use_pallas = jax.default_backend() == "tpu" if self.use_pallas is None else self.use_pallas
+        if use_pallas and h.ndim == 2:
+            from sheeprl_tpu.ops.pallas_gru import gru_gates
+
+            h_new = gru_gates(fused, h)
+            return h_new, h_new
         reset, cand, update = jnp.split(fused, 3, axis=-1)
         reset = nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)
