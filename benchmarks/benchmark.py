@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Wall-clock benchmark harness (reference: ``benchmarks/benchmark.py``).
+
+The reference toggles commented argument blocks; here the algorithm is the
+first CLI argument and everything after is passed through as overrides::
+
+    python benchmarks/benchmark.py ppo
+    python benchmarks/benchmark.py sac fabric.devices=2 env.num_envs=8
+    python benchmarks/benchmark.py dreamer_v3
+
+Prints the elapsed wall-clock seconds and an env-steps/s JSON line. Uses the
+same persistent XLA compilation cache as ``bench.py`` so repeated runs
+measure the framework, not the compiler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KNOWN = ("ppo", "a2c", "sac", "dreamer_v1", "dreamer_v2", "dreamer_v3")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in KNOWN:
+        raise SystemExit(f"usage: benchmark.py <{'|'.join(KNOWN)}> [overrides...]")
+    algo = sys.argv[1]
+    overrides = sys.argv[2:]
+
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", os.environ.get("BENCH_XLA_CACHE", "/root/repo/.xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.config import compose
+
+    args = [f"exp={algo}_benchmarks", *overrides]
+    total_steps = int(compose(args).algo.total_steps)
+
+    tic = time.perf_counter()
+    run(args)
+    elapsed = time.perf_counter() - tic
+    print(
+        json.dumps(
+            {
+                "benchmark": algo,
+                "elapsed_s": round(elapsed, 2),
+                "env_steps_per_sec": round(total_steps / elapsed, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,7 @@ def prepare_obs(
             v = v.reshape(1, num_envs, *v.shape[-3:]) / 255.0 - 0.5
         else:
             v = v.reshape(1, num_envs, -1)
-        out[k] = jax.device_put(v)
+        out[k] = v
     return out
 
 

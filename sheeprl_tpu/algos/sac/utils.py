@@ -26,7 +26,7 @@ def prepare_obs(
     """Concatenate vector keys into one float32 device array shaped
     ``(num_envs, obs_dim)`` (reference: ``utils.py:31-37``)."""
     flat = np.concatenate([np.asarray(obs[k], dtype=np.float32) for k in mlp_keys], axis=-1)
-    return jax.device_put(flat.reshape(num_envs, -1))
+    return flat.reshape(num_envs, -1)
 
 
 def test(player, params, fabric, cfg: Dict[str, Any], log_dir: str, writer=None) -> None:

@@ -45,7 +45,7 @@ def prepare_obs(
             v = v.reshape(num_envs, *v.shape[-3:]) / 255.0
         else:
             v = v.reshape(num_envs, -1)
-        out[k] = jax.device_put(v)
+        out[k] = v
     return out
 
 
