@@ -20,7 +20,8 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 KNOWN = ("ppo", "a2c", "sac", "dreamer_v1", "dreamer_v2", "dreamer_v3")
 
@@ -34,19 +35,23 @@ def main() -> None:
     try:
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", os.environ.get("BENCH_XLA_CACHE", "/root/repo/.xla_cache"))
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("BENCH_XLA_CACHE", os.path.join(_REPO_ROOT, ".xla_cache")),
+        )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
 
-    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.cli import check_configs, run_algorithm
     from sheeprl_tpu.config import compose
 
-    args = [f"exp={algo}_benchmarks", *overrides]
-    total_steps = int(compose(args).algo.total_steps)
+    cfg = compose([f"exp={algo}_benchmarks", *overrides])
+    total_steps = int(cfg.algo.total_steps)
 
     tic = time.perf_counter()
-    run(args)
+    check_configs(cfg)
+    run_algorithm(cfg)
     elapsed = time.perf_counter() - tic
     print(
         json.dumps(

@@ -28,7 +28,7 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
 
 
-def init_moments(max_: float = 1e8) -> Dict[str, jax.Array]:
+def init_moments(max_: float = 1e8) -> Dict[str, np.ndarray]:
     """Initial state of the distributed-percentile return normalizer
     (reference ``Moments``, ``utils.py:40-63``)."""
     return {"low": jnp.zeros((), jnp.float32), "high": jnp.zeros((), jnp.float32)}
@@ -78,7 +78,7 @@ def compute_lambda_values(
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
 ) -> Dict[str, jax.Array]:
-    """Batch-shaped ``(num_envs, ...)`` float32 device arrays; pixels NHWC in
+    """Batch-shaped ``(num_envs, ...)`` float32 host arrays; pixels NHWC in
     [-0.5, 0.5] (reference: ``utils.py:81-92`` — the reference keeps a time
     axis of 1, the functional player here is batch-shaped)."""
     out = {}

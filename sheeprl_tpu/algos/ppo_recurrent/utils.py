@@ -17,8 +17,8 @@ MODELS_TO_REGISTER = {"agent"}
 
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
-) -> Dict[str, jax.Array]:
-    """Time-major ``(1, num_envs, ...)`` float32 device arrays; pixels
+) -> Dict[str, np.ndarray]:
+    """Time-major ``(1, num_envs, ...)`` float32 host arrays; pixels
     normalized to [-0.5, 0.5]."""
     out = {}
     for k in obs.keys():

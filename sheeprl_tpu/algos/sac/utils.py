@@ -22,8 +22,8 @@ MODELS_TO_REGISTER = {"agent"}
 
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
-) -> jax.Array:
-    """Concatenate vector keys into one float32 device array shaped
+) -> np.ndarray:
+    """Concatenate vector keys into one float32 host array shaped
     ``(num_envs, obs_dim)`` (reference: ``utils.py:31-37``)."""
     flat = np.concatenate([np.asarray(obs[k], dtype=np.float32) for k in mlp_keys], axis=-1)
     return flat.reshape(num_envs, -1)

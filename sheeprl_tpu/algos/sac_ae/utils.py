@@ -36,7 +36,7 @@ def preprocess_obs(obs: jax.Array, bits: int = 8, key: jax.Array | None = None) 
 
 def prepare_obs(
     fabric, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
-) -> Dict[str, jax.Array]:
+) -> Dict[str, np.ndarray]:
     """Pixels → float32 NHWC in [0, 1]; vectors → flat float32."""
     out = {}
     for k in obs.keys():
