@@ -169,11 +169,36 @@ class Fabric:
         return NamedSharding(self.mesh, P("dp"))
 
     def shard_data(self, tree: Any) -> Any:
-        """Place host arrays on device, batch-sharded over ``dp``."""
+        """Place host arrays on device, batch-sharded over ``dp``.
+
+        Multi-host: each process holds ITS shard of the batch (the reference's
+        per-rank rollout); the host-local arrays are assembled into one global
+        array whose addressable shards stay local — no cross-host transfer.
+        """
+        if jax.process_count() > 1:  # pragma: no cover - exercised by the 2-process test
+            from jax.experimental import multihost_utils
+            from jax.sharding import PartitionSpec as _P
+
+            local_spec = _P("dp")
+            return jax.tree.map(
+                lambda x: multihost_utils.host_local_array_to_global_array(x, self.mesh, local_spec), tree
+            )
         sh = self.data_sharding
         return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
 
     def put_replicated(self, tree: Any) -> Any:
+        """Replicate host arrays across the mesh. Multi-host: every process
+        must pass the same values (seeded identically, like DDP init)."""
+        if jax.process_count() > 1:  # pragma: no cover - exercised by the 2-process test
+            from jax.experimental import multihost_utils
+            from jax.sharding import PartitionSpec as _P
+
+            return jax.tree.map(
+                lambda x: multihost_utils.host_local_array_to_global_array(
+                    x, self.mesh, _P()
+                ),
+                tree,
+            )
         rep = self.replicated
         return jax.tree.map(lambda x: jax.device_put(x, rep), tree)
 

@@ -44,19 +44,19 @@ obj = fabric.broadcast_obj(np.asarray([42.0 + pid]), src=0)
 assert float(np.asarray(obj)[0]) == 42.0, obj
 fabric.barrier()
 
-# data plane: a psum over the 2-process mesh via shard_map
-def local_sum(x):
-    return jax.lax.psum(x, "dp")
+# data plane: a psum over the 2-process mesh via shard_map, fed through the
+# fabric's multi-host shard_data/put_replicated paths
+def local_sum(x, w):
+    return jax.lax.psum(x * w, "dp")
 
 sharded = jax.shard_map(
-    local_sum, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P(), check_vma=False
+    local_sum, mesh=fabric.mesh, in_specs=(P("dp"), P()), out_specs=P(), check_vma=False
 )
-from jax.experimental import multihost_utils
-
 host_local = np.full((1,), float(pid + 1), np.float32)  # proc0: [1], proc1: [2]
-global_arr = multihost_utils.host_local_array_to_global_array(host_local, fabric.mesh, P("dp"))
-total = jax.jit(sharded)(global_arr)
-np.testing.assert_allclose(np.asarray(total), [3.0])
+global_arr = fabric.shard_data(host_local)
+weight = fabric.put_replicated(np.full((1,), 2.0, np.float32))
+total = jax.jit(sharded)(global_arr, weight)
+np.testing.assert_allclose(np.asarray(total), [6.0])
 
 print(f"proc {pid} OK")
 """
