@@ -219,13 +219,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
             with timer("Time/env_interaction_time", SumMetric):
                 jobs = prepare_obs(fabric, next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
-                rng, subkey = jax.random.split(rng)
-                actions, _, values = player(params, jobs, subkey)
-                if is_continuous:
-                    real_actions = np.concatenate([np.asarray(a) for a in actions], axis=-1)
-                else:
-                    real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions], axis=-1)
-                actions_np = np.concatenate([np.asarray(a) for a in actions], axis=-1)
+                # fused single-dispatch step with device-carried PRNG key
+                # (same hot-loop treatment as PPO)
+                rng, env_actions, actions_np, _logprobs, values = player.rollout_step(params, rng, jobs)
+                real_actions = np.asarray(env_actions)
+                actions_np = np.asarray(actions_np)
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions.reshape(envs.action_space.shape)
