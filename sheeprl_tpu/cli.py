@@ -143,6 +143,10 @@ def run_algorithm(cfg: DotDict) -> None:
             )
             predefined = set()
         timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    from sheeprl_tpu.distributions import set_validate_args
+
+    set_validate_args(bool(cfg.get("distribution", {}).get("validate_args", False)))
         metrics_cfg = cfg.metric.aggregator.get("metrics") or {}
         for k in set(metrics_cfg.keys()) - set(predefined):
             metrics_cfg.pop(k, None)

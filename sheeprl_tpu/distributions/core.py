@@ -15,7 +15,7 @@ reference's algorithms.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,11 +36,41 @@ __all__ = [
     "TwoHotEncodingDistribution",
     "BernoulliSafeMode",
     "kl_divergence",
+    "set_validate_args",
 ]
 
 
 class Distribution:
-    """Minimal traceable distribution protocol."""
+    """Minimal traceable distribution protocol.
+
+    ``validate_args`` (reference: ``cfg.distribution.validate_args`` gating
+    torch's eager validation) enables STATIC argument checking — shapes,
+    dtypes, broadcastability — which is everything checkable under ``jit``
+    tracing; value-level checks (NaNs, simplex membership) have no
+    trace-time analogue. Toggle globally via :func:`set_validate_args`
+    (wired from the config by the CLI).
+    """
+
+    validate_args: bool = False
+
+    @staticmethod
+    def _check_broadcastable(name: str, *arrays: Any) -> None:
+        if not Distribution.validate_args:
+            return
+        try:
+            jnp.broadcast_shapes(*(jnp.shape(a) for a in arrays))
+        except ValueError as e:
+            raise ValueError(f"{name}: arguments are not broadcastable: "
+                             f"{[jnp.shape(a) for a in arrays]}") from e
+
+    @staticmethod
+    def _check_floating(name: str, **arrays: Any) -> None:
+        if not Distribution.validate_args:
+            return
+        for arg, a in arrays.items():
+            dtype = jnp.result_type(a)
+            if not jnp.issubdtype(dtype, jnp.floating):
+                raise ValueError(f"{name}: '{arg}' must be floating point, got {dtype}")
 
     def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
         raise NotImplementedError
@@ -66,8 +96,16 @@ class Distribution:
 # ---------------------------------------------------------------------------
 
 
+def set_validate_args(enabled: bool) -> None:
+    """Globally toggle static distribution-argument validation
+    (reference: ``cfg.distribution.validate_args``)."""
+    Distribution.validate_args = bool(enabled)
+
+
 class Normal(Distribution):
     def __init__(self, loc: jax.Array, scale: jax.Array):
+        self._check_broadcastable("Normal", loc, scale)
+        self._check_floating("Normal", loc=loc, scale=scale)
         self.loc = loc
         self.scale = scale
 
@@ -175,6 +213,8 @@ class OneHotCategorical(Distribution):
     """One-hot-valued categorical (reference: ``distribution.py:281-340``)."""
 
     def __init__(self, logits: jax.Array, unimix: float = 0.0):
+        if Distribution.validate_args and jnp.ndim(logits) < 1:
+            raise ValueError(f"OneHotCategorical: logits must have at least 1 dim, got {jnp.ndim(logits)}")
         logits = _unimix_logits(logits, unimix)
         self.logits = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
 
@@ -271,6 +311,10 @@ class TruncatedNormal(Distribution):
     """
 
     def __init__(self, loc, scale, low=-1.0, high=1.0, eps: float = 1e-6):
+        if Distribution.validate_args:
+            self._check_broadcastable("TruncatedNormal", loc, scale)
+            if not (float(low) < float(high)):
+                raise ValueError(f"TruncatedNormal: low ({low}) must be < high ({high})")
         self.loc = loc
         self.scale = scale
         self.low = low

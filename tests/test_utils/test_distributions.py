@@ -124,3 +124,29 @@ def test_kl_independent_normal():
     q = Independent(Normal(jnp.ones((2, 3)), jnp.ones((2, 3))), 1)
     kl = kl_divergence(p, q)
     np.testing.assert_allclose(np.asarray(kl), 1.5, rtol=1e-5)
+
+
+def test_validate_args_static_checks():
+    """distribution.validate_args enables static (trace-safe) argument
+    validation (reference: cfg.distribution.validate_args)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from sheeprl_tpu.distributions import Normal, OneHotCategorical, TruncatedNormal, set_validate_args
+
+    set_validate_args(True)
+    try:
+        with pytest.raises(ValueError, match="broadcastable"):
+            Normal(jnp.zeros((2, 3)), jnp.ones((4,)))
+        with pytest.raises(ValueError, match="floating"):
+            Normal(jnp.zeros(3, dtype=jnp.int32), jnp.ones(3))
+        with pytest.raises(ValueError, match="at least 1 dim"):
+            OneHotCategorical(logits=jnp.float32(0.0))
+        with pytest.raises(ValueError, match="low"):
+            TruncatedNormal(jnp.zeros(2), jnp.ones(2), low=1.0, high=-1.0)
+        # valid constructions still pass
+        Normal(jnp.zeros(3), jnp.ones(3))
+    finally:
+        set_validate_args(False)
+    # disabled: no checks
+    Normal(jnp.zeros(3, dtype=jnp.int32), jnp.ones(3))
