@@ -134,6 +134,10 @@ def run_algorithm(cfg: DotDict) -> None:
     from sheeprl_tpu.utils.metric import MetricAggregator
     from sheeprl_tpu.utils.timer import timer
 
+    from sheeprl_tpu.distributions import set_validate_args
+
+    set_validate_args(bool(cfg.get("distribution", {}).get("validate_args", False)))
+
     if cfg.get("metric") is not None:
         predefined = getattr(utils, "AGGREGATOR_KEYS", None)
         if predefined is None:
@@ -143,10 +147,6 @@ def run_algorithm(cfg: DotDict) -> None:
             )
             predefined = set()
         timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
-
-    from sheeprl_tpu.distributions import set_validate_args
-
-    set_validate_args(bool(cfg.get("distribution", {}).get("validate_args", False)))
         metrics_cfg = cfg.metric.aggregator.get("metrics") or {}
         for k in set(metrics_cfg.keys()) - set(predefined):
             metrics_cfg.pop(k, None)
