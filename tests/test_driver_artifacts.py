@@ -1,0 +1,55 @@
+"""The two driver-facing artifacts that failed in round 1 must never regress:
+``bench.py`` must print its JSON line inside the budget, and
+``__graft_entry__.dryrun_multichip`` must self-provision its virtual mesh
+from a process whose JAX backend is already initialized."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_prints_json_line():
+    env = dict(os.environ)
+    env["BENCH_TOTAL_STEPS"] = "512"
+    env["BENCH_XLA_CACHE"] = "/tmp/sheeprl_tpu_bench_test_cache"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=480,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["metric"] == "ppo_cartpole_env_steps_per_sec"
+    assert payload["value"] > 0
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_from_initialized_backend():
+    code = (
+        "import jax; jax.devices()\n"  # initialize whatever backend first, like the driver
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('DRYRUN-OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "dreamer_v3(8) OK" in proc.stdout
+    assert "ppo(8) OK" in proc.stdout
+    assert "DRYRUN-OK" in proc.stdout
