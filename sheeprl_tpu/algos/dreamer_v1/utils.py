@@ -40,6 +40,10 @@ def compute_lambda_values(
     ``utils.py:42-78``): H inputs produce H-1 outputs; the next-state value
     is ``values[t+1] * (1 - lmbda)`` except at the last step, where the full
     ``last_values`` bootstraps."""
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
+    last_values = last_values.astype(jnp.float32)
     horizon = rewards.shape[0]
     next_values = jnp.concatenate([values[1 : horizon - 1] * (1 - lmbda), last_values[None]], axis=0)
     delta = rewards[: horizon - 1] + next_values * continues[: horizon - 1]

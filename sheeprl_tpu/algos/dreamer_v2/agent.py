@@ -314,7 +314,9 @@ class RSSM:
         """One dynamic-learning step; all tensors batch-shaped, posterior flat
         (reference: ``agent.py:333-369``)."""
         k_prior, k_post = jax.random.split(key)
-        action = (1 - is_first) * action
+        # dtype-stable resets (see dreamer_v3.RSSM.dynamic)
+        is_first = is_first.astype(recurrent_state.dtype)
+        action = (1 - is_first) * action.astype(recurrent_state.dtype)
         posterior = (1 - is_first) * posterior
         recurrent_state = (1 - is_first) * recurrent_state
         recurrent_state = self.recurrent_model.apply(

@@ -39,8 +39,12 @@ def compute_lambda_values(
     (reference: ``utils.py:87-107``). ``continues`` already carries gamma;
     ``bootstrap`` is the value of the state after the last input row.
     All inputs ``(H, B, 1)``."""
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
     if bootstrap is None:
         bootstrap = jnp.zeros_like(values[-1:])
+    bootstrap = bootstrap.astype(jnp.float32)
     next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
     inputs = rewards + continues * next_values * (1 - lmbda)
 

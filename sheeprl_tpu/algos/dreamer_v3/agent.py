@@ -342,10 +342,15 @@ class RSSM:
         """One dynamic-learning step (reference: ``agent.py:396-436``).
         All tensors are batch-shaped ``(B, ...)``; ``posterior`` flat."""
         k_post = key
-        action = (1 - is_first) * action
+        # keep every mixed term in the carried state's dtype: under bf16
+        # policies the float32 is_first mask / initial-state param would
+        # otherwise promote the scan carry and break its type invariant
+        dtype = recurrent_state.dtype
+        is_first = is_first.astype(dtype)
+        action = (1 - is_first) * action.astype(dtype)
         init_rec, init_post = self.get_initial_states(wmp, recurrent_state.shape[:-1])
-        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
-        posterior = (1 - is_first) * posterior + is_first * init_post
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec.astype(dtype)
+        posterior = (1 - is_first) * posterior + is_first * init_post.astype(posterior.dtype)
         recurrent_state = self.recurrent_model.apply(
             wmp["recurrent_model"], jnp.concatenate([posterior, action], axis=-1), recurrent_state
         )
@@ -360,10 +365,12 @@ class RSSM:
         """Decoupled dynamic step: the posterior is precomputed from the
         observations alone; only the recurrent state and the prior advance
         (reference DecoupledRSSM.dynamic, ``agent.py:542-581``)."""
-        action = (1 - is_first) * action
+        dtype = recurrent_state.dtype
+        is_first = is_first.astype(dtype)
+        action = (1 - is_first) * action.astype(dtype)
         init_rec, init_post = self.get_initial_states(wmp, recurrent_state.shape[:-1])
-        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
-        posterior = (1 - is_first) * posterior + is_first * init_post
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec.astype(dtype)
+        posterior = (1 - is_first) * posterior + is_first * init_post.astype(posterior.dtype)
         recurrent_state = self.recurrent_model.apply(
             wmp["recurrent_model"], jnp.concatenate([posterior, action], axis=-1), recurrent_state
         )

@@ -63,7 +63,13 @@ def compute_lambda_values(
     rewards: jax.Array, values: jax.Array, continues: jax.Array, lmbda: float = 0.95
 ) -> jax.Array:
     """TD(lambda) returns as a reverse ``lax.scan``
-    (reference: ``utils.py:66-78``). All inputs ``(H, B, 1)``."""
+    (reference: ``utils.py:66-78``). All inputs ``(H, B, 1)``.
+
+    Accumulates in float32 regardless of the compute dtype (return
+    estimation; see ``ops.gae``)."""
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
     interm = rewards + continues * values * (1 - lmbda)
 
     def body(nxt, xs):

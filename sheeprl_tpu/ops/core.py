@@ -41,7 +41,14 @@ def gae(
     Returns:
         ``(returns, advantages)`` with the shape of ``rewards``.
     """
-    not_dones = 1.0 - dones.astype(values.dtype)
+    # Accumulate in float32 regardless of the compute dtype: the reference
+    # even upcasts to float64 here (``ppo.py:346-360``) — return estimation
+    # is where low precision visibly hurts, and under bf16 policies mixed
+    # input dtypes would otherwise flip the scan carry's type.
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    next_value = next_value.astype(jnp.float32)
+    not_dones = 1.0 - dones.astype(jnp.float32)
 
     def step(lastgaelam, inp):
         reward, value, next_val, nonterminal = inp

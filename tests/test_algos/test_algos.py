@@ -344,6 +344,32 @@ def test_ppo_profiler_trace(tmp_path):
     assert glob.glob(f"{tmp_path}/logs/**/profiler/**/*", recursive=True), "no profiler trace captured"
 
 
+@pytest.mark.parametrize("precision", ["bf16-mixed", "bf16-true"])
+def test_ppo_bf16_precision(tmp_path, precision):
+    """The precision policy path (the reference CI runs everything under
+    bf16-true): GAE and the scans must keep dtype-stable carries."""
+    run(_std_args(tmp_path, "ppo", devices=2, extra=PPO_FAST + [f"fabric.precision={precision}"]))
+
+
+def test_sac_bf16_precision(tmp_path):
+    run(
+        _std_args(
+            tmp_path,
+            "sac",
+            extra=[
+                "env.id=continuous_dummy",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.per_rank_batch_size=4",
+                "fabric.precision=bf16-mixed",
+            ],
+        )
+    )
+
+
+def test_dreamer_v3_bf16_precision(tmp_path):
+    run(_std_args(tmp_path, "dreamer_v3", extra=DREAMER_FAST + ["fabric.precision=bf16-mixed"]))
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
