@@ -43,7 +43,7 @@ def reconstruction_loss(
     kl = kl_divergence(posteriors_dist, priors_dist).mean()
     state_loss = jnp.maximum(kl, kl_free_nats)
     if qc is not None and continue_targets is not None:
-        continue_loss = continue_scale_factor * qc.log_prob(continue_targets)
+        continue_loss = -continue_scale_factor * qc.log_prob(continue_targets).mean()
     else:
         continue_loss = jnp.zeros_like(reward_loss)
     rec_loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss

@@ -613,6 +613,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "actor_task": params["actor_task"],
                 "critic_task": params["critic_task"],
                 "actor_exploration": params["actor_exploration"],
+                "critic_exploration": params["critic_exploration"],
             },
         )
     logger.close()

@@ -41,6 +41,16 @@ MODELS_TO_REGISTER = {
 def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
     from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
 
+    # Intersect with the checkpoint: exploration ckpts carry the ensembles and
+    # exploration behaviour, finetuning ckpts only the task behaviour.
+    candidates = (
+        "world_model",
+        "ensembles",
+        "actor_task",
+        "critic_task",
+        "actor_exploration",
+        "critic_exploration",
+    )
     return log_state_dicts_from_checkpoint(
-        cfg, state, models=("world_model", "ensembles", "actor_task", "critic_task", "actor_exploration")
+        cfg, state, models={k: state[k] for k in candidates if k in state}
     )

@@ -691,7 +691,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 "ensembles": params["ensembles"],
                 "actor_task": params["actor_task"],
                 "critic_task": params["critic_task"],
+                "target_critic_task": params["target_critic_task"],
                 "actor_exploration": params["actor_exploration"],
+                "critic_exploration": params["critic_exploration"],
+                "target_critic_exploration": params["target_critic_exploration"],
             },
         )
     logger.close()

@@ -405,6 +405,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 "world_model": params["world_model"],
                 "actor_task": params["actor"],
                 "critic_task": params["critic"],
+                "target_critic_task": params["target_critic"],
             },
         )
     logger.close()

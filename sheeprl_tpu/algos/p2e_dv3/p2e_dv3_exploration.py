@@ -796,7 +796,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 "ensembles": params["ensembles"],
                 "actor_task": params["actor_task"],
                 "critic_task": params["critic_task"],
+                "target_critic_task": params["target_critic_task"],
+                "moments_task": moments_state["task"],
                 "actor_exploration": params["actor_exploration"],
+                "critics_exploration": params["critics_exploration"],
+                "moments_exploration": moments_state["exploration"],
             },
         )
     logger.close()

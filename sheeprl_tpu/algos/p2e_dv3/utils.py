@@ -50,6 +50,26 @@ MODELS_TO_REGISTER = {
 def log_models_from_checkpoint(fabric, env, cfg, state):  # pragma: no cover - mlflow optional
     from sheeprl_tpu.utils.mlflow import log_state_dicts_from_checkpoint
 
-    return log_state_dicts_from_checkpoint(
-        cfg, state, models=("world_model", "ensembles", "actor_task", "critic_task", "actor_exploration")
+    # Intersect with the checkpoint: exploration ckpts carry the ensembles and
+    # exploration behaviour, finetuning ckpts only the task behaviour. The
+    # Moments live under one combined "moments" checkpoint entry
+    # ({"task": ..., "exploration": {...}} in exploration; a bare task moments
+    # state in finetuning) and are split back into registry names here.
+    candidates = (
+        "world_model",
+        "ensembles",
+        "actor_task",
+        "critic_task",
+        "target_critic_task",
+        "actor_exploration",
+        "critics_exploration",
     )
+    models = {k: state[k] for k in candidates if k in state}
+    moments = state.get("moments")
+    if isinstance(moments, dict) and "task" in moments:
+        models["moments_task"] = moments["task"]
+        if "exploration" in moments:
+            models["moments_exploration"] = moments["exploration"]
+    elif moments is not None:
+        models["moments_task"] = moments
+    return log_state_dicts_from_checkpoint(cfg, state, models=models)

@@ -62,19 +62,25 @@ def log_models(cfg, models_to_log, run_id, experiment_id=None, run_name=None):  
     return model_info
 
 
-def log_state_dicts_from_checkpoint(cfg, state: Dict[str, Any], models: tuple = ("agent",)):  # pragma: no cover
+def log_state_dicts_from_checkpoint(cfg, state: Dict[str, Any], models=("agent",)):  # pragma: no cover
     """Log checkpointed param pytrees to a nested mlflow run (shared by the
     per-algorithm ``log_models_from_checkpoint`` hooks — each reference algo
-    re-implements this, e.g. ``sheeprl/algos/sac/utils.py:103-140``)."""
+    re-implements this, e.g. ``sheeprl/algos/sac/utils.py:103-140``).
+
+    ``models`` is either a tuple of checkpoint keys or an explicit
+    {model_name: pytree} dict (used when registry names don't map 1:1 onto
+    checkpoint keys, e.g. p2e_dv3's combined ``moments`` entry)."""
     import jax
     import numpy as np
 
     mlflow = _require_mlflow()
+    if not isinstance(models, dict):
+        models = {name: state[name] for name in models}
     model_info = {}
     with mlflow.start_run(run_id=cfg.run.id, experiment_id=cfg.experiment.id, run_name=cfg.run.name, nested=True):
-        for name in models:
+        for name, value in models.items():
             model_info[name] = mlflow.log_dict(
-                jax.tree.map(lambda x: np.asarray(x).tolist(), state[name]), f"{name}.json"
+                jax.tree.map(lambda x: np.asarray(x).tolist(), value), f"{name}.json"
             )
         mlflow.log_dict(dict(cfg.to_log), "config.json")
     return model_info

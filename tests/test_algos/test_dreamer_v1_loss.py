@@ -1,0 +1,69 @@
+"""Dreamer-V1 reconstruction loss with the continue head enabled.
+
+Guards the `use_continues=True` path: the continue term must be a reduced,
+negated NLL so the world-model loss stays scalar under `jax.value_and_grad`
+(reference semantics: ``sheeprl/algos/dreamer_v1/loss.py:41-98``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
+from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal
+
+
+def _make_inputs(key, T=4, B=3):
+    ks = jax.random.split(key, 6)
+    obs = {"state": jax.random.normal(ks[0], (T, B, 5))}
+    rewards = jax.random.normal(ks[1], (T, B, 1))
+    continue_targets = (jax.random.uniform(ks[2], (T, B, 1)) > 0.3).astype(jnp.float32) * 0.99
+    post_mean = jax.random.normal(ks[3], (T, B, 8))
+    prior_mean = jax.random.normal(ks[4], (T, B, 8))
+    continue_logits = jax.random.normal(ks[5], (T, B, 1))
+    return obs, rewards, continue_targets, post_mean, prior_mean, continue_logits
+
+
+def test_continue_loss_is_scalar_and_negated_nll():
+    obs, rewards, continue_targets, post_mean, prior_mean, continue_logits = _make_inputs(
+        jax.random.PRNGKey(0)
+    )
+    qo = {"state": Independent(Normal(obs["state"] + 0.1, 1.0), 1)}
+    qr = Independent(Normal(rewards * 0.5, 1.0), 1)
+    qc = Independent(BernoulliSafeMode(logits=continue_logits), 1)
+    posteriors = Independent(Normal(post_mean, jnp.ones_like(post_mean)), 1)
+    priors = Independent(Normal(prior_mean, jnp.ones_like(prior_mean)), 1)
+
+    rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+        qo, obs, qr, rewards, posteriors, priors, 3.0, 1.0, qc, continue_targets, 10.0
+    )
+    # Every returned term must be scalar (the reference reduces with .mean()).
+    for term in (rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss):
+        assert term.shape == ()
+    # NLL of a Bernoulli is positive, and the scale factor is 10.
+    expected = -10.0 * qc.log_prob(continue_targets).mean()
+    assert jnp.allclose(continue_loss, expected)
+    assert continue_loss > 0
+
+
+def test_wm_style_loss_differentiable_with_continues():
+    """value_and_grad over a reconstruction loss that includes the continue
+    term — the exact shape of the Dreamer-V1 world-model update when
+    ``algo.world_model.use_continues=True``."""
+    obs, rewards, continue_targets, post_mean, prior_mean, _ = _make_inputs(jax.random.PRNGKey(1))
+    w = jnp.ones((1,))
+
+    def loss_fn(w):
+        qo = {"state": Independent(Normal(obs["state"] * w, 1.0), 1)}
+        qr = Independent(Normal(rewards * w, 1.0), 1)
+        qc = Independent(BernoulliSafeMode(logits=jnp.broadcast_to(w, rewards.shape)), 1)
+        posteriors = Independent(Normal(post_mean * w, jnp.ones_like(post_mean)), 1)
+        priors = Independent(Normal(prior_mean, jnp.ones_like(prior_mean)), 1)
+        rec_loss, *_ = reconstruction_loss(
+            qo, obs, qr, rewards, posteriors, priors, 3.0, 1.0, qc, continue_targets, 10.0
+        )
+        return rec_loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(w)
+    assert loss.shape == ()
+    assert jnp.isfinite(loss)
+    assert jnp.all(jnp.isfinite(grads))
