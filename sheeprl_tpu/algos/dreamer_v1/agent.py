@@ -27,11 +27,13 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     Actor,
     Encoder,
     CNNDecoder,
+    MinedojoActor,
     MLPDecoder,
     _PredictionHead,
     actor_dists,  # noqa: F401  (re-exported for the train step)
     actor_sample,
     add_exploration_noise,
+    extract_obs_masks,
     xavier_normal_init,
 )
 from sheeprl_tpu.distributions import Independent, Normal
@@ -196,9 +198,20 @@ class PlayerDV1:
             )
             k_repr, k_act, k_expl = jax.random.split(key, 3)
             _, stoch = rssm._representation(wmp, rec, emb, k_repr)
-            acts, _ = actor_sample(actor, params["actor"], jnp.concatenate([stoch, rec], axis=-1), k_act, greedy)
+            obs_mask = extract_obs_masks(obs)
+            acts, _ = actor_sample(
+                actor,
+                params["actor"],
+                jnp.concatenate([stoch, rec], axis=-1),
+                k_act,
+                greedy,
+                mask=obs_mask,
+            )
             if not greedy and expl > 0.0:
-                acts = add_exploration_noise(acts, expl, k_expl, actor.is_continuous)
+                acts = add_exploration_noise(
+                    acts, expl, k_expl, actor.is_continuous,
+                    mask=obs_mask if isinstance(actor, MinedojoActor) else None,
+                )
             return acts, jnp.concatenate(acts, axis=-1), rec, stoch
 
         self._step_fn = jax.jit(_step, static_argnums=(6, 7))
@@ -342,7 +355,12 @@ def build_agent(
     dist_type = cfg.distribution.get("type", "auto").lower()
     if dist_type == "auto":
         dist_type = "tanh_normal" if is_continuous else "discrete"
-    actor = Actor(
+    actor_cls = (
+        MinedojoActor
+        if str(actor_cfg.get("cls", "") or "").rsplit(".", 1)[-1] == "MinedojoActor"
+        else Actor
+    )
+    actor = actor_cls(
         actions_dim=tuple(int(d) for d in actions_dim),
         is_continuous=is_continuous,
         distribution=dist_type,

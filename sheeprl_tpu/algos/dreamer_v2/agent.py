@@ -420,12 +420,57 @@ def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
     return [OneHotCategoricalStraightThrough(logits=lo) for lo in pre_dist]
 
 
+class MinedojoActor(Actor):
+    """Mask-aware MineDojo actor (reference: ``agent.py:577-660``); identical
+    architecture, masked sequential sampling in :func:`actor_sample`. V2 has
+    no unimix, so masks apply to the raw head logits."""
+
+
+def _minedojo_masked_sample(logits, mask, key, greedy):
+    """Vectorized equivalent of the reference's per-element masking loops
+    (``agent.py:633-655``): head 0 = action type, head 1 = craft arg (masked
+    when type 15 sampled), head 2 = equip/place (16/17) or destroy (18) arg."""
+
+    def masked(lo, m):
+        return jnp.where(jnp.broadcast_to(m, lo.shape).astype(bool), lo, -jnp.inf)
+
+    keys = jax.random.split(key, len(logits))
+    dists = [OneHotCategoricalStraightThrough(logits=masked(logits[0], mask["mask_action_type"]))]
+    actions = [dists[0].mode if greedy else dists[0].rsample(keys[0])]
+    functional_action = jnp.argmax(actions[0], axis=-1, keepdims=True)
+    if len(logits) > 1:
+        l1 = jnp.where(functional_action == 15, masked(logits[1], mask["mask_craft_smelt"]), logits[1])
+        dists.append(OneHotCategoricalStraightThrough(logits=l1))
+        actions.append(dists[1].mode if greedy else dists[1].rsample(keys[1]))
+    if len(logits) > 2:
+        equip_place = (functional_action == 16) | (functional_action == 17)
+        l2 = jnp.where(equip_place, masked(logits[2], mask["mask_equip_place"]), logits[2])
+        l2 = jnp.where(functional_action == 18, masked(logits[2], mask["mask_destroy"]), l2)
+        dists.append(OneHotCategoricalStraightThrough(logits=l2))
+        actions.append(dists[2].mode if greedy else dists[2].rsample(keys[2]))
+    return actions, dists
+
+
+def extract_obs_masks(obs: Dict[str, jax.Array]) -> Optional[Dict[str, jax.Array]]:
+    """Pull the ``mask_*`` observation keys the MineDojo wrapper emits."""
+    mask = {k: v for k, v in obs.items() if k.startswith("mask")}
+    return mask or None
+
+
 def actor_sample(
-    actor: Actor, actor_params, state: jax.Array, key: jax.Array, greedy: bool = False
+    actor: Actor,
+    actor_params,
+    state: jax.Array,
+    key: jax.Array,
+    greedy: bool = False,
+    mask: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[List[jax.Array], List[Any]]:
     """Sample actions; greedy continuous uses the reference's 100-sample
-    argmax-of-log-prob trick (``agent.py:536-545``)."""
+    argmax-of-log-prob trick (``agent.py:536-545``). Mask-aware for
+    :class:`MinedojoActor`."""
     pre_dist = actor.apply(actor_params, state)
+    if mask is not None and isinstance(actor, MinedojoActor) and not actor.is_continuous:
+        return _minedojo_masked_sample(pre_dist, mask, key, greedy)
     dists = actor_dists(actor, pre_dist)
     actions: List[jax.Array] = []
     if actor.is_continuous:
@@ -446,24 +491,56 @@ def actor_sample(
 
 
 def add_exploration_noise(
-    actions: Sequence[jax.Array], expl_amount, key: jax.Array, is_continuous: bool
+    actions: Sequence[jax.Array],
+    expl_amount,
+    key: jax.Array,
+    is_continuous: bool,
+    mask: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[jax.Array, ...]:
-    """Epsilon-style exploration (reference: ``agent.py:547-560``): continuous
+    """Epsilon-style exploration (reference: ``agent.py:547-574``): continuous
     → clipped Gaussian jitter; discrete → uniform resample with prob eps.
     ``expl_amount`` may be a traced scalar (amount 0 is then the identity by
-    construction, so no Python branch is needed)."""
+    construction, so no Python branch is needed).
+
+    With a MineDojo ``mask``, exploratory resamples are drawn from the MASKED
+    uniform so they respect the env constraints, and the argument heads are
+    force-resampled when the exploratory action type turned critical
+    (reference ``MinedojoActor.add_exploration_noise``, ``agent.py:663-704``
+    — which builds the masked logits but then samples the unmasked uniform, a
+    latent bug not reproduced here)."""
     if isinstance(expl_amount, (int, float)) and expl_amount <= 0.0:
         return tuple(actions)
     if is_continuous:
         cat = jnp.concatenate(list(actions), axis=-1)
         noise = jax.random.normal(key, cat.shape) * expl_amount
         return (jnp.clip(cat + noise, -1, 1),)
+
+    def masked(lo, m):
+        return jnp.where(jnp.broadcast_to(m, lo.shape).astype(bool), lo, -jnp.inf)
+
     out = []
     keys = jax.random.split(key, 2 * len(actions))
+    old_func = jnp.argmax(actions[0], axis=-1, keepdims=True)
+    new_func = old_func
     for i, act in enumerate(actions):
-        sample = OneHotCategorical(logits=jnp.zeros_like(act)).sample(keys[2 * i])
+        logits = jnp.zeros_like(act)
+        if mask is not None:
+            if i == 0:
+                logits = masked(logits, mask["mask_action_type"])
+            elif i == 1:
+                logits = jnp.where(new_func == 15, masked(logits, mask["mask_craft_smelt"]), logits)
+            elif i == 2:
+                equip_place = (new_func == 16) | (new_func == 17)
+                logits = jnp.where(equip_place, masked(logits, mask["mask_equip_place"]), logits)
+                logits = jnp.where(new_func == 18, masked(logits, mask["mask_destroy"]), logits)
+        sample = OneHotCategorical(logits=logits).sample(keys[2 * i])
         replace = jax.random.uniform(keys[2 * i + 1], act.shape[:1]) < expl_amount
+        if mask is not None and i in (1, 2):
+            critical = (new_func[..., 0] >= 15) & (new_func[..., 0] <= 18)
+            replace = replace | ((new_func[..., 0] != old_func[..., 0]) & critical)
         out.append(jnp.where(replace[..., None], sample, act))
+        if i == 0:
+            new_func = jnp.argmax(out[0], axis=-1, keepdims=True)
     return tuple(out)
 
 
@@ -508,9 +585,20 @@ class PlayerDV2:
             )
             k_repr, k_act, k_expl = jax.random.split(key, 3)
             _, stoch = rssm._representation(wmp, rec, emb, k_repr)
-            acts, _ = actor_sample(actor, params["actor"], jnp.concatenate([stoch, rec], axis=-1), k_act, greedy)
+            obs_mask = extract_obs_masks(obs)
+            acts, _ = actor_sample(
+                actor,
+                params["actor"],
+                jnp.concatenate([stoch, rec], axis=-1),
+                k_act,
+                greedy,
+                mask=obs_mask,
+            )
             if not greedy and expl > 0.0:
-                acts = add_exploration_noise(acts, expl, k_expl, actor.is_continuous)
+                acts = add_exploration_noise(
+                    acts, expl, k_expl, actor.is_continuous,
+                    mask=obs_mask if isinstance(actor, MinedojoActor) else None,
+                )
             return acts, jnp.concatenate(acts, axis=-1), rec, stoch
 
         self._step_fn = jax.jit(_step, static_argnums=(6, 7))
@@ -691,7 +779,11 @@ def build_agent(
     dist_type = cfg.distribution.get("type", "auto").lower()
     if dist_type == "auto":
         dist_type = "trunc_normal" if is_continuous else "discrete"
-    actor_cls = actor_cls or Actor
+    if actor_cls is None:
+        # ``algo.actor.cls`` picks the sampling behaviour (reference
+        # hydra-instantiates the target at agent.py:1019-1032).
+        is_minedojo = str(actor_cfg.get("cls", "") or "").rsplit(".", 1)[-1] == "MinedojoActor"
+        actor_cls = MinedojoActor if is_minedojo else Actor
     actor = actor_cls(
         actions_dim=tuple(int(d) for d in actions_dim),
         is_continuous=is_continuous,

@@ -437,6 +437,28 @@ class Actor(nn.Module):
         return [nn.Dense(int(d), dtype=self.dtype, name=f"head_{i}")(x) for i, d in enumerate(self.actions_dim)]
 
 
+class MinedojoActor(Actor):
+    """Mask-aware MineDojo actor: identical architecture, but sampling masks
+    invalid action-type / craft / destroy / equip-place logits with ``-inf``
+    (reference: ``agent.py:848-930``). The masking itself lives in
+    :func:`actor_sample`, keyed on this class."""
+
+
+def _unimix_logits(logits: jax.Array, amount: float) -> jax.Array:
+    """Hafner's uniform-mix regularizer on categorical logits."""
+    if amount <= 0.0:
+        return logits
+    probs = jax.nn.softmax(logits, axis=-1)
+    uniform = jnp.ones_like(probs) / probs.shape[-1]
+    return jnp.log((1 - amount) * probs + amount * uniform)
+
+
+def _mask_logits(logits: jax.Array, mask: jax.Array) -> jax.Array:
+    """``-inf`` where the (broadcast) mask is invalid."""
+    valid = jnp.broadcast_to(mask, logits.shape).astype(bool)
+    return jnp.where(valid, logits, -jnp.inf)
+
+
 def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
     """Build the action distributions from the actor outputs."""
     from sheeprl_tpu.distributions import TanhNormal
@@ -454,23 +476,80 @@ def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
         std = jax.nn.softplus(std + actor.init_std) + actor.min_std
         return [Independent(TanhNormal(mean, std), 1)]
 
-    dists = []
-    for logits in pre_dist:
-        if actor.unimix > 0.0:
-            probs = jax.nn.softmax(logits, axis=-1)
-            uniform = jnp.ones_like(probs) / probs.shape[-1]
-            probs = (1 - actor.unimix) * probs + actor.unimix * uniform
-            logits = jnp.log(probs)
-        dists.append(OneHotCategoricalStraightThrough(logits=logits))
-    return dists
+    return [
+        OneHotCategoricalStraightThrough(logits=_unimix_logits(logits, actor.unimix))
+        for logits in pre_dist
+    ]
+
+
+def _minedojo_masked_sample(
+    actor: Actor, pre_dist: List[jax.Array], mask: Dict[str, jax.Array], key: jax.Array, greedy: bool
+) -> Tuple[List[jax.Array], List[Any]]:
+    """Sequential mask-aware sampling over the three MineDojo heads
+    (reference: ``agent.py:902-926``, vectorized over the batch instead of the
+    reference's per-element Python loops):
+
+    - head 0 (action type): invalid types masked out directly;
+    - head 1 (craft arg): masked with ``mask_craft_smelt`` only where head 0
+      sampled the craft action (15);
+    - head 2 (arg): masked with ``mask_equip_place`` where head 0 sampled
+      equip/place (16/17) and ``mask_destroy`` where it sampled destroy (18).
+
+    Unimix is applied *before* masking, as in the reference, so no uniform
+    mass leaks back onto invalid actions.
+    """
+    logits = [_unimix_logits(lo, actor.unimix) for lo in pre_dist]
+    keys = jax.random.split(key, len(logits))
+    actions: List[jax.Array] = []
+    dists: List[Any] = []
+
+    def sample(dist, k):
+        return dist.mode if greedy else dist.rsample(k)
+
+    d0 = OneHotCategoricalStraightThrough(logits=_mask_logits(logits[0], mask["mask_action_type"]))
+    a0 = sample(d0, keys[0])
+    actions.append(a0)
+    dists.append(d0)
+    # (..., 1) so it broadcasts against the argument-head logits
+    functional_action = jnp.argmax(a0, axis=-1, keepdims=True)
+
+    if len(logits) > 1:
+        crafting = functional_action == 15
+        l1 = jnp.where(crafting, _mask_logits(logits[1], mask["mask_craft_smelt"]), logits[1])
+        d1 = OneHotCategoricalStraightThrough(logits=l1)
+        actions.append(sample(d1, keys[1]))
+        dists.append(d1)
+    if len(logits) > 2:
+        equip_place = (functional_action == 16) | (functional_action == 17)
+        destroy = functional_action == 18
+        l2 = jnp.where(equip_place, _mask_logits(logits[2], mask["mask_equip_place"]), logits[2])
+        l2 = jnp.where(destroy, _mask_logits(logits[2], mask["mask_destroy"]), l2)
+        d2 = OneHotCategoricalStraightThrough(logits=l2)
+        actions.append(sample(d2, keys[2]))
+        dists.append(d2)
+    return actions, dists
+
+
+def extract_obs_masks(obs: Dict[str, jax.Array]) -> Optional[Dict[str, jax.Array]]:
+    """Pull the ``mask_*`` observation keys the MineDojo wrapper emits
+    (reference main loop: ``dreamer_v3.py:574-577``)."""
+    mask = {k: v for k, v in obs.items() if k.startswith("mask")}
+    return mask or None
 
 
 def actor_sample(
-    actor: Actor, actor_params, state: jax.Array, key: jax.Array, greedy: bool = False
+    actor: Actor,
+    actor_params,
+    state: jax.Array,
+    key: jax.Array,
+    greedy: bool = False,
+    mask: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[List[jax.Array], List[Any]]:
     """Sample (reparameterized / straight-through) actions from the actor
-    (reference: ``agent.py:783-846``)."""
+    (reference: ``agent.py:783-846``); mask-aware for :class:`MinedojoActor`."""
     pre_dist = actor.apply(actor_params, state)
+    if mask is not None and isinstance(actor, MinedojoActor) and not actor.is_continuous:
+        return _minedojo_masked_sample(actor, pre_dist, mask, key, greedy)
     dists = actor_dists(actor, pre_dist)
     actions: List[jax.Array] = []
     if actor.is_continuous:
@@ -530,7 +609,14 @@ class PlayerDV3:
             )
             k_repr, k_act = jax.random.split(key)
             _, stoch = rssm._representation(wmp, rec, emb, k_repr)
-            acts, _ = actor_sample(actor, params["actor"], jnp.concatenate([stoch, rec], axis=-1), k_act, greedy)
+            acts, _ = actor_sample(
+                actor,
+                params["actor"],
+                jnp.concatenate([stoch, rec], axis=-1),
+                k_act,
+                greedy,
+                mask=extract_obs_masks(obs),
+            )
             return acts, jnp.concatenate(acts, axis=-1), rec, stoch
 
         self._init_fn = jax.jit(_init, static_argnums=(1,))
@@ -718,7 +804,14 @@ def build_agent(
         continue_model=continue_model,
     )
 
-    actor = Actor(
+    # ``algo.actor.cls`` picks the sampling behaviour (reference instantiates
+    # the hydra target at agent.py:1133-1137); both classes live in this module.
+    actor_cls = (
+        MinedojoActor
+        if str(actor_cfg.get("cls", "") or "").rsplit(".", 1)[-1] == "MinedojoActor"
+        else Actor
+    )
+    actor = actor_cls(
         actions_dim=tuple(int(d) for d in actions_dim),
         is_continuous=is_continuous,
         distribution=(

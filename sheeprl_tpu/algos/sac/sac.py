@@ -124,6 +124,124 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
     return jax.jit(shard_train, donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
+def make_burst_train_step(
+    agent: SACAgent,
+    actor_tx,
+    critic_tx,
+    alpha_tx,
+    cfg,
+    mesh,
+    capacity: int,
+    n_envs: int,
+    stage_max: int,
+    grad_chunk: int,
+):
+    """Device-resident-replay burst update (TPU-native; no reference
+    counterpart — the reference host-samples every iteration).
+
+    One dispatch (a) appends up to ``stage_max`` fresh transitions into a
+    ring buffer that LIVES ON DEVICE, (b) draws ``grad_chunk`` uniform
+    minibatches from it with device RNG, and (c) runs the same
+    critic/EMA/actor/alpha updates as :func:`make_train_step` as one scan.
+
+    Rationale: on a tunneled/remote accelerator every dispatch whose inputs
+    depend on the previous update's outputs pays a round-trip, and host-side
+    sampling ships every minibatch over the wire (~1.3 GB for the reference
+    SAC benchmark). Batching K iterations' grants into one dispatch divides
+    the round-trips by K, and on-device sampling cuts host→device traffic to
+    the raw transition stream (~5 MB). Same sampling distribution as
+    ``ReplayBuffer.sample(sample_next_obs=False)``: uniform over the valid
+    ``(position, env)`` grid.
+
+    The staged transitions are appended *before* the chunk's minibatches are
+    drawn, so late minibatches in a burst can see transitions the reference
+    would only expose next iteration — the usual one-burst staleness trade.
+    """
+    gamma = float(cfg.algo.gamma)
+    target_entropy = agent.target_entropy
+    n_dev = mesh.devices.size
+
+    def minibatch_step(carry, xs):
+        params, aopt, copt, lopt, rb = carry
+        old = (params, aopt, copt, lopt)
+        key, ema_flag, valid = xs
+        ema_flag = ema_flag * valid
+        k_idx, k_env, k_next, k_actor = jax.random.split(key, 4)
+        # On-device uniform sample over the valid (position, env) grid.
+        # valid_n rides in the carry-free closure inputs via rb["valid_n"].
+        B = int(cfg.algo.per_rank_batch_size) // n_dev
+        pos_idx = jax.random.randint(k_idx, (B,), 0, rb["valid_n"])
+        env_idx = jax.random.randint(k_env, (B,), 0, n_envs)
+        batch = {
+            k: rb[k][pos_idx, env_idx] for k in ("observations", "next_observations", "actions", "rewards", "terminated")
+        }
+
+        td_target = agent.next_target_q(params, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k_next)
+        td_target = jax.lax.stop_gradient(td_target)
+
+        def c_loss(cp):
+            q = agent.q_values(cp, batch["observations"], batch["actions"])
+            return critic_loss(q, td_target, agent.critic.n)
+
+        qf_loss, cgrads = jax.value_and_grad(c_loss)(params["critic"])
+        cgrads = jax.lax.pmean(cgrads, "dp")
+        cupd, copt = critic_tx.update(cgrads, copt, params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], cupd)}
+        params = {**params, "target_critic": agent.ema(params["critic"], params["target_critic"], ema_flag)}
+
+        alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+
+        def a_loss(ap):
+            actions, logp = agent.sample_action(ap, batch["observations"], k_actor)
+            q = agent.q_values(params["critic"], batch["observations"], actions)
+            return policy_loss(alpha, logp, jnp.min(q, axis=-1, keepdims=True)), logp
+
+        (actor_loss, logp), agrads = jax.value_and_grad(a_loss, has_aux=True)(params["actor"])
+        agrads = jax.lax.pmean(agrads, "dp")
+        aupd, aopt = actor_tx.update(agrads, aopt, params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], aupd)}
+
+        def l_loss(la):
+            return entropy_loss(la, jax.lax.stop_gradient(logp), target_entropy)
+
+        alpha_loss, lgrads = jax.value_and_grad(l_loss)(params["log_alpha"])
+        lgrads = jax.lax.pmean(lgrads, "dp")
+        lupd, lopt = alpha_tx.update(lgrads, lopt, params["log_alpha"])
+        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
+
+        # Ungranted (padding) steps are no-ops: a burst is dispatched with a
+        # fixed-length scan, `valid` marks the Ratio-granted prefix.
+        params, aopt, copt, lopt = jax.tree.map(
+            lambda n, o: jnp.where(valid > 0, n, o), (params, aopt, copt, lopt), old
+        )
+        return (params, aopt, copt, lopt, rb), (qf_loss, actor_loss, alpha_loss)
+
+    def local_train(params, aopt, copt, lopt, rb, staged, pos, count, valid_n, key, ema_flags, valid):
+        # Ring append with wrap-around; rows past `count` target index
+        # `capacity` and are dropped by the scatter.
+        idx = (pos + jnp.arange(stage_max)) % capacity
+        idx = jnp.where(jnp.arange(stage_max) < count, idx, capacity)
+        rb = {k: rb[k].at[idx].set(staged[k], mode="drop") for k in rb}
+        rb["valid_n"] = valid_n
+        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+        keys = jax.random.split(key, grad_chunk)
+        carry = (params, aopt, copt, lopt, rb)
+        carry, losses = jax.lax.scan(minibatch_step, carry, (keys, ema_flags, valid))
+        params, aopt, copt, lopt, rb = carry
+        del rb["valid_n"]
+        qf, al, ll = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
+        return params, aopt, copt, lopt, rb, qf, al, ll
+
+    shard_train = jax.shard_map(
+        local_train,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_train, donate_argnums=(4,))
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.optim.builders import build_optimizer
@@ -239,9 +357,200 @@ def main(fabric, cfg: Dict[str, Any]):
         raise ValueError(
             f"per_rank_batch_size ({batch_size}) must be divisible by the number of devices ({fabric.world_size})"
         )
-    train_fn = make_train_step(agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh)
-    data_sharding = NamedSharding(fabric.mesh, P(None, "dp"))
+    # TPU-native overlap: when the trainer mesh lives on an accelerator, the
+    # env-side policy runs on the host CPU from a params snapshot refreshed
+    # every `refresh_every` iterations (double-buffered, so the snapshot
+    # transfer overlaps the env loop and the host never blocks on the device
+    # queue — or on a tunneled chip's per-pull round-trip). The device params
+    # stay the source of truth; actions are one snapshot stale, the same
+    # trade the reference's decoupled topology makes (`sac_decoupled.py`).
+    hp_cfg = cfg.algo.get("hybrid_player") or {}
+    hp_enabled = hp_cfg.get("enabled", "auto")
+    mesh_platform = fabric.mesh.devices.flat[0].platform
+    if isinstance(hp_enabled, str):
+        hp_enabled = (mesh_platform != "cpu") if hp_enabled.lower() == "auto" else hp_enabled.lower() == "true"
+    hp_refresh = max(1, int(hp_cfg.get("refresh_every", 64)))
+    host_actor_params = None
+    host_rng = None
+    _host_sample = None
+    last_refresh = 0
+    _snapshot_slot: list = [None]
+    _snapshot_thread = None
+    if hp_enabled:
+        import threading
+        from jax.flatten_util import ravel_pytree
+
+        host_device = jax.devices("cpu")[0]
+        # One packed vector per snapshot: a per-leaf transfer pays one wire
+        # round-trip PER LEAF on a tunneled chip (jax device_put goes through
+        # host `_value`), a ravel'd vector pays exactly one.
+        _, _unravel = ravel_pytree(jax.tree.map(np.asarray, params["actor"]))
+        _pack = jax.jit(lambda ap: ravel_pytree(ap)[0])
+        _unpack = jax.jit(_unravel)
+        host_actor_params = _unpack(jax.device_put(_pack(params["actor"]), host_device))
+        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), host_device)
+        _host_sample = jax.jit(lambda ap, o, k: agent.sample_action(ap, o, k)[0])
+
+        def _snapshot_worker(vec):
+            # The blocking device->host pull runs off-thread so the env loop
+            # never waits on the wire.
+            _snapshot_slot[0] = jax.device_put(vec, host_device)
+
+        def start_snapshot(actor_params):
+            nonlocal _snapshot_thread
+            if _snapshot_thread is not None and _snapshot_thread.is_alive():
+                return False
+            _snapshot_thread = threading.Thread(
+                target=_snapshot_worker, args=(_pack(actor_params),), daemon=True
+            )
+            _snapshot_thread.start()
+            return True
+
+    # Burst training (TPU-native, see make_burst_train_step): dispatch the
+    # accumulated Ratio grants every `train_every` iterations against a
+    # device-resident replay mirror instead of shipping host samples each
+    # iteration. `auto` turns it on together with the hybrid player.
+    train_every = hp_cfg.get("train_every", "auto")
+    if isinstance(train_every, str):
+        train_every = (64 if hp_enabled else 1) if train_every == "auto" else int(train_every)
+    train_every = max(1, int(train_every))
+    burst_mode = hp_enabled and train_every > 1
+    if burst_mode and cfg.buffer.sample_next_obs:
+        warnings.warn("buffer.sample_next_obs is not supported by burst training; disabling the burst path.")
+        burst_mode = False
     ema_modulus = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
+
+    # Donation would invalidate the params buffers while a host snapshot
+    # transfer is still in flight; SAC params are tiny, so keep them.
+    train_fn = None
+    burst_fn = None
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+    act_dim = int(np.prod(action_space.shape))
+    if burst_mode:
+        grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
+        # Sized from the CONFIGURED warmup, not the resume-shifted
+        # `learning_starts` (which has start_iter added on resume) — the
+        # staging buffer only ever holds transitions since the last flush.
+        base_learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+        stage_max = min(base_learning_starts + 2 * train_every + 1, buffer_size)
+        burst_fn = make_burst_train_step(
+            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh,
+            capacity=buffer_size, n_envs=int(cfg.env.num_envs), stage_max=stage_max, grad_chunk=grad_chunk,
+        )
+        dims = {
+            "observations": obs_dim, "next_observations": obs_dim,
+            "actions": act_dim, "rewards": 1, "terminated": 1,
+        }
+        rb_dev = {
+            k: fabric.put_replicated(jnp.zeros((buffer_size, int(cfg.env.num_envs), d), jnp.float32))
+            for k, d in dims.items()
+        }
+        dev_pos, dev_total = 0, 0
+        if state is not None and cfg.buffer.checkpoint and not rb.empty:
+            # Mirror the restored host buffer onto the device ring.
+            for k in rb_dev:
+                host = np.asarray(rb.buffer[k], dtype=np.float32).reshape(buffer_size, int(cfg.env.num_envs), -1)
+                rb_dev[k] = fabric.put_replicated(jnp.asarray(host))
+            dev_pos, dev_total = rb._pos, (buffer_size if rb.full else rb._pos)
+        staged: list = []
+        ema_backlog: list = []
+
+        # The burst dispatch itself pays a round-trip on a tunneled chip, so
+        # it runs on a trainer thread: the env loop hands staged transitions
+        # over a bounded queue (backpressure = one in-flight burst) and keeps
+        # stepping with the previous snapshot. The thread owns the
+        # params/opt/ring futures; `_tr` always holds the newest handles for
+        # checkpoints and the final test.
+        import queue as _queue
+        import threading as _threading
+
+        _tr = {
+            "params": params, "aopt": aopt, "copt": copt, "lopt": lopt,
+            "rb_dev": rb_dev, "losses": None, "error": None,
+        }
+        _tr_lock = _threading.Lock()
+        _burst_q: "_queue.Queue" = _queue.Queue(maxsize=2)
+
+        def _burst_worker():
+            while True:
+                job = _burst_q.get()
+                if job is None:
+                    return
+                try:
+                    staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j = job
+                    out = burst_fn(
+                        _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"], _tr["rb_dev"],
+                        staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j,
+                    )
+                    with _tr_lock:
+                        (
+                            _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"], _tr["rb_dev"],
+                            qf_l, a_l, al_l,
+                        ) = out
+                        _tr["losses"] = (qf_l, a_l, al_l)
+                    # Refresh the host policy snapshot once per burst (one
+                    # packed-vector pull; blocking is fine on this thread).
+                    _snapshot_slot[0] = jax.device_put(_pack(_tr["params"]["actor"]), host_device)
+                except Exception as exc:  # surfaced at the next put/join
+                    _tr["error"] = exc
+                    # Keep draining so a full queue can never deadlock the
+                    # main loop's put(); the error is raised there instead.
+                    while _burst_q.get() is not None:
+                        pass
+                    return
+
+        _burst_thread = _threading.Thread(target=_burst_worker, daemon=True)
+        _burst_thread.start()
+
+        def _flush_burst():
+            """Ship the staged transitions + up to one grant chunk to the
+            trainer thread (padded scan steps are no-ops via the valid
+            mask)."""
+            nonlocal rng, dev_pos, dev_total, cumulative_per_rank_gradient_steps, train_step
+            count = len(staged)
+            pad = stage_max - count
+            if count:
+                staged_arr = {
+                    k: np.concatenate(
+                        [np.stack([t[k] for t in staged])]
+                        + ([np.zeros((pad,) + staged[0][k].shape, np.float32)] if pad else []),
+                        axis=0,
+                    )
+                    for k in rb_dev
+                }
+            else:
+                staged_arr = {
+                    k: np.zeros((stage_max,) + tuple(v.shape[1:]), np.float32) for k, v in rb_dev.items()
+                }
+            staged.clear()
+            dev_total = min(dev_total + count, buffer_size)
+            chunk = min(grad_chunk, len(ema_backlog))
+            flags = np.zeros((grad_chunk,), np.float32)
+            valid = np.zeros((grad_chunk,), np.float32)
+            flags[:chunk] = ema_backlog[:chunk]
+            valid[:chunk] = 1.0
+            if _tr["error"] is not None:
+                raise _tr["error"]
+            with timer("Time/train_time", SumMetric):
+                rng, train_key = jax.random.split(rng)
+                _burst_q.put((
+                    staged_arr,
+                    jnp.int32(dev_pos), jnp.int32(count), jnp.int32(dev_total),
+                    train_key, jnp.asarray(flags), jnp.asarray(valid),
+                ))
+                if aggregator and not aggregator.disabled and _tr["losses"] is not None:
+                    qf_l, a_l, al_l = _tr["losses"]
+                    aggregator.update("Loss/value_loss", qf_l)
+                    aggregator.update("Loss/policy_loss", a_l)
+                    aggregator.update("Loss/alpha_loss", al_l)
+            dev_pos = (dev_pos + count) % buffer_size
+            del ema_backlog[:chunk]
+            if chunk > 0:
+                cumulative_per_rank_gradient_steps += chunk
+                train_step += 1
+    else:
+        train_fn = make_train_step(agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=not hp_enabled)
+    data_sharding = NamedSharding(fabric.mesh, P(None, "dp"))
 
     rng = jax.random.PRNGKey(cfg.seed)
     mlp_keys = cfg.algo.mlp_keys.encoder
@@ -253,9 +562,30 @@ def main(fabric, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
+        # Swap in a finished off-thread snapshot; outside burst mode, also
+        # start the next pull once the refresh period has elapsed (in burst
+        # mode the trainer thread refreshes once per burst).
+        if hp_enabled:
+            if _snapshot_slot[0] is not None:
+                host_actor_params = _unpack(_snapshot_slot[0])
+                _snapshot_slot[0] = None
+            if (
+                not burst_mode
+                and iter_num - last_refresh >= hp_refresh
+                and iter_num > learning_starts
+                and start_snapshot(params["actor"])
+            ):
+                last_refresh = iter_num
+
         with timer("Time/env_interaction_time", SumMetric):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
+            elif hp_enabled:
+                flat_obs = np.concatenate(
+                    [np.asarray(obs[k], dtype=np.float32) for k in mlp_keys], axis=-1
+                ).reshape(cfg.env.num_envs, -1)
+                host_rng, subkey = jax.random.split(host_rng)
+                actions = np.asarray(_host_sample(host_actor_params, flat_obs, subkey))
             else:
                 jobs = prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
                 rng, subkey = jax.random.split(rng)
@@ -300,7 +630,21 @@ def main(fabric, cfg: Dict[str, Any]):
         obs = next_obs
 
         # Train (reference: sac.py:297-356)
-        if iter_num >= learning_starts:
+        if burst_mode:
+            # Stage the transition for the device ring; host rb stays the
+            # checkpoint source of truth.
+            staged.append({k: np.asarray(step_data[k][0], dtype=np.float32) for k in rb_dev})
+            if iter_num >= learning_starts:
+                granted = ratio(policy_step - prefill_steps + policy_steps_per_iter)
+                ema_backlog.extend([1.0 if iter_num % ema_modulus == 0 else 0.0] * granted)
+            # Dispatch one burst when a full grant chunk is queued, or flush
+            # the staging area if it is about to overflow (low replay
+            # ratios); padded scan steps are no-ops via the valid mask.
+            while len(ema_backlog) >= grad_chunk or len(staged) >= stage_max - 1:
+                _flush_burst()
+                if len(ema_backlog) < grad_chunk:
+                    break
+        elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps + policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
                 sample = rb.sample(
@@ -359,6 +703,10 @@ def main(fabric, cfg: Dict[str, Any]):
             iter_num == total_iters and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
+            if burst_mode:
+                # Latest trainer-thread handles (at most one burst stale).
+                with _tr_lock:
+                    params, aopt, copt, lopt = _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"]
             ckpt_state = {
                 "agent": params,
                 "qf_optimizer": copt,
@@ -377,6 +725,17 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+
+    if burst_mode:
+        # Flush the tail: Ratio already counted any remaining grants, so they
+        # must be executed (a reference run would have applied them).
+        while staged or ema_backlog:
+            _flush_burst()
+        _burst_q.put(None)
+        _burst_thread.join()
+        if _tr["error"] is not None:
+            raise _tr["error"]
+        params, aopt, copt, lopt = _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"]
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

@@ -1,0 +1,126 @@
+"""MineDojo action-mask enforcement in the Dreamer actors.
+
+The mask-aware actors must never sample an action the environment marked
+invalid (reference semantics: ``sheeprl/algos/dreamer_v3/agent.py:848-930``,
+``sheeprl/algos/dreamer_v2/agent.py:577-660``): head 0 honours
+``mask_action_type`` unconditionally; head 1 honours ``mask_craft_smelt``
+only when head 0 sampled the craft action (15); head 2 honours
+``mask_equip_place`` for equip/place (16/17) and ``mask_destroy`` for
+destroy (18).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.algos.dreamer_v2.agent as dv2_agent
+import sheeprl_tpu.algos.dreamer_v3.agent as dv3_agent
+
+ACTIONS_DIM = (19, 6, 5)
+B = 8
+LATENT = 16
+
+
+def _make(module):
+    actor = module(
+        actions_dim=ACTIONS_DIM,
+        is_continuous=False,
+        distribution="discrete",
+        dense_units=16,
+        mlp_layers=1,
+    )
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((B, LATENT)))
+    return actor, params
+
+
+def _full_mask(valid_types):
+    """All-arg-valid mask allowing only ``valid_types`` in head 0."""
+    m0 = np.zeros((B, ACTIONS_DIM[0]), np.float32)
+    m0[:, valid_types] = 1.0
+    return {
+        "mask_action_type": jnp.asarray(m0),
+        "mask_craft_smelt": jnp.ones((B, ACTIONS_DIM[1]), jnp.float32),
+        "mask_destroy": jnp.ones((B, ACTIONS_DIM[2]), jnp.float32),
+        "mask_equip_place": jnp.ones((B, ACTIONS_DIM[2]), jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("agent_mod,actor_cls_name", [(dv3_agent, "MinedojoActor"), (dv2_agent, "MinedojoActor")])
+@pytest.mark.parametrize("greedy", [False, True])
+def test_masked_action_types_never_sampled(agent_mod, actor_cls_name, greedy):
+    actor, params = _make(getattr(agent_mod, actor_cls_name))
+    mask = _full_mask(valid_types=[0, 3, 15])
+    state = jax.random.normal(jax.random.PRNGKey(1), (B, LATENT))
+    for seed in range(20):
+        acts, _ = agent_mod.actor_sample(
+            actor, params, state, jax.random.PRNGKey(seed), greedy=greedy, mask=mask
+        )
+        chosen = np.argmax(np.asarray(acts[0]), axis=-1)
+        assert set(chosen.tolist()) <= {0, 3, 15}
+
+
+@pytest.mark.parametrize("agent_mod", [dv3_agent, dv2_agent])
+def test_craft_arg_masked_when_crafting(agent_mod):
+    actor, params = _make(agent_mod.MinedojoActor)
+    # Force the functional action to craft (15): head-1 must then respect
+    # mask_craft_smelt.
+    mask = _full_mask(valid_types=[15])
+    m1 = np.zeros((B, ACTIONS_DIM[1]), np.float32)
+    m1[:, [1, 4]] = 1.0
+    mask["mask_craft_smelt"] = jnp.asarray(m1)
+    state = jax.random.normal(jax.random.PRNGKey(2), (B, LATENT))
+    for seed in range(20):
+        acts, _ = agent_mod.actor_sample(
+            actor, params, state, jax.random.PRNGKey(seed), greedy=False, mask=mask
+        )
+        assert np.all(np.argmax(np.asarray(acts[0]), -1) == 15)
+        assert set(np.argmax(np.asarray(acts[1]), -1).tolist()) <= {1, 4}
+
+
+@pytest.mark.parametrize("agent_mod", [dv3_agent, dv2_agent])
+@pytest.mark.parametrize("forced_type,mask_key,valid", [(16, "mask_equip_place", [2]), (17, "mask_equip_place", [2]), (18, "mask_destroy", [0, 3])])
+def test_arg_head_masked_by_functional_action(agent_mod, forced_type, mask_key, valid):
+    actor, params = _make(agent_mod.MinedojoActor)
+    mask = _full_mask(valid_types=[forced_type])
+    m2 = np.zeros((B, ACTIONS_DIM[2]), np.float32)
+    m2[:, valid] = 1.0
+    mask[mask_key] = jnp.asarray(m2)
+    state = jax.random.normal(jax.random.PRNGKey(3), (B, LATENT))
+    for seed in range(20):
+        acts, _ = agent_mod.actor_sample(
+            actor, params, state, jax.random.PRNGKey(seed), greedy=False, mask=mask
+        )
+        assert np.all(np.argmax(np.asarray(acts[0]), -1) == forced_type)
+        assert set(np.argmax(np.asarray(acts[2]), -1).tolist()) <= set(valid)
+
+
+@pytest.mark.parametrize("agent_mod", [dv3_agent, dv2_agent])
+def test_plain_actor_ignores_mask(agent_mod):
+    """The base Actor keeps reference behaviour: masks are ignored."""
+    actor, params = _make(agent_mod.Actor)
+    mask = _full_mask(valid_types=[0])
+    state = jax.random.normal(jax.random.PRNGKey(4), (B, LATENT))
+    seen = set()
+    for seed in range(30):
+        acts, _ = agent_mod.actor_sample(
+            actor, params, state, jax.random.PRNGKey(seed), greedy=False, mask=mask
+        )
+        seen |= set(np.argmax(np.asarray(acts[0]), -1).tolist())
+    # A freshly-initialized near-uniform policy over 19 types must stray
+    # outside {0} if the mask is (correctly) not applied.
+    assert len(seen) > 1
+
+
+def test_extract_obs_masks():
+    obs = {"rgb": jnp.zeros((1, 4)), "mask_action_type": jnp.ones((1, 19)), "inventory": jnp.zeros((1, 2))}
+    mask = dv3_agent.extract_obs_masks(obs)
+    assert set(mask) == {"mask_action_type"}
+    assert dv3_agent.extract_obs_masks({"rgb": jnp.zeros((1, 4))}) is None
+
+
+def test_minedojo_exp_config_selects_minedojo_actor():
+    from sheeprl_tpu.config import compose
+
+    cfg = compose(["exp=dreamer_v3_minedojo"])
+    assert cfg.algo.actor.cls.rsplit(".", 1)[-1] == "MinedojoActor"

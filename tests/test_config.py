@@ -56,3 +56,29 @@ def test_cli_override_types():
     assert cfg.algo.optimizer.lr == pytest.approx(5e-4)
     assert cfg.env.num_envs == 2
     assert cfg.algo.anneal_lr is True
+
+
+def _all_exp_names():
+    from pathlib import Path
+
+    import sheeprl_tpu
+
+    exp_dir = Path(sheeprl_tpu.__file__).parent / "configs" / "exp"
+    return sorted(p.stem for p in exp_dir.glob("*.yaml") if p.stem != "default")
+
+
+@pytest.mark.parametrize("exp", _all_exp_names())
+def test_every_exp_config_composes(exp):
+    """Every shipped exp overlay must compose and fully resolve (the named
+    runs — 100k_ms_pacman, XL_crafter, the DOA++ P2E pair, ... — are the
+    BASELINE north-star commands; a broken overlay means an unlaunchable
+    flagship run)."""
+    overrides = [f"exp={exp}"]
+    # Finetuning overlays mandate an exploration checkpoint path.
+    if "finetuning" in exp or "fntn" in exp:
+        overrides.append("checkpoint.exploration_ckpt_path=/tmp/fake.ckpt")
+    cfg = compose(overrides)
+    assert cfg.algo.name
+    assert cfg.env.id is not None
+    # The resolved tree must serialize (catches dangling interpolations).
+    assert "algo:" in to_yaml(cfg)
