@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 import warnings
 from functools import partial
-from typing import Any, Dict, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import gymnasium as gym  # noqa: F401
 import jax
@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     Actor,
+    PlayerDV3,
     WorldModel,
     actor_dists,
     actor_sample,
@@ -63,7 +64,38 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
-__all__ = ["main", "make_train_step"]
+__all__ = ["main", "make_train_step", "ring_append_rows", "ring_sample_windows"]
+
+
+def ring_append_rows(pos, valid_n, staged_mask, capacity: int):
+    """Per-env ragged ring-append indices (burst mode).
+
+    Slot ``i`` writes env ``e`` iff ``staged_mask[i, e]``; each env's rows
+    pack densely from its own write head (mirrors
+    ``EnvIndependentReplayBuffer``'s ragged adds). Returns the ``(S, E)``
+    row indices (``capacity`` marks dropped/padded slots), the new per-env
+    write heads and the new per-env valid counts.
+    """
+    counts = jnp.cumsum(staged_mask.astype(jnp.int32), axis=0)  # (S, E)
+    row = (pos[None, :] + counts - 1) % capacity
+    row = jnp.where(staged_mask > 0, row, capacity)
+    new_pos = (pos + counts[-1]) % capacity
+    new_valid = jnp.minimum(valid_n + counts[-1], capacity)
+    return row, new_pos, new_valid
+
+
+def ring_sample_windows(key, env_idx, pos, valid_n, capacity: int, seq_len: int):
+    """Uniform sequence-window starts with the ``SequentialReplayBuffer``
+    validity rule: a window never crosses its env's write head (the
+    oldest→newest data boundary once the ring is full). Returns ``(T, B)``
+    time indices for the given per-element env choices."""
+    vn = valid_n[env_idx]
+    full = vn >= capacity
+    n_starts = jnp.where(full, capacity - seq_len + 1, jnp.maximum(vn - seq_len + 1, 1))
+    base = jnp.where(full, pos[env_idx], 0)
+    u = jax.random.uniform(key, env_idx.shape)
+    start = (base + (u * n_starts).astype(jnp.int32)) % capacity
+    return (start[None, :] + jnp.arange(seq_len)[:, None]) % capacity
 
 
 def make_train_step(
@@ -75,8 +107,25 @@ def make_train_step(
     actions_dim: Sequence[int],
     is_continuous: bool,
     txs: Dict[str, Any],
+    ring: Optional[Dict[str, Any]] = None,
 ):
-    """Build the fully-jitted G-step Dreamer update (see module docstring)."""
+    """Build the fully-jitted G-step Dreamer update (see module docstring).
+
+    With ``ring`` (TPU-native burst mode, no reference counterpart) the
+    returned function owns a DEVICE-RESIDENT sequence ring instead of taking
+    host-sampled ``(G, T, B, ...)`` data: one dispatch appends the staged
+    transitions (per-env write heads — reset rows advance only the done
+    envs, mirroring ``EnvIndependentReplayBuffer``'s ragged adds) and runs
+    ``ring["grad_chunk"]`` gradient steps, drawing each step's
+    ``(T, B)`` windows on device with the `SequentialReplayBuffer` validity
+    rule (windows never cross an env's write head). Pixels stay uint8 in
+    HBM and only raw transitions ride host→device, so a tunneled chip pays
+    one round-trip per burst instead of one per gradient step plus the
+    full replay batch traffic.
+
+    ``ring`` keys: capacity, n_envs, stage_max, grad_chunk, seq_len,
+    batch_size, obs_specs ({name: (dims..., dtype)}).
+    """
     rssm = world_model.rssm
     wm_cfg = cfg.algo.world_model
     cnn_enc = list(cfg.algo.cnn_keys.encoder)
@@ -305,24 +354,77 @@ def make_train_step(
         )
         return (params, opts, moments_state, cum + 1), metrics
 
-    def local_train(params, opts, moments_state, data, key, cum0):
+    if ring is None:
+        def local_train(params, opts, moments_state, data, key, cum0):
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            n_steps = jax.tree.leaves(data)[0].shape[0]
+            keys = jax.random.split(key, n_steps)
+            (params, opts, moments_state, _), metrics = jax.lax.scan(
+                gradient_step, (params, opts, moments_state, cum0), (data, keys)
+            )
+            metrics = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), metrics)
+            return params, opts, moments_state, metrics
+
+        shard_train = jax.shard_map(
+            local_train,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(None, None, "dp"), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(shard_train, donate_argnums=(0, 1, 2))
+
+    capacity = int(ring["capacity"])
+    ring_envs = int(ring["n_envs"])
+    stage_max = int(ring["stage_max"])
+    grad_chunk = int(ring["grad_chunk"])
+    ring_seq = int(ring["seq_len"])
+    ring_batch = int(ring["batch_size"])
+    n_dev = mesh.devices.size
+
+    def local_burst(params, opts, moments_state, rb, staged, staged_mask, pos, valid_n, key, cum0, valid):
+        # -- per-env ring append. Slot i writes env e iff staged_mask[i, e];
+        # each env's rows pack densely from its own write head (ragged adds).
+        row, new_pos, new_valid = ring_append_rows(pos, valid_n, staged_mask, capacity)
+        cols = jnp.broadcast_to(jnp.arange(ring_envs)[None, :], row.shape)
+        rb = {k: rb[k].at[row, cols].set(staged[k], mode="drop") for k in rb}
+        # No env may be shorter than a sample window yet (the host buffer
+        # raises in that case); until then every step is a no-op append.
+        valid = valid * jnp.all(new_valid >= ring_seq).astype(valid.dtype)
+
+        def sampled_step(carry, xs):
+            k, valid_flag = xs
+            k_env, k_start, k_grad = jax.random.split(k, 3)
+            B = ring_batch // n_dev
+            env_idx = jax.random.randint(k_env, (B,), 0, ring_envs)
+            t_idx = ring_sample_windows(
+                k_start, env_idx, new_pos, new_valid, capacity, ring_seq
+            )  # (T, B)
+            batch = {k: rb[k][t_idx, env_idx[None, :]] for k in rb}
+            new_carry, metrics = gradient_step(carry, (batch, k_grad))
+            # Padding steps beyond the granted chunk are no-ops.
+            new_carry = jax.tree.map(lambda n, o: jnp.where(valid_flag > 0, n, o), new_carry, carry)
+            return new_carry, metrics
+
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
-        n_steps = jax.tree.leaves(data)[0].shape[0]
-        keys = jax.random.split(key, n_steps)
+        keys = jax.random.split(key, grad_chunk)
         (params, opts, moments_state, _), metrics = jax.lax.scan(
-            gradient_step, (params, opts, moments_state, cum0), (data, keys)
+            sampled_step, (params, opts, moments_state, cum0), (keys, valid)
         )
         metrics = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), metrics)
-        return params, opts, moments_state, metrics
+        return params, opts, moments_state, rb, metrics
 
-    shard_train = jax.shard_map(
-        local_train,
+    shard_burst = jax.shard_map(
+        local_burst,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(None, None, "dp"), P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(),) * 11,
+        out_specs=(P(),) * 5,
         check_vma=False,
     )
-    return jax.jit(shard_train, donate_argnums=(0, 1, 2))
+    # Only the ring is donated: params/opts/moments handles are read by the
+    # main thread (checkpoints) while a burst may be in flight — donation
+    # would hand it deleted buffers.
+    return jax.jit(shard_burst, donate_argnums=(3,))
 
 
 @register_algorithm()
@@ -486,11 +588,196 @@ def main(fabric, cfg: Dict[str, Any]):
         raise ValueError(
             f"per_rank_batch_size ({batch_size}) must be divisible by the number of devices ({fabric.world_size})"
         )
-    train_fn = make_train_step(world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs)
-    data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
-
     rng = jax.random.PRNGKey(cfg.seed)
     cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    # TPU-native overlap (same design as SAC's `hybrid_player`): the policy
+    # runs on the host CPU from a packed bf16 params snapshot, replay lives
+    # in a device-resident uint8 sequence ring, and Ratio grants are
+    # dispatched in bursts on a trainer thread. On a tunneled chip this
+    # removes the per-step action pull (~one wire round-trip per env step)
+    # and the per-grant replay-batch upload (batch 16 x seq 64 of 64x64
+    # pixels is ~12.6 MB per gradient step).
+    hp_cfg = cfg.algo.get("hybrid_player") or {}
+    hp_enabled = hp_cfg.get("enabled", "auto")
+    mesh_platform = fabric.mesh.devices.flat[0].platform
+    if isinstance(hp_enabled, str):
+        hp_enabled = (mesh_platform != "cpu") if hp_enabled.lower() == "auto" else hp_enabled.lower() == "true"
+    burst_mode = bool(hp_enabled)
+    train_every = max(1, int(hp_cfg.get("train_every", 16)))
+    snapshot_every = max(1, int(hp_cfg.get("snapshot_every", 4)))
+
+    if burst_mode:
+        import queue as _queue
+        import threading as _threading
+
+        from jax.flatten_util import ravel_pytree
+
+        grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
+        # Steady-state staging only (one regular row + at most one ragged
+        # reset row per iteration between bursts): the prefill phase flushes
+        # append-only bursts (chunk=0) instead of inflating every payload.
+        stage_max = min(4 * train_every + int(cfg.env.num_envs) + 2, buffer_size)
+        wm_cfg_ = cfg.algo.world_model
+        obs_specs = {}
+        for k in cnn_keys:
+            obs_specs[k] = (tuple(observation_space[k].shape), jnp.uint8)
+        for k in mlp_keys:
+            obs_specs[k] = (tuple(observation_space[k].shape), jnp.float32)
+        ring_keys = {
+            **obs_specs,
+            "actions": ((int(np.sum(actions_dim)),), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "terminated": ((1,), jnp.float32),
+            "is_first": ((1,), jnp.float32),
+        }
+        ring_spec = {
+            "capacity": buffer_size,
+            "n_envs": int(cfg.env.num_envs),
+            "stage_max": stage_max,
+            "grad_chunk": grad_chunk,
+            "seq_len": seq_len,
+            "batch_size": batch_size,
+        }
+        burst_fn = make_train_step(
+            world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs, ring=ring_spec
+        )
+        rb_dev = {
+            k: fabric.put_replicated(jnp.zeros((buffer_size, int(cfg.env.num_envs)) + shape, dtype))
+            for k, (shape, dtype) in ring_keys.items()
+        }
+        dev_pos = np.zeros(int(cfg.env.num_envs), np.int64)
+        dev_valid = np.zeros(int(cfg.env.num_envs), np.int64)
+        if state is not None and cfg.buffer.checkpoint:
+            # Mirror the restored per-env host buffers onto the device ring.
+            for e, sub in enumerate(rb.buffer):
+                for k in rb_dev:
+                    host = np.asarray(sub.buffer[k][:, 0], dtype=rb_dev[k].dtype)
+                    rb_dev[k] = rb_dev[k].at[:, e].set(jnp.asarray(host))
+                dev_pos[e] = sub._pos
+                dev_valid[e] = buffer_size if sub.full else sub._pos
+            rb_dev = {k: fabric.put_replicated(v) for k, v in rb_dev.items()}
+        staged: list = []  # (data dict, env mask) per ring row
+        grant_backlog = 0
+
+        # -- host-CPU player from a packed bf16 snapshot -----------------
+        host_device = jax.devices("cpu")[0]
+
+        def _player_subset(p):
+            wm = p["world_model"]
+            return {
+                "world_model": {
+                    "encoder": wm["encoder"],
+                    "recurrent_model": wm["recurrent_model"],
+                    "representation_model": wm["representation_model"],
+                    "transition_model": wm["transition_model"],
+                    "initial_recurrent_state": wm["initial_recurrent_state"],
+                },
+                "actor": p["actor"],
+            }
+
+        _, _unravel = ravel_pytree(jax.tree.map(np.asarray, _player_subset(params)))
+        _pack = jax.jit(lambda p: ravel_pytree(_player_subset(p))[0].astype(jnp.bfloat16))
+        _unpack = jax.jit(lambda v: _unravel(v.astype(jnp.float32)))
+        host_params = _unpack(jax.device_put(_pack(params), host_device))
+        host_player = PlayerDV3(
+            world_model,
+            actor,
+            actions_dim,
+            cfg.env.num_envs,
+            int(wm_cfg_.stochastic_size),
+            int(wm_cfg_.recurrent_model.recurrent_state_size),
+            discrete_size=int(wm_cfg_.discrete_size),
+        )
+        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), host_device)
+        _snapshot_slot: list = [None]
+
+        # -- trainer thread ----------------------------------------------
+        _tr = {
+            "params": params, "opts": opts, "moments": moments_state,
+            "rb_dev": rb_dev, "metrics": None, "error": None, "bursts": 0,
+        }
+        _tr_lock = _threading.Lock()
+        _burst_q: "_queue.Queue" = _queue.Queue(maxsize=2)
+
+        def _burst_worker():
+            while True:
+                job = _burst_q.get()
+                if job is None:
+                    return
+                try:
+                    staged_j, mask_j, pos_j, valid_j, key_j, cum_j, validmask_j, trained = job
+                    out = burst_fn(
+                        _tr["params"], _tr["opts"], _tr["moments"], _tr["rb_dev"],
+                        staged_j, mask_j, pos_j, valid_j, key_j, cum_j, validmask_j,
+                    )
+                    with _tr_lock:
+                        _tr["params"], _tr["opts"], _tr["moments"], _tr["rb_dev"] = out[:4]
+                        if trained:  # append-only bursts produce junk metrics
+                            _tr["metrics"] = out[4]
+                            _tr["bursts"] += 1
+                    if trained and _tr["bursts"] % snapshot_every == 0:
+                        # One packed pull; blocking is fine on this thread.
+                        _snapshot_slot[0] = jax.device_put(_pack(_tr["params"]), host_device)
+                except Exception as exc:  # surfaced at the next put/join
+                    _tr["error"] = exc
+                    while _burst_q.get() is not None:
+                        pass
+                    return
+
+        _burst_thread = _threading.Thread(target=_burst_worker, daemon=True)
+        _burst_thread.start()
+
+        def _flush_burst():
+            nonlocal rng, grant_backlog, cumulative_per_rank_gradient_steps, train_step
+            count = len(staged)
+            arrs = {}
+            for k, (shape, dtype) in ring_keys.items():
+                arr = np.zeros((stage_max, int(cfg.env.num_envs)) + shape, dtype)
+                for i, (data, _m) in enumerate(staged):
+                    arr[i] = data[k]
+                arrs[k] = arr
+            mask = np.zeros((stage_max, int(cfg.env.num_envs)), np.int32)
+            for i, (_d, m) in enumerate(staged):
+                mask[i] = m
+            staged.clear()
+            # Hold grants while any env is still shorter than a sample
+            # window (the host buffer refuses to sample in that state).
+            env_counts = mask.sum(axis=0)
+            ready = (dev_valid + env_counts).min() >= seq_len
+            chunk = min(grad_chunk, grant_backlog) if ready else 0
+            validmask = np.zeros((grad_chunk,), np.float32)
+            validmask[:chunk] = 1.0
+            if _tr["error"] is not None:
+                raise _tr["error"]
+            with timer("Time/train_time", SumMetric):
+                rng, train_key = jax.random.split(rng)
+                _burst_q.put((
+                    arrs, jnp.asarray(mask), jnp.asarray(dev_pos, jnp.int32),
+                    jnp.asarray(dev_valid, jnp.int32), train_key,
+                    jnp.int32(cumulative_per_rank_gradient_steps), jnp.asarray(validmask),
+                    chunk > 0,
+                ))
+                if aggregator and not aggregator.disabled and _tr["metrics"] is not None:
+                    names = (
+                        "Loss/world_model_loss", "Loss/observation_loss", "Loss/reward_loss",
+                        "Loss/state_loss", "Loss/continue_loss", "State/kl", "State/post_entropy",
+                        "State/prior_entropy", "Loss/policy_loss", "Loss/value_loss",
+                    )
+                    for name, value in zip(names, _tr["metrics"]):
+                        if name in aggregator:
+                            aggregator.update(name, value)
+            dev_pos[:] = (dev_pos + env_counts) % buffer_size
+            dev_valid[:] = np.minimum(dev_valid + env_counts, buffer_size)
+            grant_backlog -= chunk
+            if chunk > 0:
+                cumulative_per_rank_gradient_steps += chunk
+                train_step += 1
+            return chunk
+    else:
+        train_fn = make_train_step(world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs)
+    data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
 
     # First observation (reference: dreamer_v3.py:538-551)
     step_data: Dict[str, np.ndarray] = {}
@@ -501,7 +788,10 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), dtype=np.float32)
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), dtype=np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(params)
+    if burst_mode:
+        host_player.init_states(host_params)
+    else:
+        player.init_states(params)
 
     from sheeprl_tpu.utils.profiler import TraceProfiler
 
@@ -511,6 +801,10 @@ def main(fabric, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         profiler.tick(iter_num)
         policy_step += policy_steps_per_iter
+
+        if burst_mode and _snapshot_slot[0] is not None:
+            host_params = _unpack(_snapshot_slot[0])
+            _snapshot_slot[0] = None
 
         with timer("Time/env_interaction_time", SumMetric):
             if iter_num <= learning_starts and state is None:
@@ -522,6 +816,17 @@ def main(fabric, cfg: Dict[str, Any]):
                         [np.eye(d, dtype=np.float32)[acts2d[:, i]] for i, d in enumerate(actions_dim)],
                         axis=-1,
                     )
+            elif burst_mode:
+                # Host-CPU policy on the snapshot params: numpy obs +
+                # CPU-committed params keep the whole step off the wire.
+                jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                host_rng, subkey = jax.random.split(host_rng)
+                action_list = host_player.get_actions(host_params, jobs, subkey)
+                actions = np.asarray(jnp.concatenate(action_list, axis=-1))
+                if is_continuous:
+                    real_actions = actions
+                else:
+                    real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in action_list], axis=-1)
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
                 rng, subkey = jax.random.split(rng)
@@ -534,6 +839,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
             step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if burst_mode:
+                staged.append((
+                    {k: np.asarray(step_data[k][0]) for k in ring_keys},
+                    np.ones(cfg.env.num_envs, np.int32),
+                ))
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -550,6 +860,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     sub_rb["truncated"][last_inserted_idx] = np.ones_like(sub_rb["truncated"][last_inserted_idx])
                     sub_rb["is_first"][last_inserted_idx] = np.zeros_like(sub_rb["is_first"][last_inserted_idx])
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
+                    if burst_mode and staged:
+                        # Same truncation patch on the row still in staging
+                        # (truncated isn't stored in the device ring).
+                        staged[-1][0]["terminated"][i] = 0.0
+                        staged[-1][0]["is_first"][i] = 0.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             ep_info = infos["final_info"]
@@ -593,16 +908,36 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if burst_mode:
+                # Ragged ring row: only the done envs advance their heads.
+                row = {}
+                env_mask = np.zeros(cfg.env.num_envs, np.int32)
+                env_mask[dones_idxes] = 1
+                for k, (shape, dtype) in ring_keys.items():
+                    full_row = np.zeros((cfg.env.num_envs,) + shape, dtype)
+                    full_row[dones_idxes] = np.asarray(reset_data[k][0])
+                    row[k] = full_row
+                staged.append((row, env_mask))
 
             # Reset already-inserted step data (reference: dreamer_v3.py:652-658)
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
             step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
             step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
-            player.init_states(params, dones_idxes)
+            if burst_mode:
+                host_player.init_states(host_params, dones_idxes)
+            else:
+                player.init_states(params, dones_idxes)
 
         # Train (reference: dreamer_v3.py:660-706)
-        if iter_num >= learning_starts:
+        if burst_mode:
+            if iter_num >= learning_starts:
+                grant_backlog += ratio(policy_step - prefill_steps * policy_steps_per_iter)
+            while grant_backlog >= grad_chunk or len(staged) >= stage_max - 1 - cfg.env.num_envs:
+                consumed = _flush_burst()
+                if consumed == 0 or grant_backlog < grad_chunk:
+                    break
+        elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps * policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
                 sample = rb.sample(
@@ -665,6 +1000,10 @@ def main(fabric, cfg: Dict[str, Any]):
             iter_num == total_iters and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
+            if burst_mode:
+                # Latest trainer-thread handles (at most one burst stale).
+                with _tr_lock:
+                    params, opts, moments_state = _tr["params"], _tr["opts"], _tr["moments"]
             ckpt_state = {
                 "world_model": params["world_model"],
                 "actor": params["actor"],
@@ -685,6 +1024,19 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+
+    if burst_mode:
+        # Flush the tail: Ratio already counted the remaining grants. Grants
+        # that can never execute (data still shorter than a window) are
+        # abandoned with the run.
+        while staged or grant_backlog:
+            if _flush_burst() == 0 and not staged:
+                break
+        _burst_q.put(None)
+        _burst_thread.join()
+        if _tr["error"] is not None:
+            raise _tr["error"]
+        params, opts, moments_state = _tr["params"], _tr["opts"], _tr["moments"]
 
     envs.close()
     profiler.close()

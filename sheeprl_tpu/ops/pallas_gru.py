@@ -40,17 +40,19 @@ def gru_gates_reference(fused: jax.Array, h: jax.Array) -> jax.Array:
 def _kernel(fused_ref, h_ref, out_ref):
     from jax.experimental import pallas as pl  # noqa: F401  (kernel body uses refs only)
 
-    fused = fused_ref[...]
-    h = h_ref[...]
+    # Gate math in f32 regardless of the IO dtype: Mosaic rejects the mixed
+    # f32-scalar/bf16-vector broadcasts the transcendental lowerings emit
+    # under bf16, and the VPU pays nothing extra for f32 elementwise.
+    fused = fused_ref[...].astype(jnp.float32)
+    h = h_ref[...].astype(jnp.float32)
     hidden = h.shape[-1]
     reset = jax.nn.sigmoid(fused[..., :hidden])
     cand = jnp.tanh(reset * fused[..., hidden : 2 * hidden])
     update = jax.nn.sigmoid(fused[..., 2 * hidden :] - 1)
-    out_ref[...] = update * cand + (1 - update) * h
+    out_ref[...] = (update * cand + (1 - update) * h).astype(out_ref.dtype)
 
 
-@functools.partial(jax.named_call, name="pallas_gru_gates")
-def _forward(fused: jax.Array, h: jax.Array) -> jax.Array:
+def _pallas_forward(fused: jax.Array, h: jax.Array, interpret: bool) -> jax.Array:
     from jax.experimental import pallas as pl
 
     B, H = h.shape
@@ -67,8 +69,22 @@ def _forward(fused: jax.Array, h: jax.Array) -> jax.Array:
         ],
         out_specs=pl.BlockSpec((block_b, H), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H), h.dtype),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interpret,
     )(fused, h)
+
+
+@functools.partial(jax.named_call, name="pallas_gru_gates")
+def _forward(fused: jax.Array, h: jax.Array) -> jax.Array:
+    # Per-platform dispatch at LOWERING time: one process can trace the same
+    # cell for both the TPU (compiled kernel) and the host CPU player
+    # (interpret mode) — a process-global default_backend switch cannot.
+    # Every non-TPU platform interprets, as before.
+    return jax.lax.platform_dependent(
+        fused,
+        h,
+        tpu=functools.partial(_pallas_forward, interpret=False),
+        default=functools.partial(_pallas_forward, interpret=True),
+    )
 
 
 @jax.custom_vjp

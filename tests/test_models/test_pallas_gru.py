@@ -66,3 +66,17 @@ def test_kernel_inside_scan():
 
     _, want = jax.lax.scan(step_ref, h0, fused_seq)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+def test_forward_bf16_io():
+    """bf16 inputs (bf16-mixed precision configs) must lower and match the
+    reference chain at bf16 tolerance — the kernel computes in f32 and casts
+    back at the boundary."""
+    rng = np.random.default_rng(4)
+    B, H = 8, 16
+    fused = jnp.asarray(rng.normal(size=(B, 3 * H)).astype(np.float32), dtype=jnp.bfloat16)
+    h = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32), dtype=jnp.bfloat16)
+    got = np.asarray(gru_gates(fused, h), dtype=np.float32)
+    want = np.asarray(gru_gates_reference(fused.astype(jnp.float32), h.astype(jnp.float32)))
+    assert got.dtype == np.float32 and gru_gates(fused, h).dtype == jnp.bfloat16
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
