@@ -62,7 +62,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
 
 __all__ = ["main", "make_train_step", "ring_append_rows", "ring_sample_windows"]
 
@@ -123,8 +123,8 @@ def make_train_step(
     one round-trip per burst instead of one per gradient step plus the
     full replay batch traffic.
 
-    ``ring`` keys: capacity, n_envs, stage_max, grad_chunk, seq_len,
-    batch_size, obs_specs ({name: (dims..., dtype)}).
+    ``ring`` keys: capacity, n_envs, grad_chunk, seq_len, batch_size (the
+    ring/staged array shapes and dtypes are implied by the arguments).
     """
     rssm = world_model.rssm
     wm_cfg = cfg.algo.world_model
@@ -376,7 +376,6 @@ def make_train_step(
 
     capacity = int(ring["capacity"])
     ring_envs = int(ring["n_envs"])
-    stage_max = int(ring["stage_max"])
     grad_chunk = int(ring["grad_chunk"])
     ring_seq = int(ring["seq_len"])
     ring_batch = int(ring["batch_size"])
@@ -401,9 +400,14 @@ def make_train_step(
                 k_start, env_idx, new_pos, new_valid, capacity, ring_seq
             )  # (T, B)
             batch = {k: rb[k][t_idx, env_idx[None, :]] for k in rb}
-            new_carry, metrics = gradient_step(carry, (batch, k_grad))
-            # Padding steps beyond the granted chunk are no-ops.
-            new_carry = jax.tree.map(lambda n, o: jnp.where(valid_flag > 0, n, o), new_carry, carry)
+            # Padding steps beyond the granted chunk skip the whole gradient
+            # computation (lax.cond executes one branch), not just its result.
+            def _run(c):
+                nc, m = gradient_step(c, (batch, k_grad))
+                return nc, tuple(x.astype(jnp.float32) for x in m)
+
+            zeros = tuple(jnp.zeros((), jnp.float32) for _ in range(10))
+            new_carry, metrics = jax.lax.cond(valid_flag > 0, _run, lambda c: (c, zeros), carry)
             return new_carry, metrics
 
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
@@ -411,7 +415,9 @@ def make_train_step(
         (params, opts, moments_state, _), metrics = jax.lax.scan(
             sampled_step, (params, opts, moments_state, cum0), (keys, valid)
         )
-        metrics = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), metrics)
+        # Average over the GRANTED steps only (padding contributes zeros).
+        denom = jnp.maximum(valid.sum(), 1.0)
+        metrics = jax.tree.map(lambda x: jax.lax.pmean((x * valid).sum() / denom, "dp"), metrics)
         return params, opts, moments_state, rb, metrics
 
     shard_burst = jax.shard_map(
@@ -600,13 +606,13 @@ def main(fabric, cfg: Dict[str, Any]):
     # and the per-grant replay-batch upload (batch 16 x seq 64 of 64x64
     # pixels is ~12.6 MB per gradient step).
     hp_cfg = cfg.algo.get("hybrid_player") or {}
-    hp_enabled = hp_cfg.get("enabled", "auto")
-    mesh_platform = fabric.mesh.devices.flat[0].platform
-    if isinstance(hp_enabled, str):
-        hp_enabled = (mesh_platform != "cpu") if hp_enabled.lower() == "auto" else hp_enabled.lower() == "true"
-    burst_mode = bool(hp_enabled)
+    burst_mode = resolve_hybrid_player(hp_cfg, fabric.mesh)
     train_every = max(1, int(hp_cfg.get("train_every", 16)))
     snapshot_every = max(1, int(hp_cfg.get("snapshot_every", 4)))
+    # The host replay mirror only matters for checkpoints once the device
+    # ring owns sampling; without it every pixel transition would be stored
+    # twice (HBM ring + host RAM/memmap).
+    host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
 
     if burst_mode:
         import queue as _queue
@@ -635,7 +641,6 @@ def main(fabric, cfg: Dict[str, Any]):
         ring_spec = {
             "capacity": buffer_size,
             "n_envs": int(cfg.env.num_envs),
-            "stage_max": stage_max,
             "grad_chunk": grad_chunk,
             "seq_len": seq_len,
             "batch_size": batch_size,
@@ -816,21 +821,16 @@ def main(fabric, cfg: Dict[str, Any]):
                         [np.eye(d, dtype=np.float32)[acts2d[:, i]] for i, d in enumerate(actions_dim)],
                         axis=-1,
                     )
-            elif burst_mode:
-                # Host-CPU policy on the snapshot params: numpy obs +
-                # CPU-committed params keep the whole step off the wire.
-                jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                host_rng, subkey = jax.random.split(host_rng)
-                action_list = host_player.get_actions(host_params, jobs, subkey)
-                actions = np.asarray(jnp.concatenate(action_list, axis=-1))
-                if is_continuous:
-                    real_actions = actions
-                else:
-                    real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in action_list], axis=-1)
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                rng, subkey = jax.random.split(rng)
-                action_list = player.get_actions(params, jobs, subkey)
+                if burst_mode:
+                    # Host-CPU policy on the snapshot params: numpy obs +
+                    # CPU-committed params keep the whole step off the wire.
+                    host_rng, subkey = jax.random.split(host_rng)
+                    action_list = host_player.get_actions(host_params, jobs, subkey)
+                else:
+                    rng, subkey = jax.random.split(rng)
+                    action_list = player.get_actions(params, jobs, subkey)
                 actions = np.asarray(jnp.concatenate(action_list, axis=-1))
                 if is_continuous:
                     real_actions = actions
@@ -838,7 +838,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in action_list], axis=-1)
 
             step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if host_mirror:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
             if burst_mode:
                 staged.append((
                     {k: np.asarray(step_data[k][0]) for k in ring_keys},
@@ -854,11 +855,18 @@ def main(fabric, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, agent_roe in enumerate(infos["restart_on_exception"]):
                 if agent_roe and not dones[i]:
-                    sub_rb = rb.buffer[i]
-                    last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
-                    sub_rb["terminated"][last_inserted_idx] = np.zeros_like(sub_rb["terminated"][last_inserted_idx])
-                    sub_rb["truncated"][last_inserted_idx] = np.ones_like(sub_rb["truncated"][last_inserted_idx])
-                    sub_rb["is_first"][last_inserted_idx] = np.zeros_like(sub_rb["is_first"][last_inserted_idx])
+                    if host_mirror:
+                        sub_rb = rb.buffer[i]
+                        last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
+                        sub_rb["terminated"][last_inserted_idx] = np.zeros_like(
+                            sub_rb["terminated"][last_inserted_idx]
+                        )
+                        sub_rb["truncated"][last_inserted_idx] = np.ones_like(
+                            sub_rb["truncated"][last_inserted_idx]
+                        )
+                        sub_rb["is_first"][last_inserted_idx] = np.zeros_like(
+                            sub_rb["is_first"][last_inserted_idx]
+                        )
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
                     if burst_mode and staged:
                         # Same truncation patch on the row still in staging
@@ -907,7 +915,8 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))), dtype=np.float32)
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if host_mirror:
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             if burst_mode:
                 # Ragged ring row: only the done envs advance their heads.
                 row = {}

@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
 
 __all__ = ["main", "make_train_step"]
 
@@ -365,10 +365,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # stay the source of truth; actions are one snapshot stale, the same
     # trade the reference's decoupled topology makes (`sac_decoupled.py`).
     hp_cfg = cfg.algo.get("hybrid_player") or {}
-    hp_enabled = hp_cfg.get("enabled", "auto")
-    mesh_platform = fabric.mesh.devices.flat[0].platform
-    if isinstance(hp_enabled, str):
-        hp_enabled = (mesh_platform != "cpu") if hp_enabled.lower() == "auto" else hp_enabled.lower() == "true"
+    hp_enabled = resolve_hybrid_player(hp_cfg, fabric.mesh)
     hp_refresh = max(1, int(hp_cfg.get("refresh_every", 64)))
     host_actor_params = None
     host_rng = None

@@ -129,3 +129,14 @@ def save_configs(cfg: Mapping[str, Any], log_dir: str) -> None:
     """Persist the resolved config next to the run artifacts
     (reference: ``sheeprl/utils/utils.py:257-258``)."""
     save_config(cfg, os.path.join(log_dir, "config.yaml"))
+
+
+def resolve_hybrid_player(hp_cfg: Optional[Mapping[str, Any]], mesh) -> bool:
+    """Resolve ``algo.hybrid_player.enabled``: ``"auto"`` turns the host-side
+    policy overlap on iff the trainer mesh lives off the host CPU (shared by
+    SAC and Dreamer-V3)."""
+    enabled = (hp_cfg or {}).get("enabled", "auto")
+    platform = mesh.devices.flat[0].platform
+    if isinstance(enabled, str):
+        enabled = (platform != "cpu") if enabled.lower() == "auto" else enabled.lower() == "true"
+    return bool(enabled)

@@ -373,3 +373,24 @@ def test_dreamer_v3_bf16_precision(tmp_path):
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
+
+
+def test_dreamer_v3_hybrid_burst(tmp_path):
+    """The TPU-native hybrid/burst path forced on over the CPU mesh: host
+    player + device sequence ring + trainer-thread bursts, multiple
+    iterations past learning_starts, then the greedy test rollout."""
+    args = _std_args(tmp_path, "dreamer_v3", extra=DREAMER_FAST)
+    args.remove("dry_run=True")
+    args.remove("algo.run_test=False")
+    args += [
+        "dry_run=False",
+        "algo.run_test=True",
+        "algo.hybrid_player.enabled=true",
+        "algo.hybrid_player.train_every=4",
+        "algo.hybrid_player.snapshot_every=2",
+        "algo.total_steps=96",
+        "algo.learning_starts=32",
+        "algo.per_rank_sequence_length=4",
+        "buffer.size=2000",
+    ]
+    run(args)
