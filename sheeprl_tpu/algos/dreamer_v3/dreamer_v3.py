@@ -393,20 +393,26 @@ def make_train_step(
 
         def sampled_step(carry, xs):
             k, valid_flag = xs
-            k_env, k_start, k_grad = jax.random.split(k, 3)
-            B = ring_batch // n_dev
-            env_idx = jax.random.randint(k_env, (B,), 0, ring_envs)
-            t_idx = ring_sample_windows(
-                k_start, env_idx, new_pos, new_valid, capacity, ring_seq
-            )  # (T, B)
-            batch = {k: rb[k][t_idx, env_idx[None, :]] for k in rb}
-            # Padding steps beyond the granted chunk skip the whole gradient
-            # computation (lax.cond executes one branch), not just its result.
+
+            # Padding steps beyond the granted chunk skip EVERYTHING — the
+            # window sampling and ring gather live inside the taken branch
+            # (lax.cond executes one branch; operands computed outside it
+            # would still run unconditionally).
             def _run(c):
+                k_env, k_start, k_grad = jax.random.split(k, 3)
+                B = ring_batch // n_dev
+                env_idx = jax.random.randint(k_env, (B,), 0, ring_envs)
+                t_idx = ring_sample_windows(
+                    k_start, env_idx, new_pos, new_valid, capacity, ring_seq
+                )  # (T, B)
+                batch = {kk: rb[kk][t_idx, env_idx[None, :]] for kk in rb}
                 nc, m = gradient_step(c, (batch, k_grad))
                 return nc, tuple(x.astype(jnp.float32) for x in m)
 
-            zeros = tuple(jnp.zeros((), jnp.float32) for _ in range(10))
+            # Zero metrics derived from the true branch's structure, so the
+            # two cond branches can never drift apart.
+            metrics_shape = jax.eval_shape(_run, carry)[1]
+            zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape)
             new_carry, metrics = jax.lax.cond(valid_flag > 0, _run, lambda c: (c, zeros), carry)
             return new_carry, metrics
 
@@ -736,7 +742,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
         def _flush_burst():
             nonlocal rng, grant_backlog, cumulative_per_rank_gradient_steps, train_step
-            count = len(staged)
             arrs = {}
             for k, (shape, dtype) in ring_keys.items():
                 arr = np.zeros((stage_max, int(cfg.env.num_envs)) + shape, dtype)
