@@ -21,7 +21,6 @@ import sys
 import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, _REPO_ROOT)
 
 KNOWN = ("ppo", "a2c", "sac", "dreamer_v1", "dreamer_v2", "dreamer_v3")
 

@@ -27,7 +27,6 @@ import sys
 import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, _REPO_ROOT)
 
 V100_FRAMES_PER_S = 1_000_000 / (27 * 3600)  # Crafter, README.md:37-44
 

@@ -69,9 +69,9 @@ class ReplayBuffer:
         **kwargs: Any,
     ):
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"buffer_size must be a positive integer (got {buffer_size})")
         if n_envs <= 0:
-            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+            raise ValueError(f"n_envs must be a positive integer (got {n_envs})")
         self._buffer_size = buffer_size
         self._n_envs = n_envs
         self._obs_keys = tuple(obs_keys)
@@ -178,23 +178,24 @@ class ReplayBuffer:
         excluding the write head when full and shifting indices for next-obs
         (reference: ``buffers.py:223-288``)."""
         if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+            raise ValueError(f"need positive batch_size and n_samples (got batch_size={batch_size}, n_samples={n_samples})")
         if not self._full and self._pos == 0:
-            raise ValueError("No sample has been added to the buffer. Please add at least one sample calling 'self.add()'")
+            raise ValueError("empty buffer: add() at least one transition before sampling")
         if self._full:
-            first_range_end = self._pos - 1 if sample_next_obs else self._pos
-            second_range_end = self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
-            valid_idxes = np.array(
-                list(range(0, first_range_end)) + list(range(self._pos, second_range_end)), dtype=np.intp
+            young_stop = self._pos - 1 if sample_next_obs else self._pos
+            old_stop = self._buffer_size if young_stop >= 0 else self._buffer_size + young_stop
+            eligible_rows = np.array(
+                list(range(0, young_stop)) + list(range(self._pos, old_stop)), dtype=np.intp
             )
-            batch_idxes = valid_idxes[self._rng.integers(0, len(valid_idxes), size=(batch_size * n_samples,), dtype=np.intp)]
+            batch_idxes = eligible_rows[self._rng.integers(0, len(eligible_rows), size=(batch_size * n_samples,), dtype=np.intp)]
         else:
-            max_pos_to_sample = self._pos - 1 if sample_next_obs else self._pos
-            if max_pos_to_sample == 0:
+            newest_allowed = self._pos - 1 if sample_next_obs else self._pos
+            if newest_allowed == 0:
                 raise RuntimeError(
-                    "You want to sample the next observations, but only one sample has been added to the buffer."
+                    "sample_next_obs needs at least two stored transitions (the shifted-index "
+                    "pairing has nothing to pair with yet)"
                 )
-            batch_idxes = self._rng.integers(0, max_pos_to_sample, size=(batch_size * n_samples,), dtype=np.intp)
+            batch_idxes = self._rng.integers(0, newest_allowed, size=(batch_size * n_samples,), dtype=np.intp)
         samples = self._get_samples(batch_idxes, sample_next_obs=sample_next_obs, clone=clone)
         return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in samples.items()}
 
@@ -202,7 +203,7 @@ class ReplayBuffer:
         self, batch_idxes: np.ndarray, sample_next_obs: bool = False, clone: bool = False
     ) -> Dict[str, np.ndarray]:
         if self.empty:
-            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+            raise RuntimeError("uninitialized buffer: the storage is allocated lazily by the first add()")
         env_idxes = self._rng.integers(0, self._n_envs, size=(len(batch_idxes),), dtype=np.intp)
         flat_idxes = (batch_idxes * self._n_envs + env_idxes).flat
         if sample_next_obs:
@@ -241,16 +242,16 @@ class ReplayBuffer:
 
     def __getitem__(self, key: str) -> np.ndarray | MemmapArray:
         if not isinstance(key, str):
-            raise TypeError("'key' must be a string")
+            raise TypeError(f"buffer keys are strings (got {type(key)})")
         if self.empty:
-            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+            raise RuntimeError("uninitialized buffer: the storage is allocated lazily by the first add()")
         return self._buf.get(key)
 
     def __setitem__(self, key: str, value: np.ndarray | MemmapArray) -> None:
         if not isinstance(value, (np.ndarray, MemmapArray)):
             raise ValueError(f"The value must be an np.ndarray or MemmapArray, got {type(value)}")
         if self.empty:
-            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+            raise RuntimeError("uninitialized buffer: the storage is allocated lazily by the first add()")
         if tuple(value.shape[:2]) != (self._buffer_size, self._n_envs):
             raise RuntimeError(
                 f"'value' must have leading dims [buffer_size, n_envs, ...]; got shape {value.shape}"
@@ -280,21 +281,21 @@ class SequentialReplayBuffer(ReplayBuffer):
     ) -> Dict[str, np.ndarray]:
         batch_dim = batch_size * n_samples
         if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+            raise ValueError(f"need positive batch_size and n_samples (got batch_size={batch_size}, n_samples={n_samples})")
         if not self._full and self._pos == 0:
-            raise ValueError("No sample has been added to the buffer. Please add at least one sample calling 'self.add()'")
+            raise ValueError("empty buffer: add() at least one transition before sampling")
         if not self._full and self._pos - sequence_length + 1 < 1:
-            raise ValueError(f"Cannot sample a sequence of length {sequence_length}. Data added so far: {self._pos}")
+            raise ValueError(f"a {sequence_length}-step window needs at least that many stored rows (have {self._pos})")
         if self._full and sequence_length > len(self):
             raise ValueError(f"The sequence length ({sequence_length}) is greater than the buffer size ({len(self)})")
 
         if self._full:
-            first_range_end = self._pos - sequence_length + 1
-            second_range_end = self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
-            valid_idxes = np.array(
-                list(range(0, first_range_end)) + list(range(self._pos, second_range_end)), dtype=np.intp
+            young_stop = self._pos - sequence_length + 1
+            old_stop = self._buffer_size if young_stop >= 0 else self._buffer_size + young_stop
+            eligible_rows = np.array(
+                list(range(0, young_stop)) + list(range(self._pos, old_stop)), dtype=np.intp
             )
-            start_idxes = valid_idxes[self._rng.integers(0, len(valid_idxes), size=(batch_dim,), dtype=np.intp)]
+            start_idxes = eligible_rows[self._rng.integers(0, len(eligible_rows), size=(batch_dim,), dtype=np.intp)]
         else:
             start_idxes = self._rng.integers(0, self._pos - sequence_length + 1, size=(batch_dim,), dtype=np.intp)
         chunk = np.arange(sequence_length, dtype=np.intp).reshape(1, -1)
@@ -352,9 +353,9 @@ class EnvIndependentReplayBuffer:
         **kwargs: Any,
     ):
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"buffer_size must be a positive integer (got {buffer_size})")
         if n_envs <= 0:
-            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+            raise ValueError(f"n_envs must be a positive integer (got {n_envs})")
         if memmap:
             if memmap_mode not in _MEMMAP_MODES:
                 raise ValueError(f"Accepted values for memmap_mode are {_MEMMAP_MODES}")
@@ -438,7 +439,7 @@ class EnvIndependentReplayBuffer:
         **kwargs: Any,
     ) -> Dict[str, np.ndarray]:
         if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+            raise ValueError(f"need positive batch_size and n_samples (got batch_size={batch_size}, n_samples={n_samples})")
         bs_per_buf = np.bincount(self._rng.integers(0, self._n_envs, (batch_size,)))
         per_buf = [
             b.sample(batch_size=bs, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs)
@@ -484,9 +485,9 @@ class EpisodeBuffer:
         memmap_mode: str = "r+",
     ) -> None:
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"buffer_size must be a positive integer (got {buffer_size})")
         if minimum_episode_length <= 0:
-            raise ValueError(f"The sequence length must be greater than zero, got: {minimum_episode_length}")
+            raise ValueError(f"minimum_episode_length must be positive (got {minimum_episode_length})")
         if buffer_size < minimum_episode_length:
             raise ValueError(
                 f"The sequence length must be lower than the buffer size, got: bs = {buffer_size} and "
@@ -615,9 +616,9 @@ class EpisodeBuffer:
         if len(ends.nonzero()[0]) != 1 or not ends[-1]:
             raise RuntimeError(f"The episode must contain exactly one done at the end")
         if ep_len < self._minimum_episode_length:
-            raise RuntimeError(f"Episode too short (at least {self._minimum_episode_length} steps), got: {ep_len} steps")
+            raise RuntimeError(f"episode of {ep_len} steps is shorter than the minimum episode length {self._minimum_episode_length}")
         if ep_len > self._buffer_size:
-            raise RuntimeError(f"Episode too long (at most {self._buffer_size} steps), got: {ep_len} steps")
+            raise RuntimeError(f"episode of {ep_len} steps exceeds the buffer capacity of {self._buffer_size}")
 
         if self.full or len(self) + ep_len > self._buffer_size:
             cum_lengths = np.array(self._cum_lengths)
@@ -661,9 +662,9 @@ class EpisodeBuffer:
         **kwargs: Any,
     ) -> Dict[str, np.ndarray]:
         if batch_size <= 0:
-            raise ValueError(f"Batch size must be greater than 0, got: {batch_size}")
+            raise ValueError(f"batch_size must be positive (got {batch_size})")
         if n_samples <= 0:
-            raise ValueError(f"The number of samples must be greater than 0, got: {n_samples}")
+            raise ValueError(f"n_samples must be positive (got {n_samples})")
         ep_lens = np.array(self._cum_lengths) - np.array([0] + self._cum_lengths[:-1])
         if sample_next_obs:
             valid_mask = ep_lens > sequence_length
@@ -672,8 +673,7 @@ class EpisodeBuffer:
         valid_episodes = list(compress(self._buf, valid_mask))
         if len(valid_episodes) == 0:
             raise RuntimeError(
-                "No valid episodes has been added to the buffer. Please add at least one episode of length greater "
-                f"than or equal to {sequence_length} calling 'self.add()'"
+                f"no stored episode is at least {sequence_length} steps long — nothing to sample"
             )
 
         chunk = np.arange(sequence_length, dtype=np.intp).reshape(1, -1)

@@ -166,3 +166,50 @@ class MlflowModelManager:
         return mlflow.artifacts.download_artifacts(
             artifact_uri=f"models:/{model_name}/{version}", dst_path=output_path
         )
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: str = "max",
+    ):
+        """Register the models of the run that scored best on ``metric``
+        across an experiment (reference: ``mlflow.py:214-280``).
+
+        ``models_info`` maps registry keys to ``{"path", "name",
+        "description", "tags"}``; only artifacts actually present on the
+        winning run are registered. Returns ``{key: ModelVersion}`` or
+        ``None`` when no run carries both the metric and a listed artifact.
+        """
+        if mode not in ("max", "min"):
+            raise ValueError(f"mode must be 'max' or 'min' (got {mode!r})")
+        experiment = self.client.get_experiment_by_name(experiment_name)
+        if experiment is None:
+            return None
+        runs = self.client.search_runs(experiment_ids=[experiment.experiment_id])
+        wanted_paths = {v["path"] for v in models_info.values()}
+
+        best = None
+        best_artifacts: set = set()
+        sign = 1.0 if mode == "max" else -1.0
+        for run in runs:
+            score = run.data.metrics.get(metric)
+            present = {a.path for a in self.client.list_artifacts(run.info.run_id)} & wanted_paths
+            if score is None or not present:
+                continue
+            if best is None or sign * score > sign * best.data.metrics[metric]:
+                best, best_artifacts = run, present
+        if best is None:
+            return None
+
+        registered = {}
+        for key, info in models_info.items():
+            if info["path"] in best_artifacts:
+                registered[key] = self.register_model(
+                    f"runs:/{best.info.run_id}/{info['path']}",
+                    info["name"],
+                    info.get("description"),
+                    info.get("tags"),
+                )
+        return registered

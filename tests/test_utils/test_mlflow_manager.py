@@ -1,0 +1,85 @@
+"""Model-manager logic against a faked ``mlflow`` module (the real package is
+an optional extra; the reference runs its suite against a live `mlflow ui` —
+here the registry/selection logic is what needs coverage, not the server).
+"""
+
+import sys
+import types
+from types import SimpleNamespace
+
+import pytest
+
+
+class _FakeClient:
+    def __init__(self, runs, artifacts):
+        self._runs = runs
+        self._artifacts = artifacts
+        self.registered = []
+
+    def get_experiment_by_name(self, name):
+        return SimpleNamespace(experiment_id="exp0") if name == "exp" else None
+
+    def search_runs(self, experiment_ids):
+        return self._runs
+
+    def list_artifacts(self, run_id):
+        return [SimpleNamespace(path=p) for p in self._artifacts.get(run_id, [])]
+
+    def update_model_version(self, name, version, description):
+        pass
+
+
+def _run(run_id, metrics):
+    return SimpleNamespace(info=SimpleNamespace(run_id=run_id), data=SimpleNamespace(metrics=metrics))
+
+
+@pytest.fixture()
+def manager(monkeypatch):
+    fake = types.ModuleType("mlflow")
+    fake.set_tracking_uri = lambda uri: None
+    fake.register_model = lambda uri, name, tags=None: SimpleNamespace(version=1, source=uri, name=name)
+    fake.MlflowClient = lambda: None
+    monkeypatch.setitem(sys.modules, "mlflow", fake)
+    import sheeprl_tpu.utils.mlflow as m
+
+    monkeypatch.setattr(m, "_IS_MLFLOW_AVAILABLE", True)
+
+    runs = [
+        _run("r1", {"Test/cumulative_reward": 10.0}),
+        _run("r2", {"Test/cumulative_reward": 99.0}),  # best, has artifact
+        _run("r3", {"Test/cumulative_reward": 500.0}),  # best metric, NO artifact
+        _run("r4", {}),  # no metric
+    ]
+    artifacts = {"r1": ["agent"], "r2": ["agent"], "r4": ["agent"]}
+    mgr = m.MlflowModelManager.__new__(m.MlflowModelManager)
+    mgr.fabric = None
+    mgr.client = _FakeClient(runs, artifacts)
+    return mgr
+
+
+MODELS_INFO = {"agent": {"path": "agent", "name": "best_agent", "description": "d", "tags": {}}}
+
+
+def test_register_best_models_picks_best_scored_run_with_artifact(manager):
+    out = manager.register_best_models("exp", MODELS_INFO)
+    # r3 has the best metric but no artifact; r2 wins among eligible runs.
+    assert out["agent"].source == "runs:/r2/agent"
+
+
+def test_register_best_models_min_mode(manager):
+    out = manager.register_best_models("exp", MODELS_INFO, mode="min")
+    assert out["agent"].source == "runs:/r1/agent"
+
+
+def test_register_best_models_no_experiment(manager):
+    assert manager.register_best_models("nope", MODELS_INFO) is None
+
+
+def test_register_best_models_no_eligible_run(manager):
+    out = manager.register_best_models("exp", {"agent": {"path": "missing", "name": "x", "tags": {}}})
+    assert out is None
+
+
+def test_register_best_models_bad_mode(manager):
+    with pytest.raises(ValueError):
+        manager.register_best_models("exp", MODELS_INFO, mode="avg")
