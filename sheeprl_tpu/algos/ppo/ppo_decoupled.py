@@ -115,7 +115,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
     aggregator = None
     if not MetricAggregator.disabled:
-        aggregator = build_aggregator(cfg.metric.aggregator)
+        # sync-free variant: the player thread computes at its own cadence
+        aggregator = build_aggregator(cfg.metric.aggregator, rank_independent=True)
 
     if cfg.buffer.size < cfg.algo.rollout_steps:
         raise ValueError(

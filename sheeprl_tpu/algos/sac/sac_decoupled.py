@@ -94,7 +94,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
     aggregator = None
     if not MetricAggregator.disabled:
-        aggregator = build_aggregator(cfg.metric.aggregator)
+        # sync-free variant: the player thread computes at its own cadence
+        aggregator = build_aggregator(cfg.metric.aggregator, rank_independent=True)
 
     buffer_size = cfg.buffer.size // int(cfg.env.num_envs) if not cfg.dry_run else 1
     rb = ReplayBuffer(

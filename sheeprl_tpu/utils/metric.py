@@ -5,10 +5,13 @@ numpy-based running-statistic library. Metrics accumulate python/numpy scalars
 on the host (values coming off-device are tiny), and `MetricAggregator`
 exposes the same ``update/compute/reset/to`` surface the algorithm loops use.
 
-Cross-process reduction (torchmetrics' ``sync_on_compute``) is replaced by
-``sync_fn`` hooks: under multi-host JAX the aggregator can be given a callable
-performing ``multihost_utils`` reductions. Single-host (the common TPU-VM
-case) needs none.
+Cross-process reduction (torchmetrics' ``sync_on_compute``) is intentionally
+absent: metrics that need a cross-device view are reduced IN-GRAPH by the
+train steps (``pmean`` over the mesh) before they ever reach the aggregator,
+and rank-0 is the only logger. ``sync_on_compute`` is accepted on the metric
+constructors purely for config compatibility, and
+``RankIndependentMetricAggregator`` keeps the reference's decoupled-main API
+(per-thread aggregation that must never block on a collective).
 """
 
 from __future__ import annotations
@@ -226,12 +229,20 @@ class MetricAggregator:
 
 class RankIndependentMetricAggregator:
     """Per-rank aggregator without cross-rank sync
-    (reference: ``sheeprl/utils/metric.py:146-195``)."""
+    (reference: ``sheeprl/utils/metric.py:146-195``).
+
+    Used by the decoupled mains: the player/trainer threads log at their own
+    cadence, so metrics must never block on a cross-rank reduction at
+    ``compute`` time."""
 
     def __init__(self, metrics: Dict[str, Metric]) -> None:
         self._aggregator = MetricAggregator(metrics)
         for m in self._aggregator.metrics.values():
             m.sync_on_compute = False
+
+    @property
+    def disabled(self) -> bool:
+        return self._aggregator.disabled
 
     def update(self, name: str, value: Any) -> None:
         self._aggregator.update(name, value)
@@ -245,6 +256,12 @@ class RankIndependentMetricAggregator:
     def to(self, device: str = "cpu") -> "RankIndependentMetricAggregator":
         return self
 
+    def keys(self):
+        return self._aggregator.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._aggregator
+
 
 _METRIC_CLASSES = {
     "MeanMetric": MeanMetric,
@@ -256,12 +273,15 @@ _METRIC_CLASSES = {
 }
 
 
-def build_aggregator(metric_cfg: Dict[str, Any], keys_filter: Optional[set] = None) -> MetricAggregator:
+def build_aggregator(
+    metric_cfg: Dict[str, Any], keys_filter: Optional[set] = None, rank_independent: bool = False
+) -> MetricAggregator | RankIndependentMetricAggregator:
     """Build a MetricAggregator from the ``metric.aggregator`` config node.
 
     The config format mirrors the reference (``configs/metric/default.yaml``):
     each entry has a ``_target_`` naming the metric class; torchmetrics paths
-    are mapped onto the local classes by their leaf name.
+    are mapped onto the local classes by their leaf name. ``rank_independent``
+    selects the sync-free variant the decoupled mains log through.
     """
     metrics: Dict[str, Metric] = {}
     for name, spec in (metric_cfg.get("metrics") or {}).items():
@@ -273,4 +293,6 @@ def build_aggregator(metric_cfg: Dict[str, Any], keys_filter: Optional[set] = No
         kwargs = {k: v for k, v in spec.items() if k != "_target_"} if isinstance(spec, dict) else {}
         kwargs.pop("sync_on_compute", None)
         metrics[name] = cls(**kwargs)
+    if rank_independent:
+        return RankIndependentMetricAggregator(metrics)
     return MetricAggregator(metrics)

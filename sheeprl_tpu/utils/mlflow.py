@@ -187,19 +187,25 @@ class MlflowModelManager:
         experiment = self.client.get_experiment_by_name(experiment_name)
         if experiment is None:
             return None
-        runs = self.client.search_runs(experiment_ids=[experiment.experiment_id])
         wanted_paths = {v["path"] for v in models_info.values()}
 
         best = None
         best_artifacts: set = set()
         sign = 1.0 if mode == "max" else -1.0
-        for run in runs:
-            score = run.data.metrics.get(metric)
-            present = {a.path for a in self.client.list_artifacts(run.info.run_id)} & wanted_paths
-            if score is None or not present:
-                continue
-            if best is None or sign * score > sign * best.data.metrics[metric]:
+        page_token = None
+        while True:
+            runs = self.client.search_runs(experiment_ids=[experiment.experiment_id], page_token=page_token)
+            for run in runs:
+                score = run.data.metrics.get(metric)
+                if score is None or (best is not None and sign * score <= sign * best.data.metrics[metric]):
+                    continue
+                present = {a.path for a in self.client.list_artifacts(run.info.run_id)} & wanted_paths
+                if not present:
+                    continue
                 best, best_artifacts = run, present
+            page_token = getattr(runs, "token", None)
+            if not page_token:
+                break
         if best is None:
             return None
 

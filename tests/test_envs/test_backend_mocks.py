@@ -1,0 +1,673 @@
+"""Smoke tests for the dep-gated env backends against faked SDK modules.
+
+MineDojo/MineRL/DIAMBRA/Super-Mario wheels can't be installed in CI, so these
+tests inject minimal fake module trees into ``sys.modules`` and drive the real
+wrapper code through reset/step/conversion paths: action catalogue assembly,
+sticky attack/jump, pitch limiting, observation flattening, and the custom
+MineRL spec tables. The fakes implement only the SDK surface the wrappers
+touch (reference behavior: ``sheeprl/envs/{minedojo,minerl,diambra,
+super_mario_bros}.py``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+import types
+from types import SimpleNamespace
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+
+def _load_backend(monkeypatch, fakes, flags, target):
+    """Install fake SDK modules, force the availability flags, and (re)import
+    the backend module. The caller's monkeypatch undoes the sys.modules and
+    flag edits; the reimported backend is evicted so later tests never see a
+    module bound to the fakes."""
+    for name, mod in fakes.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    imports = importlib.import_module("sheeprl_tpu.utils.imports")
+    for flag in flags:
+        monkeypatch.setattr(imports, flag, True)
+    evicted = [target] + [m for m in list(sys.modules) if m.startswith(target + ".")]
+    for name in evicted:
+        sys.modules.pop(name, None)
+    module = importlib.import_module(target)
+    return module
+
+
+@pytest.fixture
+def evict_backend_modules():
+    """Drop reimported backend modules after the test so the fakes don't leak."""
+    yield
+    for name in list(sys.modules):
+        if name.startswith("sheeprl_tpu.envs.minedojo") or name.startswith("sheeprl_tpu.envs.minerl"):
+            sys.modules.pop(name, None)
+        if name.startswith("sheeprl_tpu.envs.diambra") or name.startswith("sheeprl_tpu.envs.super_mario_bros"):
+            sys.modules.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# MineDojo
+# ---------------------------------------------------------------------------
+
+_MD_ITEMS = ["air", "stone", "wooden_pickaxe", "crafting_table", "dirt"]
+_MD_CRAFT = ["stick", "planks", "torch"]
+
+
+class _FakeMineDojoSim:
+    """Raw MineDojo sim: 8-slot ARNN action vector in, nested obs dict out."""
+
+    def __init__(self, height, width, pitch=0.0):
+        self.observation_space = {"rgb": gym.spaces.Box(0, 255, (height, width, 3), np.uint8)}
+        self._shape = (height, width, 3)
+        self._pitch = pitch
+        self.received = []
+        self.unwrapped = SimpleNamespace(_prev_obs=None)
+
+    def _obs(self):
+        slots = ["air", "stone", "crafting table"]
+        return {
+            "rgb": np.zeros(self._shape, np.uint8),
+            "inventory": {"name": np.array(slots), "quantity": np.array([1.0, 3.0, 1.0])},
+            "delta_inv": {
+                k: []
+                for k in (
+                    "inc_name_by_craft", "inc_quantity_by_craft", "dec_name_by_craft", "dec_quantity_by_craft",
+                    "inc_name_by_other", "inc_quantity_by_other", "dec_name_by_other", "dec_quantity_by_other",
+                )
+            },
+            "equipment": {"name": ["air"]},
+            "life_stats": {
+                "life": np.array([20.0]),
+                "food": np.array([20.0]),
+                "oxygen": np.array([300.0]),
+            },
+            "location_stats": {
+                "pos": np.array([0.0, 64.0, 0.0]),
+                "pitch": np.array([self._pitch]),
+                "yaw": np.array([0.0]),
+                "biome_id": np.array([1]),
+            },
+            "masks": {
+                "action_type": np.ones(8, bool),
+                "equip": np.array([False, True, False]),
+                "destroy": np.array([False, True, True]),
+                "craft_smelt": np.ones(len(_MD_CRAFT), bool),
+            },
+        }
+
+    def reset(self):
+        return self._obs()
+
+    def step(self, action):
+        self.received.append(np.asarray(action).copy())
+        return self._obs(), 1.0, False, {}
+
+    def close(self):
+        pass
+
+
+def _fake_minedojo_tree(sim_holder, pitch=0.0):
+    minedojo = types.ModuleType("minedojo")
+    tasks = types.ModuleType("minedojo.tasks")
+    sim = types.ModuleType("minedojo.sim")
+    tasks.ALL_TASKS_SPECS = {"harvest": object()}
+    sim.ALL_ITEMS = list(_MD_ITEMS)
+    sim.ALL_CRAFT_SMELT_ITEMS = list(_MD_CRAFT)
+
+    def make(task_id, image_size, **kwargs):
+        env = _FakeMineDojoSim(*image_size, pitch=pitch)
+        sim_holder.append(env)
+        return env
+
+    minedojo.make = make
+    minedojo.tasks = tasks
+    minedojo.sim = sim
+    return {"minedojo": minedojo, "minedojo.tasks": tasks, "minedojo.sim": sim}
+
+
+def _make_minedojo(monkeypatch, pitch=0.0, **kwargs):
+    sims = []
+    module = _load_backend(
+        monkeypatch, _fake_minedojo_tree(sims, pitch), ["_IS_MINEDOJO_AVAILABLE"], "sheeprl_tpu.envs.minedojo"
+    )
+    env = module.MineDojoWrapper(id="harvest_milk", **kwargs)
+    return env, sims[0]
+
+
+@pytest.mark.usefixtures("evict_backend_modules")
+class TestMineDojoMocked:
+    def test_spaces_and_obs_conversion(self, monkeypatch):
+        env, _ = _make_minedojo(monkeypatch)
+        assert env.action_space.nvec.tolist() == [19, len(_MD_CRAFT), len(_MD_ITEMS)]
+        obs, info = env.reset()
+        assert set(obs) == set(env.observation_space.spaces)
+        n = len(_MD_ITEMS)
+        assert obs["inventory"].shape == (n,)
+        # slot quantities land on the normalized item ids ("crafting table" -> crafting_table)
+        assert obs["inventory"][_MD_ITEMS.index("stone")] == 3.0
+        assert obs["inventory"][_MD_ITEMS.index("crafting_table")] == 1.0
+        assert obs["inventory"][_MD_ITEMS.index("air")] == 1.0  # air counts as 1, not quantity
+        assert obs["equipment"][_MD_ITEMS.index("air")] == 1
+        assert obs["life_stats"].tolist() == [20.0, 20.0, 300.0]
+        # equip/destroy slot masks are scattered to item ids
+        assert obs["mask_equip_place"][_MD_ITEMS.index("stone")]
+        assert obs["mask_destroy"][_MD_ITEMS.index("crafting_table")]
+        assert obs["mask_action_type"].shape == (19,)
+        assert info["location_stats"]["y"] == 64.0
+
+    def test_action_conversion_attack_and_craft(self, monkeypatch):
+        env, sim = _make_minedojo(monkeypatch)
+        env.reset()
+        env.step(np.array([14, 0, 0]))  # attack
+        assert sim.received[-1][5] == 3
+        env.step(np.array([15, 2, 0]))  # craft, arg=2
+        assert sim.received[-1][5] == 4 and sim.received[-1][6] == 2
+        env.step(np.array([1, 2, 0]))  # forward: craft arg must be zeroed
+        assert sim.received[-1][6] == 0 and sim.received[-1][0] == 1
+
+    def test_sticky_attack(self, monkeypatch):
+        env, sim = _make_minedojo(monkeypatch, break_speed_multiplier=1, sticky_attack=3)
+        env.reset()
+        env.step(np.array([14, 0, 0]))
+        env.step(np.array([0, 0, 0]))  # no-op keeps attacking while sticky
+        assert sim.received[-1][5] == 3
+        env.step(np.array([12, 0, 0]))  # another functional action clears the counter
+        assert sim.received[-1][5] == 1
+        env.step(np.array([0, 0, 0]))
+        assert sim.received[-1][5] == 0
+
+    def test_sticky_jump_keeps_moving_forward(self, monkeypatch):
+        env, sim = _make_minedojo(monkeypatch, break_speed_multiplier=1, sticky_jump=2)
+        env.reset()
+        env.step(np.array([5, 0, 0]))  # jump+forward
+        env.step(np.array([0, 0, 0]))
+        assert sim.received[-1][2] == 1 and sim.received[-1][0] == 1
+        env.step(np.array([0, 0, 0]))
+        assert sim.received[-1][2] == 0
+
+    def test_equip_uses_inventory_slot(self, monkeypatch):
+        env, sim = _make_minedojo(monkeypatch)
+        env.reset()
+        env.step(np.array([16, 0, _MD_ITEMS.index("stone")]))  # equip stone
+        assert sim.received[-1][5] == 5
+        assert sim.received[-1][7] == 1  # stone sits in raw slot 1
+
+    def test_pitch_limit_clamps_camera(self, monkeypatch):
+        env, sim = _make_minedojo(monkeypatch, pitch=60.0, pitch_limits=(-60, 60))
+        env.reset()
+        env.step(np.array([9, 0, 0]))  # pitch up would exceed +60
+        assert sim.received[-1][3] == 12
+        env.step(np.array([8, 0, 0]))  # pitch down is allowed
+        assert sim.received[-1][3] == 11
+
+    def test_task_table_restored_after_make(self, monkeypatch):
+        sims = []
+        fakes = _fake_minedojo_tree(sims)
+        module = _load_backend(monkeypatch, fakes, ["_IS_MINEDOJO_AVAILABLE"], "sheeprl_tpu.envs.minedojo")
+        module.MineDojoWrapper(id="harvest_milk")
+        # the wrapper restores a (deep)copy so repeated construction still works
+        assert set(fakes["minedojo.tasks"].ALL_TASKS_SPECS) == {"harvest"}
+
+
+# ---------------------------------------------------------------------------
+# MineRL (wrapper + custom spec tables)
+# ---------------------------------------------------------------------------
+
+
+class _HeroEnum:
+    def __init__(self, values):
+        self.values = np.array(values)
+
+
+class _Handler:
+    def __init__(self, kind, *args, **kwargs):
+        self.kind, self.args, self.kwargs = kind, args, kwargs
+
+
+class _FakeDictSpace:
+    def __init__(self, entries):
+        self._entries = dict(entries)
+
+    def __iter__(self):
+        return iter(self._entries)
+
+    def __getitem__(self, key):
+        return self._entries[key]
+
+    @property
+    def spaces(self):
+        return self._entries
+
+
+class _FakeInventorySpace:
+    def __init__(self, items):
+        self._items = list(items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+
+_ACTION_HANDLER_NAMES = {
+    "PlaceBlock": "place",
+    "EquipAction": "equip",
+    "CraftAction": "craft",
+    "CraftNearbyAction": "nearbyCraft",
+    "SmeltItemNearby": "nearbySmelt",
+}
+
+
+class _FakeMineRLEnv:
+    """Assembles spaces from the spec's handler tables, like the real backend."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.received = []
+        obs_handlers = spec.create_observables()
+        act_handlers = spec.create_actionables()
+        self.rewards = spec.create_rewardables()
+        self.agent_start = spec.create_agent_start()
+        self.quit_handlers = spec.create_agent_handlers()
+
+        obs_entries = {"pov": object()}
+        self._inventory_items = []
+        self._equip_items = []
+        for h in obs_handlers:
+            if h.kind == "CompassObservation":
+                obs_entries["compass"] = object()
+            elif h.kind == "FlatInventoryObservation":
+                self._inventory_items = list(h.args[0])
+                obs_entries["inventory"] = _FakeInventorySpace(self._inventory_items)
+            elif h.kind == "EquippedItemObservation":
+                self._equip_items = list(h.kwargs["items"])
+                obs_entries["equipped_items"] = {"mainhand": {"type": _HeroEnum(self._equip_items)}}
+        self.observation_space = _FakeDictSpace(obs_entries)
+
+        act_entries = {}
+        for h in act_handlers:
+            if h.kind == "KeybasedCommandAction":
+                act_entries[h.args[0]] = object()
+            elif h.kind == "CameraAction":
+                act_entries["camera"] = object()
+            elif h.kind in _ACTION_HANDLER_NAMES:
+                act_entries[_ACTION_HANDLER_NAMES[h.kind]] = _HeroEnum(h.args[0])
+        self.action_space = _FakeDictSpace(act_entries)
+
+    def _obs(self):
+        obs = {
+            "pov": np.zeros((64, 64, 3), np.uint8),
+            "life_stats": {"life": 20.0, "food": 20.0, "air": 300.0},
+            "inventory": {item: (2.0 if item == "dirt" else 0.0) for item in self._inventory_items},
+        }
+        if "equipped_items" in self.observation_space.spaces:
+            obs["equipped_items"] = {"mainhand": {"type": "air"}}
+        if "compass" in self.observation_space.spaces:
+            obs["compass"] = {"angle": np.array([12.0])}
+        return obs
+
+    def reset(self):
+        return self._obs()
+
+    def step(self, action):
+        self.received.append(action)
+        return self._obs(), 0.0, False, {}
+
+    def render(self, mode="rgb_array"):
+        return np.zeros((64, 64, 3), np.uint8)
+
+    def close(self):
+        pass
+
+
+def _fake_minerl_tree():
+    minerl = types.ModuleType("minerl")
+    herobraine = types.ModuleType("minerl.herobraine")
+    env_spec = types.ModuleType("minerl.herobraine.env_spec")
+    hero = types.ModuleType("minerl.herobraine.hero")
+    hero_spaces = types.ModuleType("minerl.herobraine.hero.spaces")
+    handler_mod = types.ModuleType("minerl.herobraine.hero.handler")
+    handlers_mod = types.ModuleType("minerl.herobraine.hero.handlers")
+    mc = types.ModuleType("minerl.herobraine.hero.mc")
+
+    class FakeEnvSpec:
+        def __init__(self, name, max_episode_steps=None, **kwargs):
+            self.name = name
+            self.max_episode_steps = max_episode_steps
+
+        def make(self):
+            return _FakeMineRLEnv(self)
+
+    env_spec.EnvSpec = FakeEnvSpec
+    hero_spaces.Enum = _HeroEnum
+    handler_mod.Handler = object
+
+    def _handler_getattr(kind):
+        def factory(*args, **kwargs):
+            return _Handler(kind, *args, **kwargs)
+
+        return factory
+
+    handlers_mod.__getattr__ = lambda kind: _handler_getattr(kind)
+    keyboard = ["forward", "back", "left", "right", "jump", "sneak", "sprint", "attack"]
+    mc.INVERSE_KEYMAP = {k: str(i) for i, k in enumerate(keyboard + ["use", "drop"])}
+    mc.ALL_ITEMS = ["air", "compass", "dirt", "coal", "torch", "log", "planks", "stick", "crafting_table",
+                    "wooden_axe", "wooden_pickaxe", "stone", "cobblestone", "furnace", "stone_axe",
+                    "stone_pickaxe", "iron_ore", "iron_ingot", "iron_axe", "iron_pickaxe", "diamond"]
+
+    minerl.herobraine = herobraine
+    herobraine.env_spec = env_spec
+    herobraine.hero = hero
+    hero.spaces = hero_spaces
+    hero.handler = handler_mod
+    hero.handlers = handlers_mod
+    hero.mc = mc
+    return {
+        "minerl": minerl,
+        "minerl.herobraine": herobraine,
+        "minerl.herobraine.env_spec": env_spec,
+        "minerl.herobraine.hero": hero,
+        "minerl.herobraine.hero.spaces": hero_spaces,
+        "minerl.herobraine.hero.handler": handler_mod,
+        "minerl.herobraine.hero.handlers": handlers_mod,
+        "minerl.herobraine.hero.mc": mc,
+    }
+
+
+def _make_minerl(monkeypatch, id="custom_obtain_diamond", **kwargs):
+    module = _load_backend(monkeypatch, _fake_minerl_tree(), ["_IS_MINERL_AVAILABLE"], "sheeprl_tpu.envs.minerl")
+    return module.MineRLWrapper(id=id, **kwargs)
+
+
+@pytest.mark.usefixtures("evict_backend_modules")
+class TestMineRLMocked:
+    def test_obtain_diamond_action_catalogue(self, monkeypatch):
+        env = _make_minerl(monkeypatch)
+        # no-op + 8 keyboard + 4 camera + 6 place + 7 equip + 4 craft
+        # + 7 nearbyCraft + 2 smelt, from the spec tables
+        assert env.action_space.n == 39
+        assert env.actions_map[0] == {}
+        jump = [a for a in env.actions_map.values() if "jump" in a]
+        assert jump and all(a.get("forward") == 1 for a in jump)
+        cameras = [a for a in env.actions_map.values() if "camera" in a]
+        assert len(cameras) == 4
+        crafts = sorted(a["craft"] for a in env.actions_map.values() if "craft" in a)
+        assert crafts == ["crafting_table", "planks", "stick", "torch"]
+
+    def test_obs_conversion_multihot(self, monkeypatch):
+        env = _make_minerl(monkeypatch)
+        obs, _ = env.reset()
+        assert set(obs) == {"rgb", "life_stats", "inventory", "max_inventory", "equipment"}
+        assert obs["inventory"].shape == (env.inventory_size,)
+        assert obs["inventory"][env.inventory_item_to_id["dirt"]] == 2.0
+        assert obs["equipment"][env.equip_item_to_id["air"]] == 1
+        assert obs["life_stats"].tolist() == [20.0, 20.0, 300.0]
+
+    def test_obs_conversion_compact_inventory(self, monkeypatch):
+        env = _make_minerl(monkeypatch, multihot_inventory=False)
+        assert env.inventory_size == 18  # the obtain spec's inventory table
+        obs, _ = env.reset()
+        assert obs["inventory"].shape == (18,)
+
+    def test_navigate_has_compass_and_no_equipment(self, monkeypatch):
+        env = _make_minerl(monkeypatch, id="custom_navigate", extreme=False)
+        obs, _ = env.reset()
+        assert "compass" in obs and obs["compass"].shape == (1,)
+        assert "equipment" not in obs
+        # navigate's catalogue: no-op + 8 keyboard + 4 camera + 1 place(dirt)
+        assert env.action_space.n == 14
+
+    def test_sticky_attack_and_jump(self, monkeypatch):
+        env = _make_minerl(monkeypatch, break_speed_multiplier=1, sticky_attack=2, sticky_jump=2)
+        env.reset()
+        attack_idx = next(i for i, a in env.actions_map.items() if a == {"attack": 1})
+        env.step(attack_idx)
+        env.step(0)
+        assert env._env.received[-1]["attack"] == 1  # sticky keeps attacking
+        env.step(0)
+        env.step(0)
+        assert env._env.received[-1]["attack"] == 0  # counter expired
+
+    def test_pitch_limit_zeroes_camera(self, monkeypatch):
+        env = _make_minerl(monkeypatch, pitch_limits=(-30, 30))
+        env.reset()
+        pitch_down = next(
+            i for i, a in env.actions_map.items() if "camera" in a and np.array_equal(a["camera"], [-15, 0])
+        )
+        env.step(pitch_down)
+        env.step(pitch_down)
+        assert np.array_equal(env._env.received[-1]["camera"], [-15, 0])
+        env.step(pitch_down)  # would cross -30
+        assert np.array_equal(env._env.received[-1]["camera"], [0, 0])
+
+    def test_navigate_success_thresholds(self, monkeypatch):
+        _load_backend(monkeypatch, _fake_minerl_tree(), ["_IS_MINERL_AVAILABLE"], "sheeprl_tpu.envs.minerl")
+        specs = importlib.import_module("sheeprl_tpu.envs.minerl_envs.specs")
+        nav = specs.CustomNavigate(dense=False)
+        assert nav.determine_success_from_rewards([100.0])
+        assert not nav.determine_success_from_rewards([50.0])
+        dense = specs.CustomNavigate(dense=True)
+        assert not dense.determine_success_from_rewards([100.0])  # dense bar is 160
+        sys.modules.pop("sheeprl_tpu.envs.minerl_envs.specs", None)
+
+
+# ---------------------------------------------------------------------------
+# DIAMBRA
+# ---------------------------------------------------------------------------
+
+
+class _ArenaSettings(dict):
+    def __init__(self, **kwargs):
+        super().__init__(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError:
+            raise AttributeError(key)
+
+
+class _FakeArenaEnv(gym.Env):
+    def __init__(self):
+        self.observation_space = gym.spaces.Dict(
+            {
+                "frame": gym.spaces.Box(0, 255, (64, 64, 1), np.uint8),
+                "stage": gym.spaces.Discrete(4),
+                "side": gym.spaces.MultiDiscrete([2, 2]),
+                "health": gym.spaces.Box(0.0, 1.0, (1,), np.float32),
+            }
+        )
+        self.action_space = gym.spaces.Discrete(8)
+        self.received = []
+
+    def _obs(self):
+        return {
+            "frame": np.zeros((64, 64, 1), np.uint8),
+            "stage": 2,
+            "side": np.array([0, 1]),
+            "health": np.array([0.5], np.float32),
+        }
+
+    def reset(self, *, seed=None, options=None):
+        return self._obs(), {}
+
+    def step(self, action):
+        self.received.append(action)
+        return self._obs(), 1.0, False, False, {"env_done": False}
+
+    def render(self):
+        return np.zeros((64, 64, 3), np.uint8)
+
+
+def _fake_diambra_tree(made):
+    diambra = types.ModuleType("diambra")
+    arena = types.ModuleType("diambra.arena")
+    arena.EnvironmentSettings = _ArenaSettings
+    arena.WrappersSettings = _ArenaSettings
+    arena.SpaceTypes = SimpleNamespace(DISCRETE="discrete", MULTI_DISCRETE="multi_discrete")
+    arena.Roles = SimpleNamespace(P1="P1", P2="P2")
+
+    def make(id, settings, wrappers, rank=0, render_mode="rgb_array", log_level=0):
+        env = _FakeArenaEnv()
+        made.append((env, settings, wrappers))
+        return env
+
+    arena.make = make
+    diambra.arena = arena
+    return {"diambra": diambra, "diambra.arena": arena}
+
+
+def _make_diambra(monkeypatch, **kwargs):
+    made = []
+    module = _load_backend(
+        monkeypatch,
+        _fake_diambra_tree(made),
+        ["_IS_DIAMBRA_AVAILABLE", "_IS_DIAMBRA_ARENA_AVAILABLE"],
+        "sheeprl_tpu.envs.diambra",
+    )
+    env = module.DiambraWrapper(id="doapp", **kwargs)
+    return env, made[0]
+
+
+@pytest.mark.usefixtures("evict_backend_modules")
+class TestDiambraMocked:
+    def test_scalar_keys_become_int32_boxes(self, monkeypatch):
+        env, _ = _make_diambra(monkeypatch)
+        assert isinstance(env.observation_space["stage"], gym.spaces.Box)
+        assert env.observation_space["stage"].shape == (1,)
+        assert env.observation_space["side"].shape == (2,)
+        obs, info = env.reset()
+        assert obs["stage"].shape == (1,) and obs["stage"][0] == 2
+        assert obs["side"].tolist() == [0, 1]
+        assert info["env_domain"] == "DIAMBRA"
+
+    def test_discrete_action_unboxed_for_the_sdk(self, monkeypatch):
+        env, (inner, _, _) = _make_diambra(monkeypatch)
+        env.reset()
+        obs, reward, done, truncated, info = env.step(np.array([3]))
+        assert inner.received[-1] == 3 and not isinstance(inner.received[-1], np.ndarray)
+        assert info["env_domain"] == "DIAMBRA"
+
+    def test_performance_mode_sets_settings_frame_shape(self, monkeypatch):
+        _, (_, settings, _) = _make_diambra(monkeypatch, grayscale=True, increase_performance=True)
+        assert settings["frame_shape"] == (64, 64, 1)
+        _, (_, _, wrappers) = _make_diambra(monkeypatch, grayscale=False, increase_performance=False)
+        assert wrappers["frame_shape"] == (64, 64, 0)
+
+    def test_repeat_action_forces_step_ratio(self, monkeypatch):
+        with pytest.warns(UserWarning, match="step_ratio"):
+            _, (_, settings, wrappers) = _make_diambra(monkeypatch, repeat_action=4)
+        assert settings["step_ratio"] == 1
+        assert wrappers["repeat_action"] == 4
+
+    def test_invalid_action_space_rejected(self, monkeypatch):
+        with pytest.raises(ValueError, match="action_space"):
+            _make_diambra(monkeypatch, action_space="CONTINUOUS")
+
+
+# ---------------------------------------------------------------------------
+# Super Mario Bros
+# ---------------------------------------------------------------------------
+
+
+class _FakeNesEnv:
+    def __init__(self):
+        self.observation_space = SimpleNamespace(low=0, high=255, shape=(240, 256, 3), dtype=np.uint8)
+        self.received = []
+        self.time_up = False
+
+    def reset(self):
+        return np.zeros((240, 256, 3), np.uint8)
+
+    def step(self, action):
+        self.received.append(action)
+        done = self.time_up
+        return np.zeros((240, 256, 3), np.uint8), 1.0, done, {"time": 1 if self.time_up else 0}
+
+    def render(self, mode="rgb_array"):
+        return np.zeros((240, 256, 3), np.uint8)
+
+    def close(self):
+        pass
+
+
+class _FakeJoypadSpace:
+    def __init__(self, env, moves):
+        self._env = env
+        self.moves = moves
+        self.observation_space = env.observation_space
+        self.action_space = SimpleNamespace(n=len(moves))
+
+    def __getattr__(self, name):
+        return getattr(self._env, name)
+
+
+def _fake_mario_tree(made):
+    gsmb = types.ModuleType("gym_super_mario_bros")
+    actions = types.ModuleType("gym_super_mario_bros.actions")
+    nes_py = types.ModuleType("nes_py")
+    nes_wrappers = types.ModuleType("nes_py.wrappers")
+    actions.SIMPLE_MOVEMENT = [["NOOP"], ["right"], ["right", "A"], ["right", "B"], ["right", "A", "B"], ["A"], ["left"]]
+    actions.RIGHT_ONLY = [["NOOP"], ["right"], ["right", "A"], ["right", "B"], ["right", "A", "B"]]
+    actions.COMPLEX_MOVEMENT = actions.SIMPLE_MOVEMENT + [["left", "A"], ["left", "B"], ["left", "A", "B"], ["down"], ["up"]]
+
+    def make(id):
+        env = _FakeNesEnv()
+        made.append(env)
+        return env
+
+    gsmb.make = make
+    gsmb.actions = actions
+    nes_wrappers.JoypadSpace = _FakeJoypadSpace
+    nes_py.wrappers = nes_wrappers
+    return {
+        "gym_super_mario_bros": gsmb,
+        "gym_super_mario_bros.actions": actions,
+        "nes_py": nes_py,
+        "nes_py.wrappers": nes_wrappers,
+    }
+
+
+def _make_mario(monkeypatch, **kwargs):
+    made = []
+    module = _load_backend(
+        monkeypatch, _fake_mario_tree(made), ["_IS_SUPER_MARIO_BROS_AVAILABLE"], "sheeprl_tpu.envs.super_mario_bros"
+    )
+    env = module.SuperMarioBrosWrapper(id="SuperMarioBros-1-1-v0", **kwargs)
+    return env, made[0]
+
+
+@pytest.mark.usefixtures("evict_backend_modules")
+class TestMarioMocked:
+    def test_rgb_dict_obs_and_action_space(self, monkeypatch):
+        env, _ = _make_mario(monkeypatch)
+        assert env.action_space.n == 7  # simple movement
+        assert env.observation_space["rgb"].shape == (240, 256, 3)
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (240, 256, 3) and obs["rgb"].dtype == np.uint8
+
+    def test_action_space_presets(self, monkeypatch):
+        env, _ = _make_mario(monkeypatch, action_space="right_only")
+        assert env.action_space.n == 5
+        env, _ = _make_mario(monkeypatch, action_space="complex")
+        assert env.action_space.n == 12
+
+    def test_numpy_action_unboxed(self, monkeypatch):
+        env, inner = _make_mario(monkeypatch)
+        env.reset()
+        env.step(np.array([2]))
+        assert inner.received[-1] == 2 and not isinstance(inner.received[-1], np.ndarray)
+
+    def test_time_up_reports_truncation(self, monkeypatch):
+        env, inner = _make_mario(monkeypatch)
+        env.reset()
+        _, _, terminated, truncated, _ = env.step(1)
+        assert not terminated and not truncated
+        inner.time_up = True
+        _, _, terminated, truncated, _ = env.step(1)
+        assert truncated and not terminated

@@ -1,0 +1,106 @@
+"""Metric aggregation (reference: ``sheeprl/utils/metric.py:17-195``)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.metric import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MetricAggregator,
+    MetricAggregatorException,
+    MinMetric,
+    RankIndependentMetricAggregator,
+    SumMetric,
+    build_aggregator,
+)
+
+
+class TestMetrics:
+    def test_mean_over_arrays_and_scalars(self):
+        m = MeanMetric()
+        m.update(2.0)
+        m.update(np.array([4.0, 6.0]))
+        assert m.compute() == 4.0
+        m.reset()
+        assert math.isnan(m.compute())
+
+    def test_sum_max_min(self):
+        s, hi, lo = SumMetric(), MaxMetric(), MinMetric()
+        for v in (1.0, 5.0, 3.0):
+            s.update(v), hi.update(v), lo.update(v)
+        assert (s.compute(), hi.compute(), lo.compute()) == (9.0, 5.0, 1.0)
+
+
+class TestMetricAggregator:
+    def test_update_compute_reset(self):
+        agg = MetricAggregator({"a": MeanMetric(), "b": SumMetric()})
+        agg.update("a", 1.0)
+        agg.update("a", 3.0)
+        agg.update("b", 10.0)
+        out = agg.compute()
+        assert out["a"] == 2.0 and out["b"] == 10.0
+        agg.reset()
+        assert "a" not in agg.compute()  # NaN mean is dropped after reset
+
+    def test_unknown_key_silent_by_default_raises_when_asked(self):
+        agg = MetricAggregator({"a": MeanMetric()})
+        agg.update("missing", 1.0)  # silently skipped
+        strict = MetricAggregator({"a": MeanMetric()}, raise_on_missing=True)
+        with pytest.raises(MetricAggregatorException):
+            strict.update("missing", 1.0)
+
+    def test_contains_and_keys(self):
+        agg = MetricAggregator({"a": MeanMetric()})
+        assert "a" in agg and "b" not in agg
+        assert set(agg.keys()) == {"a"}
+
+
+class TestRankIndependentAggregator:
+    def test_full_surface_delegates(self):
+        agg = RankIndependentMetricAggregator({"a": MeanMetric(), "c": CatMetric()})
+        assert "a" in agg and "b" not in agg
+        assert set(agg.keys()) == {"a", "c"}
+        assert agg.to("cpu") is agg
+        agg.update("a", 2.0)
+        agg.update("a", 4.0)
+        assert agg.compute()["a"] == 3.0
+        agg.reset()
+        assert "a" not in agg.compute()
+
+    def test_sync_is_forced_off(self):
+        agg = RankIndependentMetricAggregator({"a": MeanMetric(sync_on_compute=True)})
+        assert not agg._aggregator.metrics["a"].sync_on_compute
+
+    def test_disabled_tracks_class_flag(self):
+        agg = RankIndependentMetricAggregator({"a": MeanMetric()})
+        assert agg.disabled == MetricAggregator.disabled
+
+
+class TestBuildAggregator:
+    CFG = {
+        "metrics": {
+            "Loss/policy_loss": {"_target_": "torchmetrics.MeanMetric", "sync_on_compute": False},
+            "Game/ep_len_avg": {"_target_": "torchmetrics.SumMetric"},
+        }
+    }
+
+    def test_maps_torchmetrics_leaf_names(self):
+        agg = build_aggregator(self.CFG)
+        assert isinstance(agg, MetricAggregator)
+        assert isinstance(agg.metrics["Loss/policy_loss"], MeanMetric)
+        assert isinstance(agg.metrics["Game/ep_len_avg"], SumMetric)
+
+    def test_keys_filter(self):
+        agg = build_aggregator(self.CFG, keys_filter={"Game/ep_len_avg"})
+        assert set(agg.keys()) == {"Game/ep_len_avg"}
+
+    def test_rank_independent_variant(self):
+        agg = build_aggregator(self.CFG, rank_independent=True)
+        assert isinstance(agg, RankIndependentMetricAggregator)
+        agg.update("Loss/policy_loss", 1.5)
+        assert agg.compute()["Loss/policy_loss"] == 1.5

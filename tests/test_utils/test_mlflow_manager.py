@@ -19,10 +19,13 @@ class _FakeClient:
     def get_experiment_by_name(self, name):
         return SimpleNamespace(experiment_id="exp0") if name == "exp" else None
 
-    def search_runs(self, experiment_ids):
+    def search_runs(self, experiment_ids, page_token=None):
+        if isinstance(self._runs, dict):  # paginated: token -> page
+            return self._runs[page_token]
         return self._runs
 
     def list_artifacts(self, run_id):
+        self.artifact_calls = getattr(self, "artifact_calls", 0) + 1
         return [SimpleNamespace(path=p) for p in self._artifacts.get(run_id, [])]
 
     def update_model_version(self, name, version, description):
@@ -83,3 +86,21 @@ def test_register_best_models_no_eligible_run(manager):
 def test_register_best_models_bad_mode(manager):
     with pytest.raises(ValueError):
         manager.register_best_models("exp", MODELS_INFO, mode="avg")
+
+
+class _Page(list):
+    def __init__(self, runs, token):
+        super().__init__(runs)
+        self.token = token
+
+
+def test_register_best_models_paginates(manager):
+    # best run sits on the SECOND page; artifact lookups are skipped for
+    # runs that can't beat the current best
+    manager.client._runs = {
+        None: _Page([_run("r1", {"Test/cumulative_reward": 10.0})], "page2"),
+        "page2": _Page([_run("r2", {"Test/cumulative_reward": 99.0}), _run("r5", {"Test/cumulative_reward": 1.0})], None),
+    }
+    out = manager.register_best_models("exp", MODELS_INFO)
+    assert out["agent"].source == "runs:/r2/agent"
+    assert manager.client.artifact_calls == 2  # r1 + r2; r5 is pre-filtered
