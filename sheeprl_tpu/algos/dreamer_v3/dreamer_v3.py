@@ -48,6 +48,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
 )
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.ring import build_burst_train_step, ring_append_rows, ring_sample_windows
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -65,37 +66,6 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
 
 __all__ = ["main", "make_train_step", "ring_append_rows", "ring_sample_windows"]
-
-
-def ring_append_rows(pos, valid_n, staged_mask, capacity: int):
-    """Per-env ragged ring-append indices (burst mode).
-
-    Slot ``i`` writes env ``e`` iff ``staged_mask[i, e]``; each env's rows
-    pack densely from its own write head (mirrors
-    ``EnvIndependentReplayBuffer``'s ragged adds). Returns the ``(S, E)``
-    row indices (``capacity`` marks dropped/padded slots), the new per-env
-    write heads and the new per-env valid counts.
-    """
-    counts = jnp.cumsum(staged_mask.astype(jnp.int32), axis=0)  # (S, E)
-    row = (pos[None, :] + counts - 1) % capacity
-    row = jnp.where(staged_mask > 0, row, capacity)
-    new_pos = (pos + counts[-1]) % capacity
-    new_valid = jnp.minimum(valid_n + counts[-1], capacity)
-    return row, new_pos, new_valid
-
-
-def ring_sample_windows(key, env_idx, pos, valid_n, capacity: int, seq_len: int):
-    """Uniform sequence-window starts with the ``SequentialReplayBuffer``
-    validity rule: a window never crosses its env's write head (the
-    oldest→newest data boundary once the ring is full). Returns ``(T, B)``
-    time indices for the given per-element env choices."""
-    vn = valid_n[env_idx]
-    full = vn >= capacity
-    n_starts = jnp.where(full, capacity - seq_len + 1, jnp.maximum(vn - seq_len + 1, 1))
-    base = jnp.where(full, pos[env_idx], 0)
-    u = jax.random.uniform(key, env_idx.shape)
-    start = (base + (u * n_starts).astype(jnp.int32)) % capacity
-    return (start[None, :] + jnp.arange(seq_len)[:, None]) % capacity
 
 
 def make_train_step(
@@ -374,69 +344,10 @@ def make_train_step(
         )
         return jax.jit(shard_train, donate_argnums=(0, 1, 2))
 
-    capacity = int(ring["capacity"])
-    ring_envs = int(ring["n_envs"])
-    grad_chunk = int(ring["grad_chunk"])
-    ring_seq = int(ring["seq_len"])
-    ring_batch = int(ring["batch_size"])
-    n_dev = mesh.devices.size
-
-    def local_burst(params, opts, moments_state, rb, staged, staged_mask, pos, valid_n, key, cum0, valid):
-        # -- per-env ring append. Slot i writes env e iff staged_mask[i, e];
-        # each env's rows pack densely from its own write head (ragged adds).
-        row, new_pos, new_valid = ring_append_rows(pos, valid_n, staged_mask, capacity)
-        cols = jnp.broadcast_to(jnp.arange(ring_envs)[None, :], row.shape)
-        rb = {k: rb[k].at[row, cols].set(staged[k], mode="drop") for k in rb}
-        # No env may be shorter than a sample window yet (the host buffer
-        # raises in that case); until then every step is a no-op append.
-        valid = valid * jnp.all(new_valid >= ring_seq).astype(valid.dtype)
-
-        def sampled_step(carry, xs):
-            k, valid_flag = xs
-
-            # Padding steps beyond the granted chunk skip EVERYTHING — the
-            # window sampling and ring gather live inside the taken branch
-            # (lax.cond executes one branch; operands computed outside it
-            # would still run unconditionally).
-            def _run(c):
-                k_env, k_start, k_grad = jax.random.split(k, 3)
-                B = ring_batch // n_dev
-                env_idx = jax.random.randint(k_env, (B,), 0, ring_envs)
-                t_idx = ring_sample_windows(
-                    k_start, env_idx, new_pos, new_valid, capacity, ring_seq
-                )  # (T, B)
-                batch = {kk: rb[kk][t_idx, env_idx[None, :]] for kk in rb}
-                nc, m = gradient_step(c, (batch, k_grad))
-                return nc, tuple(x.astype(jnp.float32) for x in m)
-
-            # Zero metrics derived from the true branch's structure, so the
-            # two cond branches can never drift apart.
-            metrics_shape = jax.eval_shape(_run, carry)[1]
-            zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape)
-            new_carry, metrics = jax.lax.cond(valid_flag > 0, _run, lambda c: (c, zeros), carry)
-            return new_carry, metrics
-
-        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
-        keys = jax.random.split(key, grad_chunk)
-        (params, opts, moments_state, _), metrics = jax.lax.scan(
-            sampled_step, (params, opts, moments_state, cum0), (keys, valid)
-        )
-        # Average over the GRANTED steps only (padding contributes zeros).
-        denom = jnp.maximum(valid.sum(), 1.0)
-        metrics = jax.tree.map(lambda x: jax.lax.pmean((x * valid).sum() / denom, "dp"), metrics)
-        return params, opts, moments_state, rb, metrics
-
-    shard_burst = jax.shard_map(
-        local_burst,
-        mesh=mesh,
-        in_specs=(P(),) * 11,
-        out_specs=(P(),) * 5,
-        check_vma=False,
-    )
-    # Only the ring is donated: params/opts/moments handles are read by the
-    # main thread (checkpoints) while a burst may be in flight — donation
-    # would hand it deleted buffers.
-    return jax.jit(shard_burst, donate_argnums=(3,))
+    # Burst variant: carry = (params, opts, moments_state, cum); the ring
+    # machinery (append, on-device window sampling, granted-chunk scan) is
+    # shared with Dreamer-V1/V2 in ``data/ring.py``.
+    return build_burst_train_step(gradient_step, mesh, ring)
 
 
 @register_algorithm()
@@ -621,10 +532,13 @@ def main(fabric, cfg: Dict[str, Any]):
     host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
 
     if burst_mode:
-        import queue as _queue
-        import threading as _threading
-
-        from jax.flatten_util import ravel_pytree
+        from sheeprl_tpu.utils.burst import (
+            DREAMER_METRIC_NAMES,
+            BurstRunner,
+            HostSnapshot,
+            dreamer_ring_keys,
+            init_device_ring,
+        )
 
         grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
         # Steady-state staging only (one regular row + at most one ragged
@@ -632,18 +546,9 @@ def main(fabric, cfg: Dict[str, Any]):
         # append-only bursts (chunk=0) instead of inflating every payload.
         stage_max = min(4 * train_every + int(cfg.env.num_envs) + 2, buffer_size)
         wm_cfg_ = cfg.algo.world_model
-        obs_specs = {}
-        for k in cnn_keys:
-            obs_specs[k] = (tuple(observation_space[k].shape), jnp.uint8)
-        for k in mlp_keys:
-            obs_specs[k] = (tuple(observation_space[k].shape), jnp.float32)
-        ring_keys = {
-            **obs_specs,
-            "actions": ((int(np.sum(actions_dim)),), jnp.float32),
-            "rewards": ((1,), jnp.float32),
-            "terminated": ((1,), jnp.float32),
-            "is_first": ((1,), jnp.float32),
-        }
+        ring_keys = dreamer_ring_keys(
+            observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=True
+        )
         ring_spec = {
             "capacity": buffer_size,
             "n_envs": int(cfg.env.num_envs),
@@ -654,27 +559,13 @@ def main(fabric, cfg: Dict[str, Any]):
         burst_fn = make_train_step(
             world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs, ring=ring_spec
         )
-        rb_dev = {
-            k: fabric.put_replicated(jnp.zeros((buffer_size, int(cfg.env.num_envs)) + shape, dtype))
-            for k, (shape, dtype) in ring_keys.items()
-        }
-        dev_pos = np.zeros(int(cfg.env.num_envs), np.int64)
-        dev_valid = np.zeros(int(cfg.env.num_envs), np.int64)
-        if state is not None and cfg.buffer.checkpoint:
-            # Mirror the restored per-env host buffers onto the device ring.
-            for e, sub in enumerate(rb.buffer):
-                for k in rb_dev:
-                    host = np.asarray(sub.buffer[k][:, 0], dtype=rb_dev[k].dtype)
-                    rb_dev[k] = rb_dev[k].at[:, e].set(jnp.asarray(host))
-                dev_pos[e] = sub._pos
-                dev_valid[e] = buffer_size if sub.full else sub._pos
-            rb_dev = {k: fabric.put_replicated(v) for k, v in rb_dev.items()}
-        staged: list = []  # (data dict, env mask) per ring row
+        rb_dev, dev_pos, dev_valid = init_device_ring(
+            fabric, ring_keys, buffer_size, int(cfg.env.num_envs),
+            rb=rb if (state is not None and cfg.buffer.checkpoint) else None,
+        )
         grant_backlog = 0
 
         # -- host-CPU player from a packed bf16 snapshot -----------------
-        host_device = jax.devices("cpu")[0]
-
         def _player_subset(p):
             wm = p["world_model"]
             return {
@@ -688,10 +579,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 "actor": p["actor"],
             }
 
-        _, _unravel = ravel_pytree(jax.tree.map(np.asarray, _player_subset(params)))
-        _pack = jax.jit(lambda p: ravel_pytree(_player_subset(p))[0].astype(jnp.bfloat16))
-        _unpack = jax.jit(lambda v: _unravel(v.astype(jnp.float32)))
-        host_params = _unpack(jax.device_put(_pack(params), host_device))
+        snapshot = HostSnapshot(_player_subset, params)
+        host_params = snapshot.pull(params)
         host_player = PlayerDV3(
             world_model,
             actor,
@@ -701,85 +590,35 @@ def main(fabric, cfg: Dict[str, Any]):
             int(wm_cfg_.recurrent_model.recurrent_state_size),
             discrete_size=int(wm_cfg_.discrete_size),
         )
-        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), host_device)
-        _snapshot_slot: list = [None]
+        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
 
-        # -- trainer thread ----------------------------------------------
-        _tr = {
-            "params": params, "opts": opts, "moments": moments_state,
-            "rb_dev": rb_dev, "metrics": None, "error": None, "bursts": 0,
-        }
-        _tr_lock = _threading.Lock()
-        _burst_q: "_queue.Queue" = _queue.Queue(maxsize=2)
-
-        def _burst_worker():
-            while True:
-                job = _burst_q.get()
-                if job is None:
-                    return
-                try:
-                    staged_j, mask_j, pos_j, valid_j, key_j, cum_j, validmask_j, trained = job
-                    out = burst_fn(
-                        _tr["params"], _tr["opts"], _tr["moments"], _tr["rb_dev"],
-                        staged_j, mask_j, pos_j, valid_j, key_j, cum_j, validmask_j,
-                    )
-                    with _tr_lock:
-                        _tr["params"], _tr["opts"], _tr["moments"], _tr["rb_dev"] = out[:4]
-                        if trained:  # append-only bursts produce junk metrics
-                            _tr["metrics"] = out[4]
-                            _tr["bursts"] += 1
-                    if trained and _tr["bursts"] % snapshot_every == 0:
-                        # One packed pull; blocking is fine on this thread.
-                        _snapshot_slot[0] = jax.device_put(_pack(_tr["params"]), host_device)
-                except Exception as exc:  # surfaced at the next put/join
-                    _tr["error"] = exc
-                    while _burst_q.get() is not None:
-                        pass
-                    return
-
-        _burst_thread = _threading.Thread(target=_burst_worker, daemon=True)
-        _burst_thread.start()
+        # -- trainer thread (shared runner; carry = params/opts/moments/cum)
+        runner = BurstRunner(
+            burst_fn,
+            (params, opts, moments_state, jnp.int32(0)),
+            rb_dev,
+            ring_keys,
+            n_envs=int(cfg.env.num_envs),
+            capacity=buffer_size,
+            grad_chunk=grad_chunk,
+            stage_max=stage_max,
+            seq_len=seq_len,
+            snapshot=snapshot,
+            snapshot_every=snapshot_every,
+            params_of=lambda c: c[0],
+        )
+        runner.set_ring_state(dev_pos, dev_valid)
 
         def _flush_burst():
             nonlocal rng, grant_backlog, cumulative_per_rank_gradient_steps, train_step
-            arrs = {}
-            for k, (shape, dtype) in ring_keys.items():
-                arr = np.zeros((stage_max, int(cfg.env.num_envs)) + shape, dtype)
-                for i, (data, _m) in enumerate(staged):
-                    arr[i] = data[k]
-                arrs[k] = arr
-            mask = np.zeros((stage_max, int(cfg.env.num_envs)), np.int32)
-            for i, (_d, m) in enumerate(staged):
-                mask[i] = m
-            staged.clear()
-            # Hold grants while any env is still shorter than a sample
-            # window (the host buffer refuses to sample in that state).
-            env_counts = mask.sum(axis=0)
-            ready = (dev_valid + env_counts).min() >= seq_len
-            chunk = min(grad_chunk, grant_backlog) if ready else 0
-            validmask = np.zeros((grad_chunk,), np.float32)
-            validmask[:chunk] = 1.0
-            if _tr["error"] is not None:
-                raise _tr["error"]
             with timer("Time/train_time", SumMetric):
                 rng, train_key = jax.random.split(rng)
-                _burst_q.put((
-                    arrs, jnp.asarray(mask), jnp.asarray(dev_pos, jnp.int32),
-                    jnp.asarray(dev_valid, jnp.int32), train_key,
-                    jnp.int32(cumulative_per_rank_gradient_steps), jnp.asarray(validmask),
-                    chunk > 0,
-                ))
-                if aggregator and not aggregator.disabled and _tr["metrics"] is not None:
-                    names = (
-                        "Loss/world_model_loss", "Loss/observation_loss", "Loss/reward_loss",
-                        "Loss/state_loss", "Loss/continue_loss", "State/kl", "State/post_entropy",
-                        "State/prior_entropy", "Loss/policy_loss", "Loss/value_loss",
-                    )
-                    for name, value in zip(names, _tr["metrics"]):
+                chunk = runner.flush(train_key, grant_backlog)
+                latest = runner.metrics
+                if aggregator and not aggregator.disabled and latest is not None:
+                    for name, value in zip(DREAMER_METRIC_NAMES, latest):
                         if name in aggregator:
                             aggregator.update(name, value)
-            dev_pos[:] = (dev_pos + env_counts) % buffer_size
-            dev_valid[:] = np.minimum(dev_valid + env_counts, buffer_size)
             grant_backlog -= chunk
             if chunk > 0:
                 cumulative_per_rank_gradient_steps += chunk
@@ -812,9 +651,10 @@ def main(fabric, cfg: Dict[str, Any]):
         profiler.tick(iter_num)
         policy_step += policy_steps_per_iter
 
-        if burst_mode and _snapshot_slot[0] is not None:
-            host_params = _unpack(_snapshot_slot[0])
-            _snapshot_slot[0] = None
+        if burst_mode:
+            fresh = snapshot.poll()
+            if fresh is not None:
+                host_params = fresh
 
         with timer("Time/env_interaction_time", SumMetric):
             if iter_num <= learning_starts and state is None:
@@ -846,10 +686,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if host_mirror:
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             if burst_mode:
-                staged.append((
-                    {k: np.asarray(step_data[k][0]) for k in ring_keys},
-                    np.ones(cfg.env.num_envs, np.int32),
-                ))
+                runner.stage_step(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -873,11 +710,10 @@ def main(fabric, cfg: Dict[str, Any]):
                             sub_rb["is_first"][last_inserted_idx]
                         )
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
-                    if burst_mode and staged:
+                    if burst_mode:
                         # Same truncation patch on the row still in staging
                         # (truncated isn't stored in the device ring).
-                        staged[-1][0]["terminated"][i] = 0.0
-                        staged[-1][0]["is_first"][i] = 0.0
+                        runner.patch_last(i, {"terminated": 0.0, "is_first": 0.0})
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             ep_info = infos["final_info"]
@@ -923,15 +759,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if host_mirror:
                 rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             if burst_mode:
-                # Ragged ring row: only the done envs advance their heads.
-                row = {}
-                env_mask = np.zeros(cfg.env.num_envs, np.int32)
-                env_mask[dones_idxes] = 1
-                for k, (shape, dtype) in ring_keys.items():
-                    full_row = np.zeros((cfg.env.num_envs,) + shape, dtype)
-                    full_row[dones_idxes] = np.asarray(reset_data[k][0])
-                    row[k] = full_row
-                staged.append((row, env_mask))
+                runner.stage_reset(reset_data, dones_idxes)
 
             # Reset already-inserted step data (reference: dreamer_v3.py:652-658)
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
@@ -947,7 +775,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if burst_mode:
             if iter_num >= learning_starts:
                 grant_backlog += ratio(policy_step - prefill_steps * policy_steps_per_iter)
-            while grant_backlog >= grad_chunk or len(staged) >= stage_max - 1 - cfg.env.num_envs:
+            while grant_backlog >= grad_chunk or runner.staging_full():
                 consumed = _flush_burst()
                 if consumed == 0 or grant_backlog < grad_chunk:
                     break
@@ -1016,8 +844,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_checkpoint = policy_step
             if burst_mode:
                 # Latest trainer-thread handles (at most one burst stale).
-                with _tr_lock:
-                    params, opts, moments_state = _tr["params"], _tr["opts"], _tr["moments"]
+                params, opts, moments_state, _ = runner.carry
             ckpt_state = {
                 "world_model": params["world_model"],
                 "actor": params["actor"],
@@ -1043,14 +870,10 @@ def main(fabric, cfg: Dict[str, Any]):
         # Flush the tail: Ratio already counted the remaining grants. Grants
         # that can never execute (data still shorter than a window) are
         # abandoned with the run.
-        while staged or grant_backlog:
-            if _flush_burst() == 0 and not staged:
+        while runner.staged_count or grant_backlog:
+            if _flush_burst() == 0 and not runner.staged_count:
                 break
-        _burst_q.put(None)
-        _burst_thread.join()
-        if _tr["error"] is not None:
-            raise _tr["error"]
-        params, opts, moments_state = _tr["params"], _tr["opts"], _tr["moments"]
+        params, opts, moments_state, _ = runner.close()
 
     envs.close()
     profiler.close()

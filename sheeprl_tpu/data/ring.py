@@ -1,0 +1,141 @@
+"""Device-resident sequence ring for burst training (TPU-native; no
+reference counterpart).
+
+The reference samples replay windows on the host and ships every batch to the
+accelerator (``sheeprl/data/buffers.py:395-528`` feeding the Dreamer train
+loops). On a tunneled TPU that is one full wire round-trip per gradient step
+plus the batch upload (batch 16 x seq 64 of 64x64 pixels is ~12.6 MB). The
+burst design inverts it: raw transitions stream to a device uint8 ring with
+per-env write heads, windows are sampled ON device with the
+``SequentialReplayBuffer`` validity rule, and a whole chunk of granted
+gradient steps runs per dispatch.
+
+Shared by the Dreamer-V1/V2/V3 burst paths; the index math is unit-tested in
+``tests/test_algos/test_dreamer_ring.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_append_rows", "ring_sample_windows", "build_burst_train_step"]
+
+
+def ring_append_rows(pos, valid_n, staged_mask, capacity: int):
+    """Per-env ragged ring-append indices (burst mode).
+
+    Slot ``i`` writes env ``e`` iff ``staged_mask[i, e]``; each env's rows
+    pack densely from its own write head (mirrors
+    ``EnvIndependentReplayBuffer``'s ragged adds). Returns the ``(S, E)``
+    row indices (``capacity`` marks dropped/padded slots), the new per-env
+    write heads and the new per-env valid counts.
+    """
+    counts = jnp.cumsum(staged_mask.astype(jnp.int32), axis=0)  # (S, E)
+    row = (pos[None, :] + counts - 1) % capacity
+    row = jnp.where(staged_mask > 0, row, capacity)
+    new_pos = (pos + counts[-1]) % capacity
+    new_valid = jnp.minimum(valid_n + counts[-1], capacity)
+    return row, new_pos, new_valid
+
+
+def ring_sample_windows(key, env_idx, pos, valid_n, capacity: int, seq_len: int):
+    """Uniform sequence-window starts with the ``SequentialReplayBuffer``
+    validity rule: a window never crosses its env's write head (the
+    oldest→newest data boundary once the ring is full). Returns ``(T, B)``
+    time indices for the given per-element env choices."""
+    vn = valid_n[env_idx]
+    full = vn >= capacity
+    n_starts = jnp.where(full, capacity - seq_len + 1, jnp.maximum(vn - seq_len + 1, 1))
+    base = jnp.where(full, pos[env_idx], 0)
+    u = jax.random.uniform(key, env_idx.shape)
+    start = (base + (u * n_starts).astype(jnp.int32)) % capacity
+    return (start[None, :] + jnp.arange(seq_len)[:, None]) % capacity
+
+
+def build_burst_train_step(
+    gradient_step: Callable[[Any, Any], Any],
+    mesh,
+    ring: Dict[str, Any],
+):
+    """Wrap an algo's per-gradient-step update into a ring-owning burst step.
+
+    ``gradient_step(carry, (batch, key)) -> (carry, metrics)`` is the same
+    scan body the algo's host-sampled path uses; ``carry`` is an arbitrary
+    pytree (params/opts/… — Dreamer-V1 carries 2 leaves groups, V2/V3 add a
+    cumulative-step counter and V3 the Moments state). The returned jitted
+    function has signature::
+
+        burst_fn(carry, rb, staged, staged_mask, pos, valid_n, key, valid)
+            -> (carry, rb, metrics)
+
+    with ``rb`` the device ring dict (donated), ``staged`` the
+    ``(S, E, ...)`` host rows, ``staged_mask`` ``(S, E)`` env write masks,
+    ``pos``/``valid_n`` the per-env heads, and ``valid`` a
+    ``(grad_chunk,)`` 0/1 mask of granted steps (padding steps skip all
+    work via ``lax.cond``).
+    """
+    capacity = int(ring["capacity"])
+    ring_envs = int(ring["n_envs"])
+    grad_chunk = int(ring["grad_chunk"])
+    ring_seq = int(ring["seq_len"])
+    ring_batch = int(ring["batch_size"])
+    n_dev = mesh.devices.size
+
+    def local_burst(carry, rb, staged, staged_mask, pos, valid_n, key, valid):
+        # -- per-env ring append. Slot i writes env e iff staged_mask[i, e];
+        # each env's rows pack densely from its own write head (ragged adds).
+        row, new_pos, new_valid = ring_append_rows(pos, valid_n, staged_mask, capacity)
+        cols = jnp.broadcast_to(jnp.arange(ring_envs)[None, :], row.shape)
+        rb = {k: rb[k].at[row, cols].set(staged[k], mode="drop") for k in rb}
+        # No env may be shorter than a sample window yet (the host buffer
+        # raises in that case); until then every step is a no-op append.
+        valid = valid * jnp.all(new_valid >= ring_seq).astype(valid.dtype)
+
+        def sampled_step(c, xs):
+            k, valid_flag = xs
+
+            # Padding steps beyond the granted chunk skip EVERYTHING — the
+            # window sampling and ring gather live inside the taken branch
+            # (lax.cond executes one branch; operands computed outside it
+            # would still run unconditionally).
+            def _run(c):
+                k_env, k_start, k_grad = jax.random.split(k, 3)
+                B = ring_batch // n_dev
+                env_idx = jax.random.randint(k_env, (B,), 0, ring_envs)
+                t_idx = ring_sample_windows(
+                    k_start, env_idx, new_pos, new_valid, capacity, ring_seq
+                )  # (T, B)
+                batch = {kk: rb[kk][t_idx, env_idx[None, :]] for kk in rb}
+                nc, m = gradient_step(c, (batch, k_grad))
+                return nc, tuple(x.astype(jnp.float32) for x in m)
+
+            # Zero metrics derived from the true branch's structure, so the
+            # two cond branches can never drift apart.
+            metrics_shape = jax.eval_shape(_run, c)[1]
+            zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape)
+            new_carry, metrics = jax.lax.cond(valid_flag > 0, _run, lambda cc: (cc, zeros), c)
+            return new_carry, metrics
+
+        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+        keys = jax.random.split(key, grad_chunk)
+        carry, metrics = jax.lax.scan(sampled_step, carry, (keys, valid))
+        # Average over the GRANTED steps only (padding contributes zeros).
+        denom = jnp.maximum(valid.sum(), 1.0)
+        metrics = jax.tree.map(lambda x: jax.lax.pmean((x * valid).sum() / denom, "dp"), metrics)
+        return carry, rb, metrics
+
+    shard_burst = jax.shard_map(
+        local_burst,
+        mesh=mesh,
+        in_specs=(P(),) * 8,
+        out_specs=(P(),) * 3,
+        check_vma=False,
+    )
+    # Only the ring is donated: the carry handles (params/opts/...) are read
+    # by the main thread (checkpoints) while a burst may be in flight —
+    # donation would hand it deleted buffers.
+    return jax.jit(shard_burst, donate_argnums=(1,))

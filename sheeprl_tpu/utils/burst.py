@@ -1,0 +1,278 @@
+"""Host-policy snapshot + trainer-thread burst dispatch (TPU-native; the
+``algo.hybrid_player`` machinery shared by the Dreamer burst paths).
+
+Two pieces:
+
+- :class:`HostSnapshot` — the player's parameter subset packed into ONE
+  bf16 vector for the device→host pull (per-leaf pulls each pay a full
+  tunnel round-trip), unpacked on the host CPU where the policy runs.
+- :class:`BurstRunner` — the staging rows + bounded job queue + trainer
+  thread that dispatches ring bursts (see ``data/ring.py``) without ever
+  blocking the env loop on the wire; the queue bound is the backpressure.
+
+Algorithm mains keep ownership of grant accounting (``Ratio``), metric
+names, timers and checkpoint layout — the runner only moves data.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+__all__ = [
+    "HostSnapshot",
+    "BurstRunner",
+    "DREAMER_METRIC_NAMES",
+    "dreamer_ring_keys",
+    "init_device_ring",
+]
+
+# Order matches the metrics tuple every Dreamer gradient_step returns.
+DREAMER_METRIC_NAMES = (
+    "Loss/world_model_loss", "Loss/observation_loss", "Loss/reward_loss",
+    "Loss/state_loss", "Loss/continue_loss", "State/kl", "State/post_entropy",
+    "State/prior_entropy", "Loss/policy_loss", "Loss/value_loss",
+)
+
+
+def dreamer_ring_keys(observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first: bool):
+    """Ring storage spec for a Dreamer family: pixel keys stay uint8 in HBM,
+    vectors/action/reward/terminated are float32; ``is_first`` only for the
+    families whose dynamic scan consumes it (V2/V3)."""
+    specs = {}
+    for k in cnn_keys:
+        specs[k] = (tuple(observation_space[k].shape), jnp.uint8)
+    for k in mlp_keys:
+        specs[k] = (tuple(observation_space[k].shape), jnp.float32)
+    specs["actions"] = ((int(np.sum(actions_dim)),), jnp.float32)
+    specs["rewards"] = ((1,), jnp.float32)
+    specs["terminated"] = ((1,), jnp.float32)
+    if with_is_first:
+        specs["is_first"] = ((1,), jnp.float32)
+    return specs
+
+
+def init_device_ring(fabric, ring_keys, capacity: int, n_envs: int, rb=None):
+    """Allocate the device ring, optionally mirroring restored per-env host
+    buffers (checkpoint resume). The mirror assembles each key host-side and
+    ships it in ONE transfer — per-env ``.at[:, e].set`` updates would copy
+    the full ring once per env per key. Returns ``(rb_dev, pos, valid)``."""
+    dev_pos = np.zeros(n_envs, np.int64)
+    dev_valid = np.zeros(n_envs, np.int64)
+    rb_dev = {}
+    if rb is None:
+        for k, (shape, dtype) in ring_keys.items():
+            rb_dev[k] = fabric.put_replicated(jnp.zeros((capacity, n_envs) + shape, dtype))
+    else:
+        for k, (shape, dtype) in ring_keys.items():
+            host = np.zeros((capacity, n_envs) + shape, np.dtype(dtype))
+            for e, sub in enumerate(rb.buffer):
+                host[:, e] = np.asarray(sub.buffer[k][:, 0], dtype=host.dtype)
+            rb_dev[k] = fabric.put_replicated(jnp.asarray(host))
+        for e, sub in enumerate(rb.buffer):
+            dev_pos[e] = sub._pos
+            dev_valid[e] = capacity if sub.full else sub._pos
+    return rb_dev, dev_pos, dev_valid
+
+
+class HostSnapshot:
+    """Packed bf16 params snapshot for the host-CPU player.
+
+    ``subset_fn(params)`` selects the leaves the policy needs (encoder +
+    recurrent/representation/transition models + actor); everything else
+    (decoders, critics, optimizer state) never crosses the wire.
+    """
+
+    def __init__(self, subset_fn: Callable[[Any], Any], params: Any):
+        self.host_device = jax.devices("cpu")[0]
+        _, unravel = ravel_pytree(jax.tree.map(np.asarray, subset_fn(params)))
+        self._pack = jax.jit(lambda p: ravel_pytree(subset_fn(p))[0].astype(jnp.bfloat16))
+        self._unpack = jax.jit(lambda v: unravel(v.astype(jnp.float32)))
+        self._slot: list = [None]
+
+    def pull(self, params: Any) -> Any:
+        """Blocking pack → pull → unpack (initialization / trainer thread)."""
+        return self._unpack(jax.device_put(self._pack(params), self.host_device))
+
+    def refresh(self, params: Any) -> None:
+        """Store a fresh packed snapshot (called on the trainer thread; the
+        blocking pull is fine there)."""
+        self._slot[0] = jax.device_put(self._pack(params), self.host_device)
+
+    def poll(self) -> Optional[Any]:
+        """Main thread: the latest snapshot unpacked on the host, or None."""
+        packed, self._slot[0] = self._slot[0], None
+        return None if packed is None else self._unpack(packed)
+
+
+class BurstRunner:
+    """Staging + dispatch for a device-ring burst step.
+
+    ``burst_fn(carry, rb, staged, staged_mask, pos, valid_n, key, valid)``
+    is the jitted function from :func:`data.ring.build_burst_train_step`;
+    ``carry`` holds the training handles (params/opts/...) and is readable
+    at any time via :attr:`carry` (at most one burst stale — checkpoints
+    accept that the same way the reference's decoupled SAC does).
+    """
+
+    def __init__(
+        self,
+        burst_fn: Callable,
+        carry: Any,
+        rb_dev: Dict[str, jax.Array],
+        ring_keys: Dict[str, Tuple[tuple, Any]],
+        n_envs: int,
+        capacity: int,
+        grad_chunk: int,
+        stage_max: int,
+        seq_len: int,
+        snapshot: Optional[HostSnapshot] = None,
+        snapshot_every: int = 4,
+        params_of: Callable[[Any], Any] = lambda carry: carry[0],
+    ) -> None:
+        self._burst_fn = burst_fn
+        self._params_of = params_of
+        self._ring_keys = ring_keys
+        self._n_envs = int(n_envs)
+        self._capacity = int(capacity)
+        self.grad_chunk = int(grad_chunk)
+        self._stage_max = int(stage_max)
+        self._seq_len = int(seq_len)
+        self._snapshot = snapshot
+        self._snapshot_every = max(1, int(snapshot_every))
+
+        self.dev_pos = np.zeros(self._n_envs, np.int64)
+        self.dev_valid = np.zeros(self._n_envs, np.int64)
+        self._staged: list = []  # (data dict, env mask) per ring row
+        self._state = {"carry": carry, "rb": rb_dev, "metrics": None, "error": None, "bursts": 0}
+        self._lock = threading.Lock()
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=2)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    # -- ring-state restore (checkpoint resume) ------------------------------
+    def set_ring_state(self, pos: np.ndarray, valid: np.ndarray) -> None:
+        self.dev_pos[:] = pos
+        self.dev_valid[:] = valid
+
+    # -- staging -------------------------------------------------------------
+    def stage(self, row: Dict[str, np.ndarray], env_mask: np.ndarray) -> None:
+        self._staged.append((row, env_mask))
+
+    def stage_step(self, step_data: Dict[str, np.ndarray]) -> None:
+        """Stage a regular all-envs row from ``(1, n_envs, ...)`` step data."""
+        self.stage(
+            {k: np.asarray(step_data[k][0]) for k in self._ring_keys},
+            np.ones(self._n_envs, np.int32),
+        )
+
+    def stage_reset(self, reset_data: Dict[str, np.ndarray], env_idxes) -> None:
+        """Stage a ragged reset row: only the done envs advance their heads
+        (mirrors ``EnvIndependentReplayBuffer.add(data, env_idxes)``)."""
+        row = {}
+        env_mask = np.zeros(self._n_envs, np.int32)
+        env_mask[env_idxes] = 1
+        for k, (shape, dtype) in self._ring_keys.items():
+            full_row = np.zeros((self._n_envs,) + shape, dtype)
+            full_row[env_idxes] = np.asarray(reset_data[k][0])
+            row[k] = full_row
+        self.stage(row, env_mask)
+
+    def patch_last(self, env_idx: int, updates: Dict[str, float]) -> None:
+        """In-place edit of the most recent staged row for one env (the
+        truncation patch on env-restart)."""
+        if self._staged:
+            for k, v in updates.items():
+                self._staged[-1][0][k][env_idx] = v
+
+    @property
+    def staged_count(self) -> int:
+        return len(self._staged)
+
+    def staging_full(self) -> bool:
+        return len(self._staged) >= self._stage_max - 1 - self._n_envs
+
+    # -- trainer-thread handles ----------------------------------------------
+    @property
+    def carry(self) -> Any:
+        with self._lock:
+            return self._state["carry"]
+
+    @property
+    def metrics(self) -> Optional[Any]:
+        with self._lock:
+            return self._state["metrics"]
+
+    def raise_if_failed(self) -> None:
+        if self._state["error"] is not None:
+            raise self._state["error"]
+
+    # -- dispatch ------------------------------------------------------------
+    def flush(self, key, grant_backlog: int) -> int:
+        """Package the staged rows + up to ``grad_chunk`` grants into one
+        burst job. Returns the number of grants consumed (0 while any env is
+        still shorter than a sample window)."""
+        self.raise_if_failed()
+        arrs = {}
+        for k, (shape, dtype) in self._ring_keys.items():
+            arr = np.zeros((self._stage_max, self._n_envs) + shape, dtype)
+            for i, (data, _m) in enumerate(self._staged):
+                arr[i] = data[k]
+            arrs[k] = arr
+        mask = np.zeros((self._stage_max, self._n_envs), np.int32)
+        for i, (_d, m) in enumerate(self._staged):
+            mask[i] = m
+        self._staged.clear()
+        # Hold grants while any env is still shorter than a sample window
+        # (the host buffer refuses to sample in that state).
+        env_counts = mask.sum(axis=0)
+        ready = (self.dev_valid + env_counts).min() >= self._seq_len
+        chunk = min(self.grad_chunk, grant_backlog) if ready else 0
+        validmask = np.zeros((self.grad_chunk,), np.float32)
+        validmask[:chunk] = 1.0
+        self._q.put((
+            arrs, jnp.asarray(mask), jnp.asarray(self.dev_pos, jnp.int32),
+            jnp.asarray(self.dev_valid, jnp.int32), key, jnp.asarray(validmask),
+            chunk > 0,
+        ))
+        self.dev_pos[:] = (self.dev_pos + env_counts) % self._capacity
+        self.dev_valid[:] = np.minimum(self.dev_valid + env_counts, self._capacity)
+        return chunk
+
+    def _worker(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                staged_j, mask_j, pos_j, valid_j, key_j, validmask_j, trained = job
+                carry, rb, metrics = self._burst_fn(
+                    self._state["carry"], self._state["rb"],
+                    staged_j, mask_j, pos_j, valid_j, key_j, validmask_j,
+                )
+                with self._lock:
+                    self._state["carry"], self._state["rb"] = carry, rb
+                    if trained:  # append-only bursts produce junk metrics
+                        self._state["metrics"] = metrics
+                        self._state["bursts"] += 1
+                if trained and self._snapshot is not None and self._state["bursts"] % self._snapshot_every == 0:
+                    # One packed pull; blocking is fine on this thread.
+                    self._snapshot.refresh(self._params_of(self._state["carry"]))
+            except Exception as exc:  # surfaced at the next flush/close
+                self._state["error"] = exc
+                while self._q.get() is not None:
+                    pass
+                return
+
+    def close(self) -> Any:
+        """Stop the trainer thread and return the final carry."""
+        self._q.put(None)
+        self._thread.join()
+        self.raise_if_failed()
+        return self._state["carry"]

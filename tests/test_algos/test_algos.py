@@ -375,11 +375,11 @@ def test_unknown_algorithm_errors(tmp_path):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])
 
 
-def test_dreamer_v3_hybrid_burst(tmp_path):
-    """The TPU-native hybrid/burst path forced on over the CPU mesh: host
+def _hybrid_burst_args(tmp_path, algo, fast):
+    """Force the TPU-native hybrid/burst path on over the CPU mesh: host
     player + device sequence ring + trainer-thread bursts, multiple
     iterations past learning_starts, then the greedy test rollout."""
-    args = _std_args(tmp_path, "dreamer_v3", extra=DREAMER_FAST)
+    args = _std_args(tmp_path, algo, extra=fast)
     args.remove("dry_run=True")
     args.remove("algo.run_test=False")
     args += [
@@ -393,4 +393,16 @@ def test_dreamer_v3_hybrid_burst(tmp_path):
         "algo.per_rank_sequence_length=4",
         "buffer.size=2000",
     ]
-    run(args)
+    return args
+
+
+def test_dreamer_v3_hybrid_burst(tmp_path):
+    run(_hybrid_burst_args(tmp_path, "dreamer_v3", DREAMER_FAST))
+
+
+def test_dreamer_v1_hybrid_burst(tmp_path):
+    run(_hybrid_burst_args(tmp_path, "dreamer_v1", DREAMER_V1_FAST))
+
+
+def test_dreamer_v2_hybrid_burst(tmp_path):
+    run(_hybrid_burst_args(tmp_path, "dreamer_v2", DREAMER_V2_FAST))
