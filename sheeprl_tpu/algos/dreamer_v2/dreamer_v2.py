@@ -45,7 +45,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
+from sheeprl_tpu.utils.utils import Ratio, conv_heavy_compile_options, resolve_hybrid_player, save_configs
 
 __all__ = ["main", "make_train_step"]
 
@@ -257,7 +257,9 @@ def make_train_step(
         return (params, opts, cum + 1), metrics
 
     if ring is not None:
-        return build_burst_train_step(gradient_step, mesh, ring)
+        return build_burst_train_step(
+            gradient_step, mesh, ring, compiler_options=conv_heavy_compile_options(mesh)
+        )
 
     def local_train(params, opts, data, key, cum0):
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
@@ -274,7 +276,7 @@ def make_train_step(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shard_train, donate_argnums=(0, 1))
+    return jax.jit(shard_train, donate_argnums=(0, 1), compiler_options=conv_heavy_compile_options(mesh))
 
 
 @register_algorithm()
@@ -469,11 +471,12 @@ def main(fabric, cfg: Dict[str, Any]):
             BurstRunner,
             HostSnapshot,
             dreamer_ring_keys,
+            dreamer_stage_sizes,
             init_device_ring,
         )
 
         grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
-        stage_max = min(4 * train_every + int(cfg.env.num_envs) + 2, buffer_size)
+        stage_max, stage_buckets = dreamer_stage_sizes(train_every, int(cfg.env.num_envs), buffer_size)
         ring_keys = dreamer_ring_keys(
             observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=True
         )
@@ -531,6 +534,7 @@ def main(fabric, cfg: Dict[str, Any]):
             snapshot=snapshot,
             snapshot_every=snapshot_every,
             params_of=lambda c: c[0],
+            stage_buckets=stage_buckets,
         )
         runner.set_ring_state(dev_pos, dev_valid)
 

@@ -537,6 +537,7 @@ def main(fabric, cfg: Dict[str, Any]):
             BurstRunner,
             HostSnapshot,
             dreamer_ring_keys,
+            dreamer_stage_sizes,
             init_device_ring,
         )
 
@@ -544,7 +545,7 @@ def main(fabric, cfg: Dict[str, Any]):
         # Steady-state staging only (one regular row + at most one ragged
         # reset row per iteration between bursts): the prefill phase flushes
         # append-only bursts (chunk=0) instead of inflating every payload.
-        stage_max = min(4 * train_every + int(cfg.env.num_envs) + 2, buffer_size)
+        stage_max, stage_buckets = dreamer_stage_sizes(train_every, int(cfg.env.num_envs), buffer_size)
         wm_cfg_ = cfg.algo.world_model
         ring_keys = dreamer_ring_keys(
             observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=True
@@ -606,6 +607,7 @@ def main(fabric, cfg: Dict[str, Any]):
             snapshot=snapshot,
             snapshot_every=snapshot_every,
             params_of=lambda c: c[0],
+            stage_buckets=stage_buckets,
         )
         runner.set_ring_state(dev_pos, dev_valid)
 

@@ -60,6 +60,7 @@ def build_burst_train_step(
     gradient_step: Callable[[Any, Any], Any],
     mesh,
     ring: Dict[str, Any],
+    compiler_options: Dict[str, Any] | None = None,
 ):
     """Wrap an algo's per-gradient-step update into a ring-owning burst step.
 
@@ -138,4 +139,4 @@ def build_burst_train_step(
     # Only the ring is donated: the carry handles (params/opts/...) are read
     # by the main thread (checkpoints) while a burst may be in flight —
     # donation would hand it deleted buffers.
-    return jax.jit(shard_burst, donate_argnums=(1,))
+    return jax.jit(shard_burst, donate_argnums=(1,), compiler_options=compiler_options)

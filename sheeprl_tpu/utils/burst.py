@@ -30,8 +30,20 @@ __all__ = [
     "BurstRunner",
     "DREAMER_METRIC_NAMES",
     "dreamer_ring_keys",
+    "dreamer_stage_sizes",
     "init_device_ring",
 ]
+
+
+def dreamer_stage_sizes(train_every: int, n_envs: int, buffer_size: int):
+    """Staging-row capacity and flush-upload buckets for the Dreamer burst
+    paths. A flush normally carries ``train_every`` step rows plus the odd
+    ragged reset row, so the first bucket covers the common case and the cap
+    leaves 4x headroom for a backed-up trainer queue; every distinct bucket
+    is one extra trace/compile of the burst program."""
+    slack = n_envs + 2
+    stage_max = min(4 * train_every + slack, buffer_size)
+    return stage_max, (train_every + slack, 2 * train_every + slack)
 
 # Order matches the metrics tuple every Dreamer gradient_step returns.
 DREAMER_METRIC_NAMES = (
@@ -67,8 +79,17 @@ def init_device_ring(fabric, ring_keys, capacity: int, n_envs: int, rb=None):
     dev_valid = np.zeros(n_envs, np.int64)
     rb_dev = {}
     if rb is None:
-        for k, (shape, dtype) in ring_keys.items():
-            rb_dev[k] = fabric.put_replicated(jnp.zeros((capacity, n_envs) + shape, dtype))
+        # Materialize the (possibly hundreds-of-MB) empty ring ON the device:
+        # a host jnp.zeros + device_put would push the whole thing over the
+        # wire, which on a tunneled chip costs minutes for a pixel ring.
+        alloc = jax.jit(
+            lambda: {
+                k: jnp.zeros((capacity, n_envs) + shape, dtype)
+                for k, (shape, dtype) in ring_keys.items()
+            },
+            out_shardings={k: fabric.replicated for k in ring_keys},
+        )
+        rb_dev = alloc()
     else:
         for k, (shape, dtype) in ring_keys.items():
             host = np.zeros((capacity, n_envs) + shape, np.dtype(dtype))
@@ -135,6 +156,7 @@ class BurstRunner:
         snapshot: Optional[HostSnapshot] = None,
         snapshot_every: int = 4,
         params_of: Callable[[Any], Any] = lambda carry: carry[0],
+        stage_buckets: Optional[Tuple[int, ...]] = None,
     ) -> None:
         self._burst_fn = burst_fn
         self._params_of = params_of
@@ -146,6 +168,14 @@ class BurstRunner:
         self._seq_len = int(seq_len)
         self._snapshot = snapshot
         self._snapshot_every = max(1, int(snapshot_every))
+        # Upload sizes: each flush pads the staged rows to the smallest
+        # bucket that fits (one jit trace per bucket). Without buckets every
+        # flush ships the full ``stage_max`` staging array — for a pixel ring
+        # over a thin link that is ~4x the bytes actually staged.
+        buckets = sorted(set(int(b) for b in (stage_buckets or ()) if 0 < int(b) <= self._stage_max))
+        if not buckets or buckets[-1] < self._stage_max:
+            buckets.append(self._stage_max)
+        self._stage_buckets = buckets
 
         self.dev_pos = np.zeros(self._n_envs, np.int64)
         self.dev_valid = np.zeros(self._n_envs, np.int64)
@@ -219,13 +249,15 @@ class BurstRunner:
         burst job. Returns the number of grants consumed (0 while any env is
         still shorter than a sample window)."""
         self.raise_if_failed()
+        n_rows = len(self._staged)
+        size = next(b for b in self._stage_buckets if b >= n_rows)
         arrs = {}
         for k, (shape, dtype) in self._ring_keys.items():
-            arr = np.zeros((self._stage_max, self._n_envs) + shape, dtype)
+            arr = np.zeros((size, self._n_envs) + shape, dtype)
             for i, (data, _m) in enumerate(self._staged):
                 arr[i] = data[k]
             arrs[k] = arr
-        mask = np.zeros((self._stage_max, self._n_envs), np.int32)
+        mask = np.zeros((size, self._n_envs), np.int32)
         for i, (_d, m) in enumerate(self._staged):
             mask[i] = m
         self._staged.clear()

@@ -131,6 +131,19 @@ def save_configs(cfg: Mapping[str, Any], log_dir: str) -> None:
     save_config(cfg, os.path.join(log_dir, "config.yaml"))
 
 
+def conv_heavy_compile_options(mesh) -> Optional[Dict[str, Any]]:
+    """Low-effort XLA compile options for train graphs dominated by
+    odd-spatial-dim VALID-conv gradients (Dreamer-V1/V2's faithful 64→31→14
+    conv stacks). On the TPU backend these kernels hit a pathological
+    compile path — the effort knobs cut compilation ~5x (measured
+    188 s → 34 s for the V1 encoder gradient alone) at negligible runtime
+    cost for models this size. CPU compilation is unaffected, so the knobs
+    are only applied off-CPU."""
+    if mesh.devices.flat[0].platform == "cpu":
+        return None
+    return {"exec_time_optimization_effort": -1.0, "memory_fitting_effort": -1.0}
+
+
 def resolve_hybrid_player(hp_cfg: Optional[Mapping[str, Any]], mesh) -> bool:
     """Resolve ``algo.hybrid_player.enabled``: ``"auto"`` turns the host-side
     policy overlap on iff the trainer mesh lives off the host CPU (shared by

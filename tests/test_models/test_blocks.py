@@ -104,3 +104,40 @@ def test_get_activation_torch_compat():
     assert get_activation("torch.nn.SiLU") is get_activation("silu")
     with pytest.raises(ValueError):
         get_activation("nosuch")
+
+
+def test_dreamer_v2_cnn_encoder_pad_trick_matches_plain_valid_conv():
+    """The exact-VALID end-pad trick in the V2/V1 encoder must be a no-op on
+    values for every input geometry, including non-square frames (crafter/
+    diambra accept tuple screen sizes)."""
+    import flax.linen as nn
+
+    from sheeprl_tpu.algos.dreamer_v2.agent import CNNEncoder
+
+    class PlainStack(nn.Module):
+        channels_multiplier: int = 4
+
+        @nn.compact
+        def __call__(self, x):
+            for i, mult in enumerate((1, 2, 4, 8)):
+                x = nn.Conv(
+                    mult * self.channels_multiplier,
+                    kernel_size=(4, 4),
+                    strides=(2, 2),
+                    padding="VALID",
+                    use_bias=True,
+                    name=f"conv_{i}",
+                )(x)
+                x = nn.elu(x)
+            return x.reshape(x.shape[0], -1)
+
+    for h, w in ((64, 64), (96, 64)):
+        x = jnp.asarray(np.random.RandomState(h + w).rand(2, h, w, 3), jnp.float32)
+        enc = CNNEncoder(keys=["rgb"], channels_multiplier=4, layer_norm=False, activation="elu")
+        ref = PlainStack()
+        p_ref = ref.init(jax.random.PRNGKey(0), x)
+        out_ref = ref.apply(p_ref, x)
+        # Graft the plain stack's kernels into the encoder so outputs are comparable.
+        graft = {"params": {k: dict(p_ref["params"][k]) for k in p_ref["params"]}}
+        out_enc = enc.apply(graft, {"rgb": x})
+        np.testing.assert_array_equal(np.asarray(out_enc), np.asarray(out_ref))

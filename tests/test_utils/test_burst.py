@@ -1,0 +1,213 @@
+"""Unit tests for the shared hybrid-player burst machinery
+(``utils/burst.py``): packed host snapshots, ring init/mirror, and the
+BurstRunner staging/dispatch semantics — with a fake burst_fn so the queue
+and thread lifecycle are exercised without compiling a train step.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.utils.burst import BurstRunner, HostSnapshot, dreamer_ring_keys, init_device_ring
+
+
+class _FakeFabric:
+    replicated = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    def put_replicated(self, tree):
+        return jax.tree.map(jnp.asarray, tree)
+
+
+class TestHostSnapshot:
+    def test_pull_round_trips_subset(self):
+        params = {"world_model": {"encoder": jnp.arange(8.0), "decoder": jnp.ones(4)}, "actor": jnp.ones(3) * 2}
+        subset = lambda p: {"enc": p["world_model"]["encoder"], "actor": p["actor"]}
+        snap = HostSnapshot(subset, params)
+        host = snap.pull(params)
+        np.testing.assert_allclose(np.asarray(host["enc"]), np.arange(8.0), rtol=1e-2)
+        np.testing.assert_allclose(np.asarray(host["actor"]), 2.0, rtol=1e-2)
+
+    def test_refresh_then_poll_returns_once(self):
+        params = {"w": jnp.ones(4)}
+        snap = HostSnapshot(lambda p: p, params)
+        assert snap.poll() is None
+        snap.refresh({"w": jnp.full((4,), 3.0)})
+        polled = snap.poll()
+        np.testing.assert_allclose(np.asarray(polled["w"]), 3.0, rtol=1e-2)
+        assert snap.poll() is None  # consumed
+
+
+class TestInitDeviceRing:
+    KEYS = {"obs": ((2,), jnp.float32), "rewards": ((1,), jnp.float32)}
+
+    def test_fresh_ring_is_zeroed(self):
+        rb_dev, pos, valid = init_device_ring(_FakeFabric(), self.KEYS, capacity=5, n_envs=3)
+        assert rb_dev["obs"].shape == (5, 3, 2)
+        assert float(rb_dev["obs"].sum()) == 0.0
+        assert pos.tolist() == [0, 0, 0] and valid.tolist() == [0, 0, 0]
+
+    def test_mirror_restores_contents_and_heads(self):
+        rb = EnvIndependentReplayBuffer(4, n_envs=2, obs_keys=("obs",), buffer_cls=SequentialReplayBuffer)
+        data = {
+            "obs": np.arange(12, dtype=np.float32).reshape(3, 2, 2),
+            "rewards": np.ones((3, 2, 1), np.float32),
+        }
+        rb.add(data)
+        rb_dev, pos, valid = init_device_ring(_FakeFabric(), self.KEYS, capacity=4, n_envs=2, rb=rb)
+        np.testing.assert_array_equal(np.asarray(rb_dev["obs"])[:3, 0], data["obs"][:, 0])
+        np.testing.assert_array_equal(np.asarray(rb_dev["obs"])[:3, 1], data["obs"][:, 1])
+        assert pos.tolist() == [3, 3]
+        assert valid.tolist() == [3, 3]
+
+
+def test_dreamer_ring_keys_layout():
+    import gymnasium as gym
+
+    space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8),
+            "state": gym.spaces.Box(-1, 1, (7,), np.float32),
+        }
+    )
+    keys = dreamer_ring_keys(space, ["rgb"], ["state"], (2, 3), with_is_first=False)
+    assert keys["rgb"] == ((64, 64, 3), jnp.uint8)
+    assert keys["state"] == ((7,), jnp.float32)
+    assert keys["actions"] == ((5,), jnp.float32)
+    assert "is_first" not in keys
+    assert "is_first" in dreamer_ring_keys(space, ["rgb"], [], (2,), with_is_first=True)
+
+
+class _RecordingBurstFn:
+    """Fake burst_fn: counts granted steps, appends rows into a numpy mirror."""
+
+    def __init__(self):
+        self.calls = []
+        self.fail = False
+
+    def __call__(self, carry, rb, staged, mask, pos, valid_n, key, validmask):
+        if self.fail:
+            raise RuntimeError("burst boom")
+        granted = float(np.asarray(validmask).sum())
+        self.calls.append(
+            {
+                "granted": granted,
+                "rows": int(np.asarray(mask).sum()),
+                "upload_rows": int(np.asarray(mask).shape[0]),
+                "staged_shape": {k: staged[k].shape for k in staged},
+            }
+        )
+        return carry + granted, rb, (jnp.float32(granted),)
+
+
+def _runner(burst_fn, n_envs=2, capacity=8, grad_chunk=2, stage_max=6, seq_len=2):
+    keys = {"obs": ((1,), jnp.float32)}
+    rb_dev = {"obs": jnp.zeros((capacity, n_envs, 1), jnp.float32)}
+    return BurstRunner(
+        burst_fn, jnp.float32(0.0), rb_dev, keys,
+        n_envs=n_envs, capacity=capacity, grad_chunk=grad_chunk,
+        stage_max=stage_max, seq_len=seq_len, params_of=lambda c: c,
+    )
+
+
+def _wait(pred, timeout=5.0):
+    t0 = time.time()
+    while not pred():
+        if time.time() - t0 > timeout:
+            raise AssertionError("timed out waiting for burst worker")
+        time.sleep(0.01)
+
+
+class TestBurstRunner:
+    def test_flush_holds_grants_until_windows_exist(self):
+        fn = _RecordingBurstFn()
+        r = _runner(fn, seq_len=4)
+        r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+        # 1 row < seq_len 4 -> append-only burst, no grants consumed
+        assert r.flush(jax.random.PRNGKey(0), grant_backlog=5) == 0
+        _wait(lambda: len(fn.calls) == 1)
+        assert fn.calls[0]["granted"] == 0.0
+        for _ in range(4):
+            r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+        assert r.flush(jax.random.PRNGKey(1), grant_backlog=5) == 2  # capped at grad_chunk
+        _wait(lambda: len(fn.calls) == 2)
+        assert fn.calls[1]["granted"] == 2.0
+        assert r.close() is not None
+
+    def test_ring_heads_advance_with_ragged_resets(self):
+        fn = _RecordingBurstFn()
+        r = _runner(fn)
+        r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+        r.stage_reset({"obs": np.ones((1, 1, 1), np.float32)}, [1])  # env 1 only
+        r.flush(jax.random.PRNGKey(0), grant_backlog=0)
+        assert r.dev_pos.tolist() == [1, 2]
+        assert r.dev_valid.tolist() == [1, 2]
+        assert r.staged_count == 0
+        r.close()
+
+    def test_patch_last_edits_most_recent_row(self):
+        fn = _RecordingBurstFn()
+        r = _runner(fn)
+        r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+        r.patch_last(0, {"obs": 9.0})
+        row, _mask = r._staged[-1]
+        assert row["obs"][0, 0] == 9.0 and row["obs"][1, 0] == 1.0
+        r.close()
+
+    def test_worker_error_surfaces_on_next_flush(self):
+        fn = _RecordingBurstFn()
+        fn.fail = True
+        r = _runner(fn)
+        r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+        r.flush(jax.random.PRNGKey(0), grant_backlog=0)
+        _wait(lambda: r._state["error"] is not None)
+        with pytest.raises(RuntimeError, match="burst boom"):
+            r.flush(jax.random.PRNGKey(1), grant_backlog=0)
+
+    def test_stage_buckets_size_each_upload(self):
+        fn = _RecordingBurstFn()
+        keys = {"obs": ((1,), jnp.float32)}
+        rb_dev = {"obs": jnp.zeros((16, 2, 1), jnp.float32)}
+        r = BurstRunner(
+            fn, jnp.float32(0.0), rb_dev, keys,
+            n_envs=2, capacity=16, grad_chunk=2, stage_max=12, seq_len=1,
+            params_of=lambda c: c, stage_buckets=(3, 6),
+        )
+        # 2 staged rows -> smallest bucket (3); 5 rows -> next bucket (6);
+        # 8 rows -> the implicit stage_max fallback bucket (12). Data beyond
+        # the staged rows must be zero padding, never stale rows.
+        for i, n_rows in enumerate((2, 5, 8)):
+            for _ in range(n_rows):
+                r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+            r.flush(jax.random.PRNGKey(n_rows), grant_backlog=0)
+            _wait(lambda: len(fn.calls) == i + 1)
+        sizes = [(c["rows"] // 2, c["upload_rows"]) for c in fn.calls]
+        assert sizes == [(2, 3), (5, 6), (8, 12)]
+        assert all(c["staged_shape"]["obs"] == (c["upload_rows"], 2, 1) for c in fn.calls)
+        r.close()
+
+    def test_bucket_normalization_caps_and_sorts(self):
+        fn = _RecordingBurstFn()
+        keys = {"obs": ((1,), jnp.float32)}
+        rb_dev = {"obs": jnp.zeros((16, 1, 1), jnp.float32)}
+        r = BurstRunner(
+            fn, jnp.float32(0.0), rb_dev, keys,
+            n_envs=1, capacity=16, grad_chunk=1, stage_max=5, seq_len=1,
+            params_of=lambda c: c, stage_buckets=(9, 3, 0, 3),  # >cap, dup, junk
+        )
+        assert r._stage_buckets == [3, 5]
+        r.close()
+
+    def test_carry_readable_while_running(self):
+        fn = _RecordingBurstFn()
+        r = _runner(fn, seq_len=1)
+        r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
+        r.flush(jax.random.PRNGKey(0), grant_backlog=2)
+        _wait(lambda: len(fn.calls) == 1)
+        assert float(np.asarray(r.carry)) == 2.0  # fake carry counts granted steps
+        r.close()
