@@ -37,6 +37,8 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     xavier_normal_init,
 )
 from sheeprl_tpu.distributions import Independent, Normal
+from sheeprl_tpu.utils.utils import player_reset_fn as _player_reset_fn
+from sheeprl_tpu.utils.utils import player_zeros as _player_zeros
 from sheeprl_tpu.models import MLP
 
 __all__ = [
@@ -173,6 +175,7 @@ class PlayerDV1:
         recurrent_state_size: int,
         expl_amount: float = 0.0,
         actor_type: Optional[str] = None,
+        host_device=None,
     ):
         self.world_model = world_model
         self.actor = actor
@@ -182,6 +185,7 @@ class PlayerDV1:
         self.recurrent_state_size = recurrent_state_size
         self.expl_amount = expl_amount
         self.actor_type = actor_type
+        self.host_device = host_device
         self.is_continuous = actor.is_continuous
         self.actions = None
         self.recurrent_state = None
@@ -215,17 +219,23 @@ class PlayerDV1:
             return acts, jnp.concatenate(acts, axis=-1), rec, stoch
 
         self._step_fn = jax.jit(_step, static_argnums=(6, 7))
+        self._reset_fn = _player_reset_fn()
 
     def init_states(self, params=None, reset_envs: Optional[Sequence[int]] = None) -> None:
+        # Full resets must produce arrays with EXACTLY the placement/type of
+        # _step_fn's outputs. As a host-CPU policy (``host_device`` set), an
+        # ambient-mesh `jnp.zeros` would be `{Auto: ('dp',)}`-typed while the
+        # step outputs are plain committed-CPU — flipping between the two
+        # retraces (and host-recompiles) the policy jit at EVERY episode end.
         if reset_envs is None or len(reset_envs) == 0:
-            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32)
-            self.recurrent_state = jnp.zeros((self.num_envs, self.recurrent_state_size), dtype=jnp.float32)
-            self.stochastic_state = jnp.zeros((self.num_envs, self.stochastic_size), dtype=jnp.float32)
+            self.actions = _player_zeros((self.num_envs, int(np.sum(self.actions_dim))), self.host_device)
+            self.recurrent_state = _player_zeros((self.num_envs, self.recurrent_state_size), self.host_device)
+            self.stochastic_state = _player_zeros((self.num_envs, self.stochastic_size), self.host_device)
         else:
-            idx = jnp.asarray(list(reset_envs))
-            self.actions = self.actions.at[idx].set(0.0)
-            self.recurrent_state = self.recurrent_state.at[idx].set(0.0)
-            self.stochastic_state = self.stochastic_state.at[idx].set(0.0)
+            idx = np.asarray(list(reset_envs))
+            self.actions, self.recurrent_state, self.stochastic_state = self._reset_fn(
+                self.actions, self.recurrent_state, self.stochastic_state, idx
+            )
 
     def get_actions(self, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
         acts, self.actions, self.recurrent_state, self.stochastic_state = self._step_fn(

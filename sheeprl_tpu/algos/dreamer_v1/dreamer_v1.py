@@ -443,6 +443,7 @@ def main(fabric, cfg: Dict[str, Any]):
             int(cfg.algo.world_model.recurrent_model.recurrent_state_size),
             expl_amount=player.expl_amount,
             actor_type=player.actor_type,
+            host_device=snapshot.host_device,
         )
         host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
         runner = BurstRunner(

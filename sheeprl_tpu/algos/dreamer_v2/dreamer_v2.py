@@ -519,6 +519,7 @@ def main(fabric, cfg: Dict[str, Any]):
             discrete_size=int(cfg.algo.world_model.discrete_size),
             expl_amount=player.expl_amount,
             actor_type=player.actor_type,
+            host_device=snapshot.host_device,
         )
         host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
         runner = BurstRunner(

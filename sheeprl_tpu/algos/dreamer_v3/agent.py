@@ -33,6 +33,8 @@ from sheeprl_tpu.distributions import (
     Normal,
     OneHotCategoricalStraightThrough,
 )
+from sheeprl_tpu.utils.utils import player_reset_fn as _player_reset_fn
+from sheeprl_tpu.utils.utils import player_zeros as _player_zeros
 from sheeprl_tpu.models import MLP, LayerNormGRUCell
 from sheeprl_tpu.models.blocks import _ConvTranspose
 from sheeprl_tpu.ops import symlog
@@ -580,6 +582,7 @@ class PlayerDV3:
         recurrent_state_size: int,
         discrete_size: int = 32,
         actor_type: Optional[str] = None,
+        host_device=None,
     ):
         self.world_model = world_model
         self.actor = actor
@@ -589,6 +592,7 @@ class PlayerDV3:
         self.recurrent_state_size = recurrent_state_size
         self.discrete_size = discrete_size
         self.actor_type = actor_type
+        self.host_device = host_device
         self.is_continuous = actor.is_continuous
         self.actions = None
         self.recurrent_state = None
@@ -621,17 +625,22 @@ class PlayerDV3:
 
         self._init_fn = jax.jit(_init, static_argnums=(1,))
         self._step_fn = jax.jit(_step, static_argnums=(6,))
+        self._reset_fn = _player_reset_fn(with_values=True)
 
     def init_states(self, params, reset_envs: Optional[Sequence[int]] = None) -> None:
+        # The zero action rows must match _step_fn's output placement/type —
+        # an ambient-mesh jnp.zeros is mesh-typed and would retrace the
+        # (host) policy jit at every episode end (see utils.player_zeros).
+        # _init_fn outputs already follow the committed params device.
         if reset_envs is None or len(reset_envs) == 0:
-            self.actions = jnp.zeros((self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32)
+            self.actions = _player_zeros((self.num_envs, int(np.sum(self.actions_dim))), self.host_device)
             self.recurrent_state, self.stochastic_state = self._init_fn(params, self.num_envs)
         else:
-            idx = jnp.asarray(list(reset_envs))
+            idx = np.asarray(list(reset_envs))
             rec, post = self._init_fn(params, len(reset_envs))
-            self.actions = self.actions.at[idx].set(0.0)
-            self.recurrent_state = self.recurrent_state.at[idx].set(rec)
-            self.stochastic_state = self.stochastic_state.at[idx].set(post)
+            self.actions, self.recurrent_state, self.stochastic_state = self._reset_fn(
+                self.actions, self.recurrent_state, self.stochastic_state, idx, rec, post
+            )
 
     def get_actions(self, params, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
         acts, self.actions, self.recurrent_state, self.stochastic_state = self._step_fn(

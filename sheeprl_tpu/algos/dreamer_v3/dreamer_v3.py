@@ -590,6 +590,7 @@ def main(fabric, cfg: Dict[str, Any]):
             int(wm_cfg_.stochastic_size),
             int(wm_cfg_.recurrent_model.recurrent_state_size),
             discrete_size=int(wm_cfg_.discrete_size),
+            host_device=snapshot.host_device,
         )
         host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
 

@@ -131,6 +131,41 @@ def save_configs(cfg: Mapping[str, Any], log_dir: str) -> None:
     save_config(cfg, os.path.join(log_dir, "config.yaml"))
 
 
+def player_zeros(shape, host_device=None):
+    """Zero state for a stateful env-side player.
+
+    ``host_device`` set (hybrid/burst host-CPU policy): a committed host
+    array, so the policy jit always sees plain committed-CPU avals — an
+    ambient-mesh ``jnp.zeros`` would be mesh-typed and flip the jit's cache
+    key between resets and steps, retracing (and host-recompiling) the
+    policy at every episode end. ``None``: the trainer-mesh default.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if host_device is not None:
+        return jax.device_put(np.zeros(shape, np.float32), host_device)
+    return jnp.zeros(shape, jnp.float32)
+
+
+def player_reset_fn(with_values: bool = False):
+    """Jitted partial-reset for a stateful player's ``(actions, recurrent,
+    stochastic)`` state. An eager ``.at[idx].set`` triggers a fresh XLA:CPU
+    compile per call on AOT-mismatched hosts (~250 ms measured) — per episode
+    end, that dominates the env loop; one jitted call hits the jit cache.
+
+    ``with_values`` selects the Dreamer-V3 form where the reset rows take the
+    learned initial state instead of zeros.
+    """
+    import jax
+
+    if with_values:
+        return jax.jit(
+            lambda a, r, st, i, rec, post: (a.at[i].set(0.0), r.at[i].set(rec), st.at[i].set(post))
+        )
+    return jax.jit(lambda a, r, st, i: (a.at[i].set(0.0), r.at[i].set(0.0), st.at[i].set(0.0)))
+
+
 def conv_heavy_compile_options(mesh) -> Optional[Dict[str, Any]]:
     """Low-effort XLA compile options for train graphs dominated by
     odd-spatial-dim VALID-conv gradients (Dreamer-V1/V2's faithful 64→31→14
