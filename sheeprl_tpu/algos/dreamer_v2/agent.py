@@ -643,9 +643,13 @@ class PlayerDV2:
         return acts
 
 
+@jax.jit
 def xavier_normal_init(params: Any, key: jax.Array) -> Any:
     """Re-initialize every Dense/Conv kernel with Xavier normal and zero every
-    bias (reference ``utils.init_weights`` mode="normal")."""
+    bias (reference ``utils.init_weights`` mode="normal").
+
+    Jitted: one program per parameter structure — the per-leaf eager path
+    compiles a fresh tiny XLA program per leaf per process."""
     leaves = jax.tree_util.tree_leaves_with_path(params)
     keys = jax.random.split(key, len(leaves))
 
