@@ -22,7 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sheeprl_tpu.algos.dreamer_v2.agent import actor_dists, actor_sample
+from sheeprl_tpu.algos.dreamer_v2.agent import PlayerDV2, actor_dists, actor_sample
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, ensembles_apply
 from sheeprl_tpu.algos.p2e_dv2.utils import compute_lambda_values, prepare_obs, test
@@ -33,12 +33,12 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, conv_heavy_compile_options, resolve_hybrid_player, save_configs
 
 __all__ = ["main", "make_train_step"]
 
 
-def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_dim, is_continuous, txs):
+def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_dim, is_continuous, txs, ring=None):
     rssm = world_model.rssm
     wm_cfg = cfg.algo.world_model
     cnn_enc = list(cfg.algo.cnn_keys.encoder)
@@ -315,6 +315,13 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
         ).entropy().mean()
         return (params, opts, cum + 1), metrics
 
+    if ring is not None:
+        from sheeprl_tpu.data.ring import build_burst_train_step
+
+        return build_burst_train_step(
+            gradient_step, mesh, ring, compiler_options=conv_heavy_compile_options(mesh)
+        )
+
     def local_train(params, opts, data, key, cum0):
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
         n_steps = jax.tree.leaves(data)[0].shape[0]
@@ -330,7 +337,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shard_train, donate_argnums=(0, 1))
+    return jax.jit(shard_train, donate_argnums=(0, 1), compiler_options=conv_heavy_compile_options(mesh))
 
 
 @register_algorithm()
@@ -488,16 +495,120 @@ def main(fabric, cfg: Dict[str, Any]):
         raise ValueError(
             f"per_rank_batch_size ({batch_size}) must be divisible by the number of devices ({fabric.world_size})"
         )
-    train_fn = make_train_step(
-        world_model, ens_module, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs
-    )
-    data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
-
     rng = jax.random.PRNGKey(cfg.seed)
     cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
 
     def player_params():
         return {"world_model": params["world_model"], "actor": params["actor_exploration"]}
+
+    # TPU-native overlap, shared with the Dreamer mains (`algo.hybrid_player`).
+    hp_cfg = cfg.algo.get("hybrid_player") or {}
+    burst_mode = resolve_hybrid_player(hp_cfg, fabric.mesh)
+    if burst_mode and buffer_type != "sequential":
+        warnings.warn("hybrid_player burst mode requires buffer.type=sequential; falling back to host sampling")
+        burst_mode = False
+    train_every = max(1, int(hp_cfg.get("train_every", 16)))
+    snapshot_every = max(1, int(hp_cfg.get("snapshot_every", 4)))
+    host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
+
+    if burst_mode:
+        from sheeprl_tpu.utils.burst import (
+            BurstRunner,
+            HostSnapshot,
+            dreamer_ring_keys,
+            dreamer_stage_sizes,
+            init_device_ring,
+        )
+
+        grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
+        stage_max, stage_buckets = dreamer_stage_sizes(train_every, int(cfg.env.num_envs), buffer_size)
+        ring_keys = dreamer_ring_keys(
+            observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=True
+        )
+        ring_spec = {
+            "capacity": buffer_size,
+            "n_envs": int(cfg.env.num_envs),
+            "grad_chunk": grad_chunk,
+            "seq_len": seq_len,
+            "batch_size": batch_size,
+        }
+        burst_fn = make_train_step(
+            world_model, ens_module, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs,
+            ring=ring_spec,
+        )
+        rb_dev, dev_pos, dev_valid = init_device_ring(
+            fabric, ring_keys, buffer_size, int(cfg.env.num_envs),
+            rb=rb if (state is not None and cfg.buffer.checkpoint) else None,
+        )
+        grant_backlog = 0
+        wm_cfg_ = cfg.algo.world_model
+
+        def _player_subset(p):
+            wm = p["world_model"]
+            return {
+                "world_model": {
+                    "encoder": wm["encoder"],
+                    "recurrent_model": wm["recurrent_model"],
+                    "representation_model": wm["representation_model"],
+                },
+                "actor": p["actor_exploration"],
+            }
+
+        snapshot = HostSnapshot(_player_subset, params)
+        host_params = snapshot.pull(params)
+        host_player = PlayerDV2(
+            world_model,
+            actor,
+            actions_dim,
+            cfg.env.num_envs,
+            int(wm_cfg_.stochastic_size),
+            int(wm_cfg_.recurrent_model.recurrent_state_size),
+            discrete_size=int(wm_cfg_.discrete_size),
+            expl_amount=player.expl_amount,
+            actor_type=player.actor_type,
+            host_device=snapshot.host_device,
+        )
+        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
+        runner = BurstRunner(
+            burst_fn,
+            (params, opts, jnp.int32(0)),
+            rb_dev,
+            ring_keys,
+            n_envs=int(cfg.env.num_envs),
+            capacity=buffer_size,
+            grad_chunk=grad_chunk,
+            stage_max=stage_max,
+            seq_len=seq_len,
+            snapshot=snapshot,
+            snapshot_every=snapshot_every,
+            params_of=lambda c: c[0],
+            stage_buckets=stage_buckets,
+        )
+        runner.set_ring_state(dev_pos, dev_valid)
+
+        def _flush_burst():
+            nonlocal rng, grant_backlog, cumulative_per_rank_gradient_steps, train_step
+            with timer("Time/train_time", SumMetric):
+                rng, train_key = jax.random.split(rng)
+                chunk = runner.flush(train_key, grant_backlog)
+                latest = runner.metrics
+                if aggregator and not aggregator.disabled and latest is not None:
+                    for name, value in latest.items():
+                        if name in aggregator:
+                            aggregator.update(name, value)
+                    if "Params/exploration_amount" in aggregator:
+                        aggregator.update("Params/exploration_amount", host_player.expl_amount)
+            grant_backlog -= chunk
+            if chunk > 0:
+                cumulative_per_rank_gradient_steps += chunk
+                train_step += 1
+            return chunk
+    else:
+        train_fn = make_train_step(
+            world_model, ens_module, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs
+        )
+    data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
 
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -511,12 +622,22 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))), dtype=np.float32)
     step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), dtype=np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    rb.add(step_data, validate_args=cfg.buffer.validate_args)
-    player.init_states(player_params())
+    if host_mirror:
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    if burst_mode:
+        runner.stage_step(step_data)
+        host_player.init_states(host_params)
+    else:
+        player.init_states(player_params())
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+
+        if burst_mode:
+            fresh = snapshot.poll()
+            if fresh is not None:
+                host_params = fresh
 
         with timer("Time/env_interaction_time", SumMetric):
             if iter_num <= learning_starts and state is None:
@@ -529,8 +650,12 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                rng, subkey = jax.random.split(rng)
-                action_list = player.get_actions(player_params(), jobs, subkey)
+                if burst_mode:
+                    host_rng, subkey = jax.random.split(host_rng)
+                    action_list = host_player.get_actions(host_params, jobs, subkey)
+                else:
+                    rng, subkey = jax.random.split(rng)
+                    action_list = player.get_actions(player_params(), jobs, subkey)
                 actions = np.asarray(jnp.concatenate(action_list, axis=-1))
                 if is_continuous:
                     real_actions = actions
@@ -578,7 +703,10 @@ def main(fabric, cfg: Dict[str, Any]):
         step_data["rewards"] = clip_rewards_fn(
             np.asarray(rewards, dtype=np.float32).reshape(1, cfg.env.num_envs, -1)
         )
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if host_mirror:
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if burst_mode:
+            runner.stage_step(step_data)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
@@ -591,13 +719,26 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))), dtype=np.float32)
             reset_data["rewards"] = np.zeros((1, reset_envs, 1), dtype=np.float32)
             reset_data["is_first"] = np.ones_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if host_mirror:
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if burst_mode:
+                runner.stage_reset(reset_data, dones_idxes)
             for d in dones_idxes:
                 step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
                 step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
-            player.init_states(player_params(), dones_idxes)
+            if burst_mode:
+                host_player.init_states(host_params, dones_idxes)
+            else:
+                player.init_states(player_params(), dones_idxes)
 
-        if iter_num >= learning_starts:
+        if burst_mode:
+            if iter_num >= learning_starts:
+                grant_backlog += ratio(policy_step - prefill_steps * policy_steps_per_iter)
+            while grant_backlog >= grad_chunk or runner.staging_full():
+                consumed = _flush_burst()
+                if consumed == 0 or grant_backlog < grad_chunk:
+                    break
+        elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps * policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
                 sample = rb.sample(
@@ -649,6 +790,8 @@ def main(fabric, cfg: Dict[str, Any]):
             iter_num == total_iters and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
+            if burst_mode:
+                params, opts, _ = runner.carry
             ckpt_state = {
                 "world_model": params["world_model"],
                 "ensembles": params["ensembles"],
@@ -672,6 +815,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+
+    if burst_mode:
+        while runner.staged_count or grant_backlog:
+            if _flush_burst() == 0 and not runner.staged_count:
+                break
+        params, opts, _ = runner.close()
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

@@ -33,7 +33,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sheeprl_tpu.algos.dreamer_v3.agent import actor_dists, actor_sample
+from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, actor_dists, actor_sample
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, ensembles_apply
 from sheeprl_tpu.algos.p2e_dv3.utils import (
@@ -58,7 +58,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
 
 __all__ = ["main", "make_train_step"]
 
@@ -74,6 +74,7 @@ def make_train_step(
     actions_dim: Sequence[int],
     is_continuous: bool,
     txs: Dict[str, Any],
+    ring=None,
 ):
     """Build the fully-jitted G-step P2E-DV3 update (see module docstring)."""
     rssm = world_model.rssm
@@ -409,6 +410,11 @@ def make_train_step(
         ).entropy().mean()
         return (params, opts, moments_state, cum + 1), metrics
 
+    if ring is not None:
+        from sheeprl_tpu.data.ring import build_burst_train_step
+
+        return build_burst_train_step(gradient_step, mesh, ring)
+
     def local_train(params, opts, moments_state, data, key, cum0):
         key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
         n_steps = jax.tree.leaves(data)[0].shape[0]
@@ -589,16 +595,121 @@ def main(fabric, cfg: Dict[str, Any]):
         raise ValueError(
             f"per_rank_batch_size ({batch_size}) must be divisible by the number of devices ({fabric.world_size})"
         )
-    train_fn = make_train_step(
-        world_model, ens_module, actor, critic, critics_spec, cfg, fabric.mesh, actions_dim, is_continuous, txs
-    )
-    data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
-
     rng = jax.random.PRNGKey(cfg.seed)
     cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
 
     def player_params():
         return {"world_model": params["world_model"], "actor": params["actor_exploration"]}
+
+    # TPU-native overlap, shared with the Dreamer mains (`algo.hybrid_player`):
+    # host-CPU exploration policy from a packed bf16 snapshot, device-resident
+    # uint8 sequence ring, Ratio grants dispatched in bursts on a trainer
+    # thread (see dreamer_v3.py for the design rationale).
+    hp_cfg = cfg.algo.get("hybrid_player") or {}
+    burst_mode = resolve_hybrid_player(hp_cfg, fabric.mesh)
+    train_every = max(1, int(hp_cfg.get("train_every", 16)))
+    snapshot_every = max(1, int(hp_cfg.get("snapshot_every", 4)))
+    host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
+
+    if burst_mode:
+        from sheeprl_tpu.utils.burst import (
+            BurstRunner,
+            HostSnapshot,
+            dreamer_ring_keys,
+            dreamer_stage_sizes,
+            init_device_ring,
+        )
+
+        grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
+        stage_max, stage_buckets = dreamer_stage_sizes(train_every, int(cfg.env.num_envs), buffer_size)
+        ring_keys = dreamer_ring_keys(
+            observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=True
+        )
+        ring_spec = {
+            "capacity": buffer_size,
+            "n_envs": int(cfg.env.num_envs),
+            "grad_chunk": grad_chunk,
+            "seq_len": seq_len,
+            "batch_size": batch_size,
+        }
+        burst_fn = make_train_step(
+            world_model, ens_module, actor, critic, critics_spec, cfg, fabric.mesh,
+            actions_dim, is_continuous, txs, ring=ring_spec,
+        )
+        rb_dev, dev_pos, dev_valid = init_device_ring(
+            fabric, ring_keys, buffer_size, int(cfg.env.num_envs),
+            rb=rb if (state is not None and cfg.buffer.checkpoint) else None,
+        )
+        grant_backlog = 0
+
+        wm_cfg_ = cfg.algo.world_model
+
+        def _player_subset(p):
+            wm = p["world_model"]
+            return {
+                "world_model": {
+                    "encoder": wm["encoder"],
+                    "recurrent_model": wm["recurrent_model"],
+                    "representation_model": wm["representation_model"],
+                    "transition_model": wm["transition_model"],
+                    "initial_recurrent_state": wm["initial_recurrent_state"],
+                },
+                "actor": p["actor_exploration"],
+            }
+
+        snapshot = HostSnapshot(_player_subset, params)
+        host_params = snapshot.pull(params)
+        host_player = PlayerDV3(
+            world_model,
+            actor,
+            actions_dim,
+            cfg.env.num_envs,
+            int(wm_cfg_.stochastic_size),
+            int(wm_cfg_.recurrent_model.recurrent_state_size),
+            discrete_size=int(wm_cfg_.discrete_size),
+            actor_type="exploration",
+            host_device=snapshot.host_device,
+        )
+        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
+
+        runner = BurstRunner(
+            burst_fn,
+            (params, opts, moments_state, jnp.int32(0)),
+            rb_dev,
+            ring_keys,
+            n_envs=int(cfg.env.num_envs),
+            capacity=buffer_size,
+            grad_chunk=grad_chunk,
+            stage_max=stage_max,
+            seq_len=seq_len,
+            snapshot=snapshot,
+            snapshot_every=snapshot_every,
+            params_of=lambda c: c[0],
+            stage_buckets=stage_buckets,
+        )
+        runner.set_ring_state(dev_pos, dev_valid)
+
+        def _flush_burst():
+            nonlocal rng, grant_backlog, cumulative_per_rank_gradient_steps, train_step
+            with timer("Time/train_time", SumMetric):
+                rng, train_key = jax.random.split(rng)
+                chunk = runner.flush(train_key, grant_backlog)
+                latest = runner.metrics
+                if aggregator and not aggregator.disabled and latest is not None:
+                    for name, value in latest.items():
+                        if name in aggregator:
+                            aggregator.update(name, value)
+            grant_backlog -= chunk
+            if chunk > 0:
+                cumulative_per_rank_gradient_steps += chunk
+                train_step += 1
+            return chunk
+    else:
+        train_fn = make_train_step(
+            world_model, ens_module, actor, critic, critics_spec, cfg, fabric.mesh, actions_dim, is_continuous, txs
+        )
+    data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
 
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -608,11 +719,19 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), dtype=np.float32)
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), dtype=np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player.init_states(player_params())
+    if burst_mode:
+        host_player.init_states(host_params)
+    else:
+        player.init_states(player_params())
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+
+        if burst_mode:
+            fresh = snapshot.poll()
+            if fresh is not None:
+                host_params = fresh
 
         with timer("Time/env_interaction_time", SumMetric):
             if iter_num <= learning_starts and state is None:
@@ -625,8 +744,13 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
             else:
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                rng, subkey = jax.random.split(rng)
-                action_list = player.get_actions(player_params(), jobs, subkey)
+                if burst_mode:
+                    # Host-CPU policy on the snapshot params (see dreamer_v3).
+                    host_rng, subkey = jax.random.split(host_rng)
+                    action_list = host_player.get_actions(host_params, jobs, subkey)
+                else:
+                    rng, subkey = jax.random.split(rng)
+                    action_list = player.get_actions(player_params(), jobs, subkey)
                 actions = np.asarray(jnp.concatenate(action_list, axis=-1))
                 if is_continuous:
                     real_actions = actions
@@ -634,7 +758,10 @@ def main(fabric, cfg: Dict[str, Any]):
                     real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in action_list], axis=-1)
 
             step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if host_mirror:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if burst_mode:
+                runner.stage_step(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -645,12 +772,21 @@ def main(fabric, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, agent_roe in enumerate(infos["restart_on_exception"]):
                 if agent_roe and not dones[i]:
-                    sub_rb = rb.buffer[i]
-                    last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
-                    sub_rb["terminated"][last_inserted_idx] = np.zeros_like(sub_rb["terminated"][last_inserted_idx])
-                    sub_rb["truncated"][last_inserted_idx] = np.ones_like(sub_rb["truncated"][last_inserted_idx])
-                    sub_rb["is_first"][last_inserted_idx] = np.zeros_like(sub_rb["is_first"][last_inserted_idx])
+                    if host_mirror:
+                        sub_rb = rb.buffer[i]
+                        last_inserted_idx = (sub_rb._pos - 1) % sub_rb.buffer_size
+                        sub_rb["terminated"][last_inserted_idx] = np.zeros_like(
+                            sub_rb["terminated"][last_inserted_idx]
+                        )
+                        sub_rb["truncated"][last_inserted_idx] = np.ones_like(
+                            sub_rb["truncated"][last_inserted_idx]
+                        )
+                        sub_rb["is_first"][last_inserted_idx] = np.zeros_like(
+                            sub_rb["is_first"][last_inserted_idx]
+                        )
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
+                    if burst_mode:
+                        runner.patch_last(i, {"terminated": 0.0, "is_first": 0.0})
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             ep_info = infos["final_info"]
@@ -692,15 +828,28 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))), dtype=np.float32)
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if host_mirror:
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if burst_mode:
+                runner.stage_reset(reset_data, dones_idxes)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
             step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
             step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
-            player.init_states(player_params(), dones_idxes)
+            if burst_mode:
+                host_player.init_states(host_params, dones_idxes)
+            else:
+                player.init_states(player_params(), dones_idxes)
 
-        if iter_num >= learning_starts:
+        if burst_mode:
+            if iter_num >= learning_starts:
+                grant_backlog += ratio(policy_step - prefill_steps * policy_steps_per_iter)
+            while grant_backlog >= grad_chunk or runner.staging_full():
+                consumed = _flush_burst()
+                if consumed == 0 or grant_backlog < grad_chunk:
+                    break
+        elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps * policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
                 sample = rb.sample(
@@ -753,6 +902,9 @@ def main(fabric, cfg: Dict[str, Any]):
             iter_num == total_iters and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
+            if burst_mode:
+                # Latest trainer-thread handles (at most one burst stale).
+                params, opts, moments_state, _ = runner.carry
             ckpt_state = {
                 "world_model": params["world_model"],
                 "ensembles": params["ensembles"],
@@ -776,6 +928,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+
+    if burst_mode:
+        # Flush the tail; grants that can never execute are abandoned.
+        while runner.staged_count or grant_backlog:
+            if _flush_burst() == 0 and not runner.staged_count:
+                break
+        params, opts, moments_state, _ = runner.close()
 
     envs.close()
     # Zero-shot task test (reference: p2e_dv3_exploration.py:800-812)

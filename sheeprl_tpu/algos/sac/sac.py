@@ -438,10 +438,11 @@ def main(fabric, cfg: Dict[str, Any]):
             "observations": obs_dim, "next_observations": obs_dim,
             "actions": act_dim, "rewards": 1, "terminated": 1,
         }
-        rb_dev = {
-            k: fabric.put_replicated(jnp.zeros((buffer_size, int(cfg.env.num_envs), d), jnp.float32))
-            for k, d in dims.items()
-        }
+        from sheeprl_tpu.utils.burst import init_device_ring
+
+        rb_dev, _, _ = init_device_ring(
+            fabric, {k: ((d,), jnp.float32) for k, d in dims.items()}, buffer_size, int(cfg.env.num_envs)
+        )
         dev_pos, dev_total = 0, 0
         if state is not None and cfg.buffer.checkpoint and not rb.empty:
             # Mirror the restored host buffer onto the device ring.

@@ -112,7 +112,9 @@ def build_burst_train_step(
                 )  # (T, B)
                 batch = {kk: rb[kk][t_idx, env_idx[None, :]] for kk in rb}
                 nc, m = gradient_step(c, (batch, k_grad))
-                return nc, tuple(x.astype(jnp.float32) for x in m)
+                # Metrics may be a tuple (Dreamers) or a dict (P2E) — keep
+                # the structure, normalize the dtype for the masked mean.
+                return nc, jax.tree.map(lambda x: x.astype(jnp.float32), m)
 
             # Zero metrics derived from the true branch's structure, so the
             # two cond branches can never drift apart.

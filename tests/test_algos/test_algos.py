@@ -406,3 +406,15 @@ def test_dreamer_v1_hybrid_burst(tmp_path):
 
 def test_dreamer_v2_hybrid_burst(tmp_path):
     run(_hybrid_burst_args(tmp_path, "dreamer_v2", DREAMER_V2_FAST))
+
+
+def test_p2e_dv3_exploration_hybrid_burst(tmp_path):
+    run(_hybrid_burst_args(tmp_path, "p2e_dv3_exploration", P2E_DV3_FAST))
+
+
+def test_p2e_dv1_exploration_hybrid_burst(tmp_path):
+    run(_hybrid_burst_args(tmp_path, "p2e_dv1_exploration", P2E_DV1_FAST))
+
+
+def test_p2e_dv2_exploration_hybrid_burst(tmp_path):
+    run(_hybrid_burst_args(tmp_path, "p2e_dv2_exploration", P2E_DV2_FAST))

@@ -37,14 +37,23 @@ def test_bench_prints_json_line():
 @pytest.mark.slow
 def test_dryrun_multichip_from_initialized_backend():
     code = (
-        "import jax; jax.devices()\n"  # initialize whatever backend first, like the driver
+        # Initialize a backend first, like the driver. The sandbox's
+        # sitecustomize force-sets JAX_PLATFORMS, so pin CPU via jax.config
+        # (the shell env alone is not enough).
+        "import jax; jax.config.update('jax_platforms', 'cpu'); jax.devices()\n"
         "import __graft_entry__ as g\n"
         "g.dryrun_multichip(8)\n"
         "print('DRYRUN-OK')\n"
     )
+    # Pin the child to the CPU backend: the driver provides the virtual-CPU
+    # mesh environment itself, and the default (tunneled-accelerator) backend
+    # can wedge for minutes — this test must stay hermetic.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, "-c", code],
         cwd=_REPO,
+        env=env,
         capture_output=True,
         text=True,
         timeout=540,
