@@ -277,14 +277,8 @@ def test_p2e_exploration_then_finetuning(tmp_path, algo, fast):
     """Exploration dry-run → checkpoint → finetuning-from-checkpoint
     round-trip (mirrors reference ``tests/test_algos/test_algos.py`` p2e
     coverage + the ``cli`` finetuning config plumbing)."""
-    expl = _std_args(tmp_path, f"{algo}_exploration", extra=fast)
-    expl.remove("checkpoint.save_last=False")
-    expl.append("checkpoint.save_last=True")
-    run(expl)
-    ckpt = _latest_ckpt(f"{tmp_path}/logs")
-    run(
-        _std_args(tmp_path, f"{algo}_finetuning", extra=fast)
-        + [f"checkpoint.exploration_ckpt_path={ckpt}"]
+    _exploration_ckpt_then_finetune(
+        tmp_path, algo, fast, _std_args(tmp_path, f"{algo}_exploration", extra=fast)
     )
 
 
@@ -414,6 +408,34 @@ def test_p2e_dv3_exploration_hybrid_burst(tmp_path):
 
 def test_p2e_dv1_exploration_hybrid_burst(tmp_path):
     run(_hybrid_burst_args(tmp_path, "p2e_dv1_exploration", P2E_DV1_FAST))
+
+
+def _exploration_ckpt_then_finetune(tmp_path, algo, fast, exploration_args):
+    """Run an exploration phase with save_last, then finetune from its
+    checkpoint (shared by the host-path and burst-path round-trip tests)."""
+    expl = list(exploration_args)
+    expl.remove("checkpoint.save_last=False")
+    expl.append("checkpoint.save_last=True")
+    run(expl)
+    ckpt = _latest_ckpt(f"{tmp_path}/logs")
+    run(
+        _std_args(tmp_path, f"{algo}_finetuning", extra=fast)
+        + [f"checkpoint.exploration_ckpt_path={ckpt}"]
+    )
+
+
+@pytest.mark.parametrize(
+    "algo, fast",
+    [("p2e_dv1", P2E_DV1_FAST), ("p2e_dv3", P2E_DV3_FAST)],
+)
+def test_p2e_burst_checkpoint_feeds_finetuning(tmp_path, algo, fast):
+    """A checkpoint written by the burst path (trainer-thread carry) must be
+    consumable by the host-path finetuning main — cross-phase parity of the
+    checkpoint layout. dv1 and dv3 cover the two carry shapes
+    ((params, opts) and (params, opts, moments, cum))."""
+    args = _hybrid_burst_args(tmp_path, f"{algo}_exploration", fast)
+    args.append("algo.run_test=False")  # the greedy rollout adds nothing here
+    _exploration_ckpt_then_finetune(tmp_path, algo, fast, args)
 
 
 def test_p2e_dv2_exploration_hybrid_burst(tmp_path):
