@@ -608,40 +608,10 @@ def main(fabric, cfg: Dict[str, Any]):
     # thread (see dreamer_v3.py for the design rationale).
     hp_cfg = cfg.algo.get("hybrid_player") or {}
     burst_mode = resolve_hybrid_player(hp_cfg, fabric.mesh)
-    train_every = max(1, int(hp_cfg.get("train_every", 16)))
-    snapshot_every = max(1, int(hp_cfg.get("snapshot_every", 4)))
     host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
 
     if burst_mode:
-        from sheeprl_tpu.utils.burst import (
-            BurstRunner,
-            HostSnapshot,
-            dreamer_ring_keys,
-            dreamer_stage_sizes,
-            init_device_ring,
-        )
-
-        grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
-        stage_max, stage_buckets = dreamer_stage_sizes(train_every, int(cfg.env.num_envs), buffer_size)
-        ring_keys = dreamer_ring_keys(
-            observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=True
-        )
-        ring_spec = {
-            "capacity": buffer_size,
-            "n_envs": int(cfg.env.num_envs),
-            "grad_chunk": grad_chunk,
-            "seq_len": seq_len,
-            "batch_size": batch_size,
-        }
-        burst_fn = make_train_step(
-            world_model, ens_module, actor, critic, critics_spec, cfg, fabric.mesh,
-            actions_dim, is_continuous, txs, ring=ring_spec,
-        )
-        rb_dev, dev_pos, dev_valid = init_device_ring(
-            fabric, ring_keys, buffer_size, int(cfg.env.num_envs),
-            rb=rb if (state is not None and cfg.buffer.checkpoint) else None,
-        )
-        grant_backlog = 0
+        from sheeprl_tpu.utils.burst import HybridPlayerHarness
 
         wm_cfg_ = cfg.algo.world_model
 
@@ -658,8 +628,20 @@ def main(fabric, cfg: Dict[str, Any]):
                 "actor": p["actor_exploration"],
             }
 
-        snapshot = HostSnapshot(_player_subset, params)
-        host_params = snapshot.pull(params)
+        hp = HybridPlayerHarness(
+            fabric, cfg,
+            observation_space=observation_space, cnn_keys=cnn_keys, mlp_keys=mlp_keys,
+            actions_dim=actions_dim, capacity=buffer_size, seq_len=seq_len, batch_size=batch_size,
+            policy_steps_per_iter=policy_steps_per_iter,
+            make_burst_fn=lambda ring: make_train_step(
+                world_model, ens_module, actor, critic, critics_spec, cfg, fabric.mesh,
+                actions_dim, is_continuous, txs, ring=ring,
+            ),
+            player_subset=_player_subset,
+            carry=(params, opts, moments_state, jnp.int32(0)),
+            rb=rb if (state is not None and cfg.buffer.checkpoint) else None,
+            with_is_first=True, aggregator=aggregator,
+        )
         host_player = PlayerDV3(
             world_model,
             actor,
@@ -669,42 +651,8 @@ def main(fabric, cfg: Dict[str, Any]):
             int(wm_cfg_.recurrent_model.recurrent_state_size),
             discrete_size=int(wm_cfg_.discrete_size),
             actor_type="exploration",
-            host_device=snapshot.host_device,
+            host_device=hp.host_device,
         )
-        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
-
-        runner = BurstRunner(
-            burst_fn,
-            (params, opts, moments_state, jnp.int32(0)),
-            rb_dev,
-            ring_keys,
-            n_envs=int(cfg.env.num_envs),
-            capacity=buffer_size,
-            grad_chunk=grad_chunk,
-            stage_max=stage_max,
-            seq_len=seq_len,
-            snapshot=snapshot,
-            snapshot_every=snapshot_every,
-            params_of=lambda c: c[0],
-            stage_buckets=stage_buckets,
-        )
-        runner.set_ring_state(dev_pos, dev_valid)
-
-        def _flush_burst():
-            nonlocal rng, grant_backlog, cumulative_per_rank_gradient_steps, train_step
-            with timer("Time/train_time", SumMetric):
-                rng, train_key = jax.random.split(rng)
-                chunk = runner.flush(train_key, grant_backlog)
-                latest = runner.metrics
-                if aggregator and not aggregator.disabled and latest is not None:
-                    for name, value in latest.items():
-                        if name in aggregator:
-                            aggregator.update(name, value)
-            grant_backlog -= chunk
-            if chunk > 0:
-                cumulative_per_rank_gradient_steps += chunk
-                train_step += 1
-            return chunk
     else:
         train_fn = make_train_step(
             world_model, ens_module, actor, critic, critics_spec, cfg, fabric.mesh, actions_dim, is_continuous, txs
@@ -720,7 +668,7 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), dtype=np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     if burst_mode:
-        host_player.init_states(host_params)
+        host_player.init_states(hp.host_params)
     else:
         player.init_states(player_params())
 
@@ -729,9 +677,7 @@ def main(fabric, cfg: Dict[str, Any]):
         policy_step += policy_steps_per_iter
 
         if burst_mode:
-            fresh = snapshot.poll()
-            if fresh is not None:
-                host_params = fresh
+            hp.poll()
 
         with timer("Time/env_interaction_time", SumMetric):
             if iter_num <= learning_starts and state is None:
@@ -746,8 +692,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 jobs = prepare_obs(fabric, obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
                 if burst_mode:
                     # Host-CPU policy on the snapshot params (see dreamer_v3).
-                    host_rng, subkey = jax.random.split(host_rng)
-                    action_list = host_player.get_actions(host_params, jobs, subkey)
+                    action_list = host_player.get_actions(hp.host_params, jobs, hp.host_key())
                 else:
                     rng, subkey = jax.random.split(rng)
                     action_list = player.get_actions(player_params(), jobs, subkey)
@@ -761,7 +706,7 @@ def main(fabric, cfg: Dict[str, Any]):
             if host_mirror:
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             if burst_mode:
-                runner.stage_step(step_data)
+                hp.stage_step(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -786,7 +731,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         )
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
                     if burst_mode:
-                        runner.patch_last(i, {"terminated": 0.0, "is_first": 0.0})
+                        hp.patch_last(i, {"terminated": 0.0, "is_first": 0.0})
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             ep_info = infos["final_info"]
@@ -831,24 +776,22 @@ def main(fabric, cfg: Dict[str, Any]):
             if host_mirror:
                 rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             if burst_mode:
-                runner.stage_reset(reset_data, dones_idxes)
+                hp.stage_reset(reset_data, dones_idxes)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
             step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
             step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
             if burst_mode:
-                host_player.init_states(host_params, dones_idxes)
+                host_player.init_states(hp.host_params, dones_idxes)
             else:
                 player.init_states(player_params(), dones_idxes)
 
         if burst_mode:
             if iter_num >= learning_starts:
-                grant_backlog += ratio(policy_step - prefill_steps * policy_steps_per_iter)
-            while grant_backlog >= grad_chunk or runner.staging_full():
-                consumed = _flush_burst()
-                if consumed == 0 or grant_backlog < grad_chunk:
-                    break
+                hp.grant(ratio(policy_step - prefill_steps * policy_steps_per_iter))
+            hp.pump()
+            cumulative_per_rank_gradient_steps, train_step = hp.gradient_steps, hp.train_steps
         elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps * policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
@@ -904,7 +847,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_checkpoint = policy_step
             if burst_mode:
                 # Latest trainer-thread handles (at most one burst stale).
-                params, opts, moments_state, _ = runner.carry
+                params, opts, moments_state, _ = hp.carry
             ckpt_state = {
                 "world_model": params["world_model"],
                 "ensembles": params["ensembles"],
@@ -931,10 +874,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     if burst_mode:
         # Flush the tail; grants that can never execute are abandoned.
-        while runner.staged_count or grant_backlog:
-            if _flush_burst() == 0 and not runner.staged_count:
-                break
-        params, opts, moments_state, _ = runner.close()
+        params, opts, moments_state, _ = hp.finish()
 
     envs.close()
     # Zero-shot task test (reference: p2e_dv3_exploration.py:800-812)

@@ -371,37 +371,15 @@ def main(fabric, cfg: Dict[str, Any]):
     host_rng = None
     _host_sample = None
     last_refresh = 0
-    _snapshot_slot: list = [None]
-    _snapshot_thread = None
     if hp_enabled:
-        import threading
-        from jax.flatten_util import ravel_pytree
+        from sheeprl_tpu.utils.burst import HostSnapshot
 
-        host_device = jax.devices("cpu")[0]
-        # One packed vector per snapshot: a per-leaf transfer pays one wire
-        # round-trip PER LEAF on a tunneled chip (jax device_put goes through
-        # host `_value`), a ravel'd vector pays exactly one.
-        _, _unravel = ravel_pytree(jax.tree.map(np.asarray, params["actor"]))
-        _pack = jax.jit(lambda ap: ravel_pytree(ap)[0])
-        _unpack = jax.jit(_unravel)
-        host_actor_params = _unpack(jax.device_put(_pack(params["actor"]), host_device))
-        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), host_device)
+        # SAC's actor is tiny, so the packed snapshot stays full-precision
+        # (the Dreamer harness narrows to bf16 where the wire is the cost).
+        snapshot = HostSnapshot(lambda p: p["actor"], params, wire_dtype=jnp.float32)
+        host_actor_params = snapshot.pull(params)
+        host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), snapshot.host_device)
         _host_sample = jax.jit(lambda ap, o, k: agent.sample_action(ap, o, k)[0])
-
-        def _snapshot_worker(vec):
-            # The blocking device->host pull runs off-thread so the env loop
-            # never waits on the wire.
-            _snapshot_slot[0] = jax.device_put(vec, host_device)
-
-        def start_snapshot(actor_params):
-            nonlocal _snapshot_thread
-            if _snapshot_thread is not None and _snapshot_thread.is_alive():
-                return False
-            _snapshot_thread = threading.Thread(
-                target=_snapshot_worker, args=(_pack(actor_params),), daemon=True
-            )
-            _snapshot_thread.start()
-            return True
 
     # Burst training (TPU-native, see make_burst_train_step): dispatch the
     # accumulated Ratio grants every `train_every` iterations against a
@@ -454,51 +432,27 @@ def main(fabric, cfg: Dict[str, Any]):
         ema_backlog: list = []
 
         # The burst dispatch itself pays a round-trip on a tunneled chip, so
-        # it runs on a trainer thread: the env loop hands staged transitions
-        # over a bounded queue (backpressure = one in-flight burst) and keeps
-        # stepping with the previous snapshot. The thread owns the
-        # params/opt/ring futures; `_tr` always holds the newest handles for
-        # checkpoints and the final test.
-        import queue as _queue
-        import threading as _threading
+        # it runs on a trainer thread (shared machinery, `utils/burst.py`):
+        # the env loop hands staged transitions over a bounded queue and
+        # keeps stepping with the previous snapshot; the thread owns the
+        # params/opt/ring futures and refreshes the host policy snapshot
+        # once per burst.
+        from sheeprl_tpu.utils.burst import TrainerThread
 
-        _tr = {
-            "params": params, "aopt": aopt, "copt": copt, "lopt": lopt,
-            "rb_dev": rb_dev, "losses": None, "error": None,
-        }
-        _tr_lock = _threading.Lock()
-        _burst_q: "_queue.Queue" = _queue.Queue(maxsize=2)
+        def _burst_step(carry, job):
+            params_, aopt_, copt_, lopt_, rb_dev_ = carry
+            staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j = job
+            params_, aopt_, copt_, lopt_, rb_dev_, qf_l, a_l, al_l = burst_fn(
+                params_, aopt_, copt_, lopt_, rb_dev_,
+                staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j,
+            )
+            return (params_, aopt_, copt_, lopt_, rb_dev_), (qf_l, a_l, al_l)
 
-        def _burst_worker():
-            while True:
-                job = _burst_q.get()
-                if job is None:
-                    return
-                try:
-                    staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j = job
-                    out = burst_fn(
-                        _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"], _tr["rb_dev"],
-                        staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j,
-                    )
-                    with _tr_lock:
-                        (
-                            _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"], _tr["rb_dev"],
-                            qf_l, a_l, al_l,
-                        ) = out
-                        _tr["losses"] = (qf_l, a_l, al_l)
-                    # Refresh the host policy snapshot once per burst (one
-                    # packed-vector pull; blocking is fine on this thread).
-                    _snapshot_slot[0] = jax.device_put(_pack(_tr["params"]["actor"]), host_device)
-                except Exception as exc:  # surfaced at the next put/join
-                    _tr["error"] = exc
-                    # Keep draining so a full queue can never deadlock the
-                    # main loop's put(); the error is raised there instead.
-                    while _burst_q.get() is not None:
-                        pass
-                    return
-
-        _burst_thread = _threading.Thread(target=_burst_worker, daemon=True)
-        _burst_thread.start()
+        trainer = TrainerThread(
+            _burst_step,
+            (params, aopt, copt, lopt, rb_dev),
+            on_step=lambda carry, _m: snapshot.refresh(carry[0]),
+        )
 
         def _flush_burst():
             """Ship the staged transitions + up to one grant chunk to the
@@ -527,17 +481,16 @@ def main(fabric, cfg: Dict[str, Any]):
             valid = np.zeros((grad_chunk,), np.float32)
             flags[:chunk] = ema_backlog[:chunk]
             valid[:chunk] = 1.0
-            if _tr["error"] is not None:
-                raise _tr["error"]
             with timer("Time/train_time", SumMetric):
                 rng, train_key = jax.random.split(rng)
-                _burst_q.put((
+                trainer.submit((
                     staged_arr,
                     jnp.int32(dev_pos), jnp.int32(count), jnp.int32(dev_total),
                     train_key, jnp.asarray(flags), jnp.asarray(valid),
                 ))
-                if aggregator and not aggregator.disabled and _tr["losses"] is not None:
-                    qf_l, a_l, al_l = _tr["losses"]
+                latest = trainer.metrics
+                if aggregator and not aggregator.disabled and latest is not None:
+                    qf_l, a_l, al_l = latest
                     aggregator.update("Loss/value_loss", qf_l)
                     aggregator.update("Loss/policy_loss", a_l)
                     aggregator.update("Loss/alpha_loss", al_l)
@@ -564,14 +517,14 @@ def main(fabric, cfg: Dict[str, Any]):
         # start the next pull once the refresh period has elapsed (in burst
         # mode the trainer thread refreshes once per burst).
         if hp_enabled:
-            if _snapshot_slot[0] is not None:
-                host_actor_params = _unpack(_snapshot_slot[0])
-                _snapshot_slot[0] = None
+            fresh = snapshot.poll()
+            if fresh is not None:
+                host_actor_params = fresh
             if (
                 not burst_mode
                 and iter_num - last_refresh >= hp_refresh
                 and iter_num > learning_starts
-                and start_snapshot(params["actor"])
+                and snapshot.refresh_async(params)
             ):
                 last_refresh = iter_num
 
@@ -703,8 +656,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_checkpoint = policy_step
             if burst_mode:
                 # Latest trainer-thread handles (at most one burst stale).
-                with _tr_lock:
-                    params, aopt, copt, lopt = _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"]
+                params, aopt, copt, lopt, _ = trainer.carry
             ckpt_state = {
                 "agent": params,
                 "qf_optimizer": copt,
@@ -729,11 +681,7 @@ def main(fabric, cfg: Dict[str, Any]):
         # must be executed (a reference run would have applied them).
         while staged or ema_backlog:
             _flush_burst()
-        _burst_q.put(None)
-        _burst_thread.join()
-        if _tr["error"] is not None:
-            raise _tr["error"]
-        params, aopt, copt, lopt = _tr["params"], _tr["aopt"], _tr["copt"], _tr["lopt"]
+        params, aopt, copt, lopt, _ = trainer.close()
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:

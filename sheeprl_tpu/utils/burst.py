@@ -27,7 +27,9 @@ from jax.flatten_util import ravel_pytree
 
 __all__ = [
     "HostSnapshot",
+    "TrainerThread",
     "BurstRunner",
+    "HybridPlayerHarness",
     "DREAMER_METRIC_NAMES",
     "dreamer_ring_keys",
     "dreamer_stage_sizes",
@@ -110,12 +112,13 @@ class HostSnapshot:
     (decoders, critics, optimizer state) never crosses the wire.
     """
 
-    def __init__(self, subset_fn: Callable[[Any], Any], params: Any):
+    def __init__(self, subset_fn: Callable[[Any], Any], params: Any, wire_dtype=jnp.bfloat16):
         self.host_device = jax.devices("cpu")[0]
         _, unravel = ravel_pytree(jax.tree.map(np.asarray, subset_fn(params)))
-        self._pack = jax.jit(lambda p: ravel_pytree(subset_fn(p))[0].astype(jnp.bfloat16))
+        self._pack = jax.jit(lambda p: ravel_pytree(subset_fn(p))[0].astype(wire_dtype))
         self._unpack = jax.jit(lambda v: unravel(v.astype(jnp.float32)))
         self._slot: list = [None]
+        self._refresh_thread: Optional[threading.Thread] = None
 
     def pull(self, params: Any) -> Any:
         """Blocking pack → pull → unpack (initialization / trainer thread)."""
@@ -126,10 +129,95 @@ class HostSnapshot:
         blocking pull is fine there)."""
         self._slot[0] = jax.device_put(self._pack(params), self.host_device)
 
+    def refresh_async(self, params: Any) -> bool:
+        """Main thread: kick off the device→host pull on a one-shot thread so
+        the env loop never waits on the wire. Skipped (returns False) while a
+        previous pull is still in flight."""
+        if self._refresh_thread is not None and self._refresh_thread.is_alive():
+            return False
+        packed = self._pack(params)
+        self._refresh_thread = threading.Thread(
+            target=lambda: self._slot.__setitem__(0, jax.device_put(packed, self.host_device)),
+            daemon=True,
+        )
+        self._refresh_thread.start()
+        return True
+
     def poll(self) -> Optional[Any]:
         """Main thread: the latest snapshot unpacked on the host, or None."""
         packed, self._slot[0] = self._slot[0], None
         return None if packed is None else self._unpack(packed)
+
+
+class TrainerThread:
+    """Bounded-queue trainer thread: jobs go in, ``step_fn(carry, job)``
+    runs off the env loop, and the newest carry/metrics are readable at any
+    time. The queue bound is the backpressure (at most ``maxsize`` bursts in
+    flight). A ``step_fn`` exception parks the thread and resurfaces on the
+    next :meth:`submit`/:meth:`close`; the queue keeps draining so a full
+    ``put`` can never deadlock the env loop.
+
+    :class:`BurstRunner` composes this with ring staging; SAC's flat
+    transition ring drives it directly.
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+        carry: Any,
+        on_step: Optional[Callable[[Any, Any], None]] = None,
+        maxsize: int = 2,
+    ) -> None:
+        self._step_fn = step_fn
+        self._on_step = on_step
+        self._state = {"carry": carry, "metrics": None, "error": None}
+        self._lock = threading.Lock()
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=maxsize)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def carry(self) -> Any:
+        with self._lock:
+            return self._state["carry"]
+
+    @property
+    def metrics(self) -> Optional[Any]:
+        with self._lock:
+            return self._state["metrics"]
+
+    def raise_if_failed(self) -> None:
+        if self._state["error"] is not None:
+            raise self._state["error"]
+
+    def submit(self, job: Any) -> None:
+        self.raise_if_failed()
+        self._q.put(job)
+
+    def _worker(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                carry, metrics = self._step_fn(self._state["carry"], job)
+                with self._lock:
+                    self._state["carry"] = carry
+                    if metrics is not None:
+                        self._state["metrics"] = metrics
+                if self._on_step is not None:
+                    self._on_step(carry, metrics)
+            except Exception as exc:  # surfaced at the next submit/close
+                self._state["error"] = exc
+                while self._q.get() is not None:
+                    pass
+                return
+
+    def close(self) -> Any:
+        self._q.put(None)
+        self._thread.join()
+        self.raise_if_failed()
+        return self._state["carry"]
 
 
 class BurstRunner:
@@ -180,11 +268,8 @@ class BurstRunner:
         self.dev_pos = np.zeros(self._n_envs, np.int64)
         self.dev_valid = np.zeros(self._n_envs, np.int64)
         self._staged: list = []  # (data dict, env mask) per ring row
-        self._state = {"carry": carry, "rb": rb_dev, "metrics": None, "error": None, "bursts": 0}
-        self._lock = threading.Lock()
-        self._q: "_queue.Queue" = _queue.Queue(maxsize=2)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        self._bursts = 0  # trained bursts; worker-thread-only state
+        self._thread = TrainerThread(self._step, (carry, rb_dev))
 
     # -- ring-state restore (checkpoint resume) ------------------------------
     def set_ring_state(self, pos: np.ndarray, valid: np.ndarray) -> None:
@@ -231,24 +316,32 @@ class BurstRunner:
     # -- trainer-thread handles ----------------------------------------------
     @property
     def carry(self) -> Any:
-        with self._lock:
-            return self._state["carry"]
+        return self._thread.carry[0]
 
     @property
     def metrics(self) -> Optional[Any]:
-        with self._lock:
-            return self._state["metrics"]
+        return self._thread.metrics
 
     def raise_if_failed(self) -> None:
-        if self._state["error"] is not None:
-            raise self._state["error"]
+        self._thread.raise_if_failed()
+
+    def _step(self, carry_rb, job):
+        carry, rb = carry_rb
+        staged_j, mask_j, pos_j, valid_j, key_j, validmask_j, trained = job
+        carry, rb, metrics = self._burst_fn(carry, rb, staged_j, mask_j, pos_j, valid_j, key_j, validmask_j)
+        if trained:
+            self._bursts += 1
+            if self._snapshot is not None and self._bursts % self._snapshot_every == 0:
+                # One packed pull; blocking is fine on this thread.
+                self._snapshot.refresh(self._params_of(carry))
+            return (carry, rb), metrics
+        return (carry, rb), None  # append-only bursts produce junk metrics
 
     # -- dispatch ------------------------------------------------------------
     def flush(self, key, grant_backlog: int) -> int:
         """Package the staged rows + up to ``grad_chunk`` grants into one
         burst job. Returns the number of grants consumed (0 while any env is
         still shorter than a sample window)."""
-        self.raise_if_failed()
         n_rows = len(self._staged)
         size = next(b for b in self._stage_buckets if b >= n_rows)
         arrs = {}
@@ -268,7 +361,7 @@ class BurstRunner:
         chunk = min(self.grad_chunk, grant_backlog) if ready else 0
         validmask = np.zeros((self.grad_chunk,), np.float32)
         validmask[:chunk] = 1.0
-        self._q.put((
+        self._thread.submit((
             arrs, jnp.asarray(mask), jnp.asarray(self.dev_pos, jnp.int32),
             jnp.asarray(self.dev_valid, jnp.int32), key, jnp.asarray(validmask),
             chunk > 0,
@@ -277,34 +370,170 @@ class BurstRunner:
         self.dev_valid[:] = np.minimum(self.dev_valid + env_counts, self._capacity)
         return chunk
 
-    def _worker(self) -> None:
-        while True:
-            job = self._q.get()
-            if job is None:
-                return
-            try:
-                staged_j, mask_j, pos_j, valid_j, key_j, validmask_j, trained = job
-                carry, rb, metrics = self._burst_fn(
-                    self._state["carry"], self._state["rb"],
-                    staged_j, mask_j, pos_j, valid_j, key_j, validmask_j,
-                )
-                with self._lock:
-                    self._state["carry"], self._state["rb"] = carry, rb
-                    if trained:  # append-only bursts produce junk metrics
-                        self._state["metrics"] = metrics
-                        self._state["bursts"] += 1
-                if trained and self._snapshot is not None and self._state["bursts"] % self._snapshot_every == 0:
-                    # One packed pull; blocking is fine on this thread.
-                    self._snapshot.refresh(self._params_of(self._state["carry"]))
-            except Exception as exc:  # surfaced at the next flush/close
-                self._state["error"] = exc
-                while self._q.get() is not None:
-                    pass
-                return
-
     def close(self) -> Any:
         """Stop the trainer thread and return the final carry."""
-        self._q.put(None)
-        self._thread.join()
-        self.raise_if_failed()
-        return self._state["carry"]
+        return self._thread.close()[0]
+
+
+class HybridPlayerHarness:
+    """One-call orchestration of the hybrid host-player burst path for the
+    Dreamer-family mains (dreamer_v1/v2/v3 and the three p2e exploration
+    entry points).
+
+    Owns everything the six mains used to instantiate by hand — ring spec,
+    device-ring allocation (with checkpoint mirror), packed-bf16 host
+    snapshot, :class:`BurstRunner`, grant accounting, and the per-flush
+    metric fan-out — so a main keeps only its algorithm-specific pieces:
+    the player-subset fn, the carry tuple, the metric names, and the host
+    player construction (from :attr:`host_device`).
+
+    The train-key stream is ``PRNGKey(cfg.seed)`` split once per flush and
+    the host action stream is ``PRNGKey(cfg.seed + 17)`` — the exact streams
+    the open-coded blocks used, so refactored runs are bit-identical.
+    """
+
+    def __init__(
+        self,
+        fabric,
+        cfg,
+        *,
+        observation_space,
+        cnn_keys,
+        mlp_keys,
+        actions_dim,
+        capacity: int,
+        seq_len: int,
+        batch_size: int,
+        policy_steps_per_iter: int,
+        make_burst_fn: Callable[[Dict[str, int]], Callable],
+        player_subset: Callable[[Any], Any],
+        carry: Any,
+        rb=None,
+        with_is_first: bool = True,
+        metric_names: Optional[Tuple[str, ...]] = None,
+        aggregator=None,
+        params_of: Callable[[Any], Any] = lambda c: c[0],
+    ) -> None:
+        hp_cfg = cfg.algo.get("hybrid_player") or {}
+        train_every = max(1, int(hp_cfg.get("train_every", 16)))
+        snapshot_every = max(1, int(hp_cfg.get("snapshot_every", 4)))
+        n_envs = int(cfg.env.num_envs)
+
+        self.grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
+        stage_max, stage_buckets = dreamer_stage_sizes(train_every, n_envs, capacity)
+        self.ring_keys = dreamer_ring_keys(
+            observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=with_is_first
+        )
+        burst_fn = make_burst_fn(
+            {
+                "capacity": capacity,
+                "n_envs": n_envs,
+                "grad_chunk": self.grad_chunk,
+                "seq_len": seq_len,
+                "batch_size": batch_size,
+            }
+        )
+        rb_dev, dev_pos, dev_valid = init_device_ring(fabric, self.ring_keys, capacity, n_envs, rb=rb)
+
+        params = params_of(carry)
+        self.snapshot = HostSnapshot(player_subset, params)
+        self.host_device = self.snapshot.host_device
+        self.host_params = self.snapshot.pull(params)
+        self._host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), self.host_device)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+
+        self.runner = BurstRunner(
+            burst_fn,
+            carry,
+            rb_dev,
+            self.ring_keys,
+            n_envs=n_envs,
+            capacity=capacity,
+            grad_chunk=self.grad_chunk,
+            stage_max=stage_max,
+            seq_len=seq_len,
+            snapshot=self.snapshot,
+            snapshot_every=snapshot_every,
+            params_of=params_of,
+            stage_buckets=stage_buckets,
+        )
+        self.runner.set_ring_state(dev_pos, dev_valid)
+
+        self._metric_names = metric_names
+        self._aggregator = aggregator
+        # Late-bound {metric_name: () -> value} extras (e.g. the V1/P2E
+        # exploration amount, whose host player exists only after __init__).
+        self.extra_metrics: Dict[str, Callable[[], Any]] = {}
+
+        self.grant_backlog = 0
+        self.gradient_steps = 0  # cumulative per-rank gradient steps
+        self.train_steps = 0  # burst dispatches that actually trained
+
+    # -- host player ---------------------------------------------------------
+    def poll(self) -> Any:
+        """Adopt the newest trainer-thread snapshot, if one has landed."""
+        fresh = self.snapshot.poll()
+        if fresh is not None:
+            self.host_params = fresh
+        return self.host_params
+
+    def host_key(self):
+        self._host_rng, subkey = jax.random.split(self._host_rng)
+        return subkey
+
+    # -- staging (delegates) -------------------------------------------------
+    def stage_step(self, step_data) -> None:
+        self.runner.stage_step(step_data)
+
+    def stage_reset(self, reset_data, env_idxes) -> None:
+        self.runner.stage_reset(reset_data, env_idxes)
+
+    def patch_last(self, env_idx: int, updates: Dict[str, float]) -> None:
+        self.runner.patch_last(env_idx, updates)
+
+    @property
+    def carry(self) -> Any:
+        return self.runner.carry
+
+    # -- grant accounting + dispatch -----------------------------------------
+    def grant(self, n: int) -> None:
+        self.grant_backlog += int(n)
+
+    def flush(self) -> int:
+        from sheeprl_tpu.utils.metric import SumMetric
+        from sheeprl_tpu.utils.timer import timer
+
+        with timer("Time/train_time", SumMetric):
+            self._rng, train_key = jax.random.split(self._rng)
+            chunk = self.runner.flush(train_key, self.grant_backlog)
+            latest = self.runner.metrics
+            agg = self._aggregator
+            if agg and not agg.disabled and latest is not None:
+                pairs = latest.items() if isinstance(latest, dict) else zip(self._metric_names, latest)
+                for name, value in pairs:
+                    if name in agg:
+                        agg.update(name, value)
+                for name, value_fn in self.extra_metrics.items():
+                    if name in agg:
+                        agg.update(name, value_fn())
+        self.grant_backlog -= chunk
+        if chunk > 0:
+            self.gradient_steps += chunk
+            self.train_steps += 1
+        return chunk
+
+    def pump(self) -> None:
+        """Dispatch while a full grant chunk (or a full staging buffer) is
+        pending — the per-iteration train section of every burst main."""
+        while self.grant_backlog >= self.grad_chunk or self.runner.staging_full():
+            consumed = self.flush()
+            if consumed == 0 or self.grant_backlog < self.grad_chunk:
+                break
+
+    def finish(self) -> Any:
+        """Flush the tail (grants that can never execute are abandoned with
+        the run), stop the trainer thread, and return the final carry."""
+        while self.runner.staged_count or self.grant_backlog:
+            if self.flush() == 0 and not self.runner.staged_count:
+                break
+        return self.runner.close()

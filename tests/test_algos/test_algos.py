@@ -440,3 +440,13 @@ def test_p2e_burst_checkpoint_feeds_finetuning(tmp_path, algo, fast):
 
 def test_p2e_dv2_exploration_hybrid_burst(tmp_path):
     run(_hybrid_burst_args(tmp_path, "p2e_dv2_exploration", P2E_DV2_FAST))
+
+
+def test_dreamer_v2_hybrid_burst_episode_buffer_errors(tmp_path):
+    """Explicit hybrid_player.enabled=true + buffer.type=episode is a config
+    conflict (the ring has no whole-episode sampling rule) — it must error,
+    not silently forfeit the burst speedup (howto/tpu_parallelism.md)."""
+    args = _hybrid_burst_args(tmp_path, "dreamer_v2", DREAMER_V2_FAST)
+    args += ["buffer.type=episode", "algo.per_rank_sequence_length=1"]
+    with pytest.raises(ValueError, match="whole-episode sampling"):
+        run(args)

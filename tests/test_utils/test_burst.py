@@ -165,7 +165,7 @@ class TestBurstRunner:
         r = _runner(fn)
         r.stage_step({"obs": np.ones((1, 2, 1), np.float32)})
         r.flush(jax.random.PRNGKey(0), grant_backlog=0)
-        _wait(lambda: r._state["error"] is not None)
+        _wait(lambda: r._thread._state["error"] is not None)
         with pytest.raises(RuntimeError, match="burst boom"):
             r.flush(jax.random.PRNGKey(1), grant_backlog=0)
 

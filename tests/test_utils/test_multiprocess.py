@@ -62,14 +62,77 @@ print(f"proc {pid} OK")
 """
 
 
+# The real v5e-pod shape: each process owns FOUR devices, so the global
+# mesh is 2 hosts x 4 local devices = 8, and the fabric's
+# ``shard_data``/``put_replicated`` global-array assembly runs its
+# multi-DEVICE-per-process paths (host-local (4, ...) blocks -> one global
+# (8, ...) array whose addressable shards stay local).
+_WORKER_2x4 = r"""
+import os, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from sheeprl_tpu.parallel.distributed import maybe_init
+
+maybe_init()
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4, jax.local_device_count()
+pid = jax.process_index()
+
+from sheeprl_tpu.parallel.fabric import Fabric
+
+fabric = Fabric(devices=8)
+assert fabric.world_size == 8
+assert fabric.local_device.process_index == pid
+
+# control plane from a non-zero source rank
+obj = fabric.broadcast_obj(np.asarray([7.0 + pid]), src=1)
+assert float(np.asarray(obj)[0]) == 8.0, obj
+fabric.barrier()
+
+# shard_data: this process contributes rows [4*pid, 4*pid+4) of the global
+# batch; all_gather reassembles the full batch so the placement is checked
+# value-for-value, not just by shape.
+host_local = np.stack(
+    [np.full((2,), 4 * pid + d, np.float32) for d in range(4)]
+)  # (4, 2) local block
+global_arr = fabric.shard_data(host_local)
+assert global_arr.shape == (8, 2), global_arr.shape
+
+def gather(x):
+    return jax.lax.all_gather(x, "dp", tiled=True)
+
+gathered = jax.jit(
+    jax.shard_map(gather, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+)(global_arr)
+np.testing.assert_allclose(np.asarray(jax.device_get(gathered))[:, 0], np.arange(8, dtype=np.float32))
+
+# put_replicated + cross-process psum == the single-process analytic value
+def local_sum(x, w):
+    return jax.lax.psum(x * w, "dp")
+
+weight = fabric.put_replicated(np.full((2,), 3.0, np.float32))
+total = jax.jit(
+    jax.shard_map(local_sum, mesh=fabric.mesh, in_specs=(P("dp"), P()), out_specs=P(), check_vma=False)
+)(global_arr, weight)
+np.testing.assert_allclose(np.asarray(total), np.full((1, 2), 3.0 * sum(range(8))))
+
+print(f"proc {pid} OK")
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_mesh_psum_and_control_plane(tmp_path):
+def _run_workers(worker_src: str, devices_per_process: int) -> None:
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -77,7 +140,7 @@ def test_two_process_mesh_psum_and_control_plane(tmp_path):
         env.update(
             {
                 "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_process}",
                 "SHEEPRL_COORDINATOR": f"127.0.0.1:{port}",
                 "SHEEPRL_NUM_PROCESSES": "2",
                 "SHEEPRL_PROCESS_ID": str(pid),
@@ -85,7 +148,7 @@ def test_two_process_mesh_psum_and_control_plane(tmp_path):
         )
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", _WORKER],
+                [sys.executable, "-c", worker_src],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
@@ -100,3 +163,17 @@ def test_two_process_mesh_psum_and_control_plane(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
         assert f"proc {pid} OK" in out
+
+
+@pytest.mark.slow
+def test_two_process_mesh_psum_and_control_plane(tmp_path):
+    _run_workers(_WORKER, devices_per_process=1)
+
+
+@pytest.mark.slow
+def test_two_process_four_devices_each_global_assembly(tmp_path):
+    """2 processes x 4 virtual devices each — the v5e-pod shape. Exercises
+    ``shard_data``/``put_replicated`` global-array assembly across
+    multi-device processes and checks a cross-process ``psum`` against the
+    analytic single-process value (VERDICT r3 weak-item 6)."""
+    _run_workers(_WORKER_2x4, devices_per_process=4)
