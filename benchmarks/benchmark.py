@@ -48,53 +48,25 @@ def main() -> None:
     cfg = compose([f"exp={algo}_benchmarks", *overrides])
     total_steps = int(cfg.algo.total_steps)
 
-    calib_pre = _device_calibration()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from calibration import calibration_verdict, device_calibration_ms, gate_quiet
+
+    # Refuse to measure a loud chip; stamp pre/post readings + verdict so a
+    # number can never be quoted without its measurement conditions.
+    accel = str(cfg.fabric.get("accelerator", "auto"))
+    calib_pre = gate_quiet(accel)
     tic = time.perf_counter()
     check_configs(cfg)
     run_algorithm(cfg)
     elapsed = time.perf_counter() - tic
-    calib_post = _device_calibration()
+    calib_post = device_calibration_ms(accel)
     result = {
         "benchmark": algo,
         "elapsed_s": round(elapsed, 2),
         "env_steps_per_sec": round(total_steps / elapsed, 2),
+        **calibration_verdict(calib_pre, calib_post),
     }
-    # Bracketing probes: a long run is only a clean measurement if the chip
-    # was quiet both when it started and when it ended.
-    if calib_pre is not None:
-        result["device_calibration_ms"] = [calib_pre, calib_post]
     print(json.dumps(result))
-
-
-def _device_calibration() -> "float | None":
-    """Warm time of a fixed ~1 GFLOP matmul chain on the default accelerator.
-
-    The sandbox TPU is time-shared between tenants (a program measured at
-    2.14 ms has been observed at 24.6 ms under external load), so wall-clock
-    results are only comparable at similar calibration readings. Quiet-chip
-    reference for this probe on the v5e: ~1 ms.
-    """
-    try:
-        import jax
-        import jax.numpy as jnp
-
-        if jax.default_backend() == "cpu":
-            return None
-
-        @jax.jit
-        def chain(x):
-            for _ in range(8):
-                x = jnp.tanh(x @ x)
-            return x
-
-        x = jnp.ones((512, 512), jnp.bfloat16)
-        chain(x).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(5):
-            chain(x).block_until_ready()
-        return round((time.perf_counter() - t0) / 5 * 1e3, 2)
-    except Exception:
-        return None
 
 
 if __name__ == "__main__":

@@ -1,0 +1,136 @@
+"""Chip-quietness gate shared by every wall-clock benchmark.
+
+The sandbox TPU is time-shared between tenants: the same jitted program
+measured at 2.14 ms has been observed at 24.6 ms under co-tenant load, and
+round 3's flagship number was silently re-measured 40% low during a loud
+window (BENCH_NOTES.md "Measurement caveat"). A bench run is therefore only
+a measurement if the chip was quiet when it started AND when it ended —
+anything else is a load report.
+
+``gate_quiet()`` probes a fixed ~1 GFLOP matmul chain, retries while the
+chip is loud, and REFUSES (exit status 3) if it never quiets down; benches
+stamp the pre/post readings plus a pass/fail verdict into their JSON line so
+a number can never be quoted without its measurement conditions. When the
+bench is pinned to the host CPU it also pins ``jax_platforms`` so backend
+discovery can never touch the tunneled TPU (merely initializing it can hang
+for hours when the tunnel is wedged).
+
+Env knobs: ``BENCH_CALIB_THRESHOLD_MS`` (default 3.0 — the quiet v5e reads
+~1 ms), ``BENCH_CALIB_RETRIES`` (default 10), ``BENCH_CALIB_WAIT_S``
+(default 30), ``BENCH_ALLOW_LOUD=1`` to record a loud run anyway (stamped
+as failed calibration).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+__all__ = ["device_calibration_ms", "gate_quiet", "calibration_verdict", "PROBE_FAILED"]
+
+THRESHOLD_MS = float(os.environ.get("BENCH_CALIB_THRESHOLD_MS", "3.0"))
+# Sentinel for "the probe itself errored" — distinct from None (= CPU bench,
+# not time-shared, nothing to gate). A failed probe can never certify a
+# quiet chip, so it gates/stamps as a failure, not as a CPU run.
+PROBE_FAILED = -1.0
+
+
+def pin_platform_for(accelerator: "str | None") -> None:
+    """Pin ``jax_platforms=cpu`` for CPU-pinned benches BEFORE any backend
+    discovery (same guard as bench.py). No-op for accelerator=auto/tpu."""
+    if accelerator is not None and str(accelerator).lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def device_calibration_ms(accelerator: "str | None" = None) -> "float | None":
+    """Warm time of a fixed ~1 GFLOP matmul chain on the default accelerator.
+
+    Returns None for CPU benches (not time-shared, nothing to gate) and
+    :data:`PROBE_FAILED` when the probe itself errors."""
+    if accelerator is not None and str(accelerator).lower() == "cpu":
+        return None
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() == "cpu":
+            return None
+
+        @jax.jit
+        def chain(x):
+            for _ in range(8):
+                x = jnp.tanh(x @ x)
+            return x
+
+        x = jnp.ones((512, 512), jnp.bfloat16)
+        chain(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(5):
+            chain(x).block_until_ready()
+        return round((time.perf_counter() - t0) / 5 * 1e3, 2)
+    except Exception:
+        return PROBE_FAILED
+
+
+def _quiet(reading: "float | None") -> bool:
+    return reading is None or (reading != PROBE_FAILED and reading <= THRESHOLD_MS)
+
+
+def gate_quiet(accelerator: "str | None" = None) -> "float | None":
+    """Block until the chip is quiet; refuse if it never is.
+
+    Pins the platform for CPU benches, then probes up to
+    ``BENCH_CALIB_RETRIES`` + 1 times with ``BENCH_CALIB_WAIT_S`` sleeps.
+    Returns the passing reading (None on CPU); on exhaustion prints the
+    refusal and exits with status 3 unless ``BENCH_ALLOW_LOUD=1``.
+    """
+    pin_platform_for(accelerator)
+    retries = int(os.environ.get("BENCH_CALIB_RETRIES", "10"))
+    wait_s = float(os.environ.get("BENCH_CALIB_WAIT_S", "30"))
+    reading = device_calibration_ms(accelerator)
+    for attempt in range(retries + 1):
+        if _quiet(reading):
+            return reading
+        if attempt == retries:
+            break  # the last probe was checked — don't sleep again
+        print(
+            json.dumps(
+                {
+                    "calibration_wait": attempt + 1,
+                    "device_calibration_ms": reading,
+                    "threshold_ms": THRESHOLD_MS,
+                }
+            ),
+            file=sys.stderr,
+        )
+        time.sleep(wait_s)
+        reading = device_calibration_ms(accelerator)
+    if os.environ.get("BENCH_ALLOW_LOUD") == "1":
+        return reading
+    print(
+        f"chip never quieted: calibration {reading} ms > {THRESHOLD_MS} ms after {retries} retries "
+        "(set BENCH_ALLOW_LOUD=1 to record a loud run anyway)",
+        file=sys.stderr,
+    )
+    raise SystemExit(3)
+
+
+def calibration_verdict(pre: "float | None", post: "float | None") -> dict:
+    """The JSON fields every bench stamps next to its number."""
+    if pre is None and post is None:
+        return {"calibration": "cpu"}
+    readings = [r for r in (pre, post) if r is not None]
+    failed_probe = any(r == PROBE_FAILED for r in readings)
+    ok = not failed_probe and all(r <= THRESHOLD_MS for r in readings)
+    verdict = {
+        "device_calibration_ms": [pre, post],
+        "calibration_threshold_ms": THRESHOLD_MS,
+        "calibration": "pass" if ok else "FAIL",
+    }
+    if failed_probe:
+        verdict["calibration_probe_failed"] = True
+    return verdict

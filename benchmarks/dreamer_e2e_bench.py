@@ -11,7 +11,12 @@ is dm_control walker-walk from pixels via the named north-star overlay
 (`exp=dreamer_v3_dmc_walker_walk`): same S model config, same 64x64x3 pixel
 observation shape and replay machinery as the Atari-100K runs.
 
-    python benchmarks/dreamer_e2e_bench.py [policy_steps] [overrides...]
+    python benchmarks/dreamer_e2e_bench.py [atari|dmc] [policy_steps] [overrides...]
+
+``atari`` runs the Atari-100K shape on the deterministic ALE-protocol env
+(exp=dreamer_v3_100k_atari_dummy): frame-skip 4, life-loss episode
+structure, noop starts — the named benchmark's own dynamics. ``dmc`` (the
+default) keeps the dm_control walker-walk analogue.
 
 Reference context (BASELINE.md): DreamerV3 Crafter on a V100 does 1M frames
 in 1d3h (~10.3 env-frames/s); MsPacman-100K on an RTX 3080 does 100K frames
@@ -33,6 +38,15 @@ V100_FRAMES_PER_S = 1_000_000 / (27 * 3600)  # Crafter, README.md:37-44
 
 def main() -> None:
     args = sys.argv[1:]
+    # usage: dreamer_e2e_bench.py [atari|dmc] [policy_steps] [overrides...]
+    exp = "exp=dreamer_v3_dmc_walker_walk"
+    if args and args[0] in ("atari", "dmc"):
+        if args[0] == "atari":
+            # Atari's own episode/reset dynamics (frame-skip 4, life-loss
+            # resets, noop starts) on the deterministic ALE-protocol env —
+            # the named Atari-100K shape rather than the walker analogue.
+            exp = "exp=dreamer_v3_100k_atari_dummy"
+        args = args[1:]
     policy_steps = int(args[0]) if args and args[0].isdigit() else 2000
     overrides = args[1:] if args and args[0].isdigit() else args
 
@@ -52,7 +66,7 @@ def main() -> None:
 
     cfg = compose(
         [
-            "exp=dreamer_v3_dmc_walker_walk",
+            exp,
             "env.num_envs=1",
             "env.capture_video=False",
             f"algo.total_steps={policy_steps}",
@@ -74,10 +88,16 @@ def main() -> None:
     action_repeat = int(cfg.env.action_repeat)
     total_frames = int(cfg.algo.total_steps) * action_repeat
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from calibration import calibration_verdict, device_calibration_ms, gate_quiet
+
+    accel = str(cfg.fabric.get("accelerator", "auto"))
+    calib_pre = gate_quiet(accel)
     tic = time.perf_counter()
     check_configs(cfg)
     run_algorithm(cfg)
     elapsed = time.perf_counter() - tic
+    calib_post = device_calibration_ms(accel)
 
     frames_per_s = total_frames / elapsed
     print(
@@ -90,6 +110,7 @@ def main() -> None:
                 "elapsed_s": round(elapsed, 2),
                 "env_frames_per_sec": round(frames_per_s, 2),
                 "vs_v100_crafter_rate": round(frames_per_s / V100_FRAMES_PER_S, 2),
+                **calibration_verdict(calib_pre, calib_post),
             }
         )
     )

@@ -97,6 +97,10 @@ def main() -> None:
     jax.block_until_ready(params)
     compile_s = time.perf_counter() - t0
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from calibration import calibration_verdict, device_calibration_ms, gate_quiet
+
+    calib_pre = gate_quiet()
     t0 = time.perf_counter()
     for i in range(steps):
         params, opts, moments, _ = train_fn(params, opts, moments, data, key, jnp.int32(i + 1))
@@ -114,6 +118,7 @@ def main() -> None:
                 "compile_s": round(compile_s, 2),
                 "train_step_ms": round(per_step * 1e3, 2),
                 "replayed_frames_per_sec": round(frames / per_step, 1),
+                **calibration_verdict(calib_pre, device_calibration_ms()),
             }
         )
     )

@@ -15,7 +15,7 @@ from typing import List, Tuple
 import gymnasium as gym
 import numpy as np
 
-__all__ = ["ContinuousDummyEnv", "DiscreteDummyEnv", "MultiDiscreteDummyEnv"]
+__all__ = ["AtariProtocolDummyEnv", "ContinuousDummyEnv", "DiscreteDummyEnv", "MultiDiscreteDummyEnv"]
 
 
 class _CounterEnv(gym.Env):
@@ -105,3 +105,129 @@ class MultiDiscreteDummyEnv(_CounterEnv):
         dict_obs_space: bool = True,
     ):
         super().__init__(gym.spaces.MultiDiscrete(action_dims), image_size, vector_shape, n_steps, dict_obs_space)
+
+
+class AtariProtocolDummyEnv(gym.Env):
+    """Deterministic ALE-protocol stand-in (the Atari wheels are not
+    installable here): 210x160x3 uint8 raw frames, an 18-action ``Discrete``
+    space, deterministic noop starts, frame-skip with a 2-frame max-pool,
+    a 3-lives game-over episode structure and a scripted action-coupled
+    reward schedule — the preprocessing contract of
+    ``gymnasium.wrappers.AtariPreprocessing`` over an ALE ``*NoFrameskip-v4``
+    env (reference config: ``sheeprl/configs/env/atari.yaml``), so Dreamer
+    benchmarks carry Atari's episode/reset dynamics (frame-skip, life-loss
+    resets, long sparse episodes) without the ROMs.
+
+    Everything is a pure function of ``(seed, action sequence)``: frames are
+    a rolled gradient plus an action-driven sprite, a life ends every
+    ``life_len`` raw frames (jittered per life by the seed), and the episode
+    terminates at 0 lives (``terminal_on_life_loss=False`` protocol — life
+    losses are visible only through ``info["lives"]``).
+    """
+
+    RAW_SHAPE = (210, 160, 3)
+
+    def __init__(
+        self,
+        screen_size: int = 64,
+        frame_skip: int = 4,
+        grayscale: bool = False,
+        noop_max: int = 30,
+        lives: int = 3,
+        life_len: int = 500,
+        seed: int = 0,
+    ):
+        self.action_space = gym.spaces.Discrete(18)
+        channels = 1 if grayscale else 3
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(0, 255, (screen_size, screen_size, channels), np.uint8)}
+        )
+        self.reward_range = (-np.inf, np.inf)
+        self.frame_skip = int(frame_skip)  # checked by the factory: no double ActionRepeat
+        self._screen_size = int(screen_size)
+        self._grayscale = bool(grayscale)
+        self._noop_max = int(noop_max)
+        self._start_lives = int(lives)
+        self._life_len = int(life_len)
+        self._seed = int(seed)
+        # Procedural base frame: a fixed gradient texture the renderer rolls,
+        # computed once (a fresh 100KB pattern per frame would dominate step
+        # time without adding any protocol fidelity).
+        h, w, _ = self.RAW_SHAPE
+        y = np.arange(h, dtype=np.uint32)[:, None]
+        x = np.arange(w, dtype=np.uint32)[None, :]
+        base = np.stack([(y * 3 + x) % 251, (y + x * 5) % 241, (y * 7 ^ x) % 239], axis=-1)
+        self._base = base.astype(np.uint8)
+        self._t = 0  # raw frame counter within the episode
+        self._lives = self._start_lives
+        self._life_deadlines: List[int] = []
+        self._episode = 0
+
+    # -- deterministic pieces -------------------------------------------------
+    def _raw_frame(self, t: int, action: int) -> np.ndarray:
+        frame = np.roll(self._base, shift=(t * 2) % self.RAW_SHAPE[0], axis=0)
+        # action-driven 12x12 sprite: couples pixels to the policy so two
+        # different action sequences produce different observations
+        sy = (t * 5 + action * 17) % (self.RAW_SHAPE[0] - 12)
+        sx = (t * 3 + action * 29) % (self.RAW_SHAPE[1] - 12)
+        frame[sy : sy + 12, sx : sx + 12] = 255
+        # lives indicator row (mirrors the ALE score/lives strip)
+        frame[0:4] = 0
+        frame[0:4, : 16 * self._lives] = 200
+        return frame
+
+    def _deadlines(self) -> List[int]:
+        rng = np.random.default_rng(self._seed * 7919 + self._episode)
+        jitter = rng.integers(-self._life_len // 4, self._life_len // 4 + 1, size=self._start_lives)
+        return list(np.cumsum(self._life_len + jitter))
+
+    def _reward(self, t: int, action: int) -> float:
+        step_idx = t // self.frame_skip
+        return 1.0 if (step_idx % 13) == ((action * 5 + self._seed) % 13) else 0.0
+
+    def _observe(self, frames: List[np.ndarray]) -> dict:
+        import cv2
+
+        pooled = np.maximum(frames[-1], frames[-2]) if len(frames) >= 2 else frames[-1]
+        obs = cv2.resize(pooled, (self._screen_size, self._screen_size), interpolation=cv2.INTER_AREA)
+        if self._grayscale:
+            obs = cv2.cvtColor(obs, cv2.COLOR_RGB2GRAY)[..., None]
+        return {"rgb": np.asarray(obs, dtype=np.uint8)}
+
+    # -- gym surface ----------------------------------------------------------
+    def step(self, action):
+        action = int(action)
+        reward = 0.0
+        frames = []
+        terminated = False
+        for _ in range(self.frame_skip):
+            self._t += 1
+            reward += self._reward(self._t, action)
+            frames.append(self._raw_frame(self._t, action))
+            if self._life_deadlines and self._t >= self._life_deadlines[0]:
+                self._life_deadlines.pop(0)
+                self._lives -= 1
+                reward += 10.0  # end-of-life bonus keeps returns non-trivial
+                if self._lives <= 0:
+                    terminated = True
+                    break
+        return self._observe(frames), reward, terminated, False, {"lives": self._lives}
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._seed = int(seed)
+        self._episode += 1
+        self._t = 0
+        self._lives = self._start_lives
+        self._life_deadlines = self._deadlines()
+        # deterministic noop start (protocol: up to noop_max noop frames)
+        noops = (self._seed * 31 + self._episode * 13) % (self._noop_max + 1)
+        frames = [self._raw_frame(t, 0) for t in range(max(1, noops))]
+        self._t = max(0, noops - 1)
+        return self._observe(frames[-2:]), {"lives": self._lives}
+
+    def render(self):
+        return self._raw_frame(self._t, 0)
+
+    def close(self):
+        pass

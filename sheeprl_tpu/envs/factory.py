@@ -189,7 +189,12 @@ def make_env(
             wrapper_cfg["rank"] = rank + vector_env_idx
         env = instantiate(wrapper_cfg)
 
-        if cfg.env.action_repeat > 1 and "atari" not in str(env_spec):
+        # Atari-protocol envs (AtariPreprocessing, AtariProtocolDummyEnv)
+        # implement frame-skip themselves — stacking ActionRepeat on top
+        # would square the repeat (reference guard: ``env.py``'s env_spec
+        # check; the attribute covers envs gym.spec cannot resolve).
+        built_in_skip = int(getattr(env, "frame_skip", 1) or 1)
+        if cfg.env.action_repeat > 1 and "atari" not in str(env_spec) and built_in_skip <= 1:
             env = ActionRepeat(env, cfg.env.action_repeat)
 
         if cfg.env.get("mask_velocities", False):

@@ -1,0 +1,112 @@
+"""AtariProtocolDummyEnv: the deterministic ALE-protocol stand-in used by
+the Dreamer benchmarks (frame-skip + 2-frame max-pool, 3-lives game-over
+episodes, noop starts, scripted rewards). These tests pin the protocol
+surface so the bench env cannot silently drift from Atari's dynamics."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import AtariProtocolDummyEnv
+
+
+def _rollout(env, actions, seed=3):
+    obs, info = env.reset(seed=seed)
+    frames, rewards, lives = [obs["rgb"]], [], [info["lives"]]
+    terminated = False
+    for a in actions:
+        obs, r, terminated, truncated, info = env.step(a)
+        frames.append(obs["rgb"])
+        rewards.append(r)
+        lives.append(info["lives"])
+        if terminated:
+            break
+    return frames, rewards, lives, terminated
+
+
+def test_protocol_surface():
+    env = AtariProtocolDummyEnv(screen_size=64, frame_skip=4)
+    assert env.action_space.n == 18
+    assert env.frame_skip == 4
+    obs, info = env.reset(seed=0)
+    assert obs["rgb"].shape == (64, 64, 3) and obs["rgb"].dtype == np.uint8
+    assert info["lives"] == 3
+    obs, r, term, trunc, info = env.step(5)
+    assert obs["rgb"].shape == (64, 64, 3)
+    assert isinstance(r, float) and not trunc
+
+
+def test_grayscale_channel():
+    env = AtariProtocolDummyEnv(screen_size=64, grayscale=True)
+    obs, _ = env.reset(seed=0)
+    assert obs["rgb"].shape == (64, 64, 1)
+
+
+def test_deterministic_given_seed_and_actions():
+    actions = [int(a) for a in np.random.default_rng(0).integers(0, 18, 200)]
+    f1, r1, l1, t1 = _rollout(AtariProtocolDummyEnv(), actions)
+    f2, r2, l2, t2 = _rollout(AtariProtocolDummyEnv(), actions)
+    assert r1 == r2 and l1 == l2 and t1 == t2
+    np.testing.assert_array_equal(f1[-1], f2[-1])
+
+
+def test_actions_change_observations_and_rewards():
+    a_seq = [3] * 50
+    b_seq = [11] * 50
+    fa, ra, _, _ = _rollout(AtariProtocolDummyEnv(), a_seq)
+    fb, rb, _, _ = _rollout(AtariProtocolDummyEnv(), b_seq)
+    assert not np.array_equal(fa[10], fb[10])
+    assert ra != rb  # the scripted schedule is action-coupled
+
+
+def test_life_loss_structure_then_game_over():
+    env = AtariProtocolDummyEnv(life_len=40, frame_skip=4)
+    _, _, lives, terminated = _rollout(env, [0] * 200)
+    assert terminated
+    # lives only ever decrease, hitting 0 exactly at termination
+    assert lives[0] == 3 and lives[-1] == 0
+    assert all(b <= a for a, b in zip(lives, lives[1:]))
+    # life losses are spread across the episode, not front-loaded
+    assert lives.index(2) >= 2
+
+
+def test_episode_length_varies_per_episode():
+    """Noop starts + per-life jitter give Atari-like variable episode
+    lengths across resets (the dynamics walker-walk benches lack)."""
+    env = AtariProtocolDummyEnv(life_len=40)
+    lengths = []
+    for _ in range(3):
+        _, rewards, _, term = _rollout(env, [2] * 300, seed=None)
+        assert term
+        lengths.append(len(rewards))
+    assert len(set(lengths)) > 1
+
+
+def test_factory_pipeline_no_double_action_repeat(tmp_path):
+    """Through the real factory + atari_dummy config: the env's built-in
+    frame-skip must NOT be wrapped in another ActionRepeat, and the pixel
+    pipeline must deliver channel-last 64x64 uint8."""
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.envs.wrappers import ActionRepeat
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=atari_dummy",
+            "env.capture_video=False",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.decoder=[]",
+        ]
+    )
+    env = make_env(cfg, seed=7, rank=0)()
+    inner = env
+    while hasattr(inner, "env"):
+        assert not isinstance(inner, ActionRepeat), "frame-skip applied twice"
+        inner = inner.env
+    obs, _ = env.reset(seed=7)
+    assert obs["rgb"].shape == (64, 64, 3) and obs["rgb"].dtype == np.uint8
+    obs, r, term, trunc, info = env.step(np.int64(4))
+    assert obs["rgb"].shape == (64, 64, 3)
+    env.close()
