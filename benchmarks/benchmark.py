@@ -48,7 +48,7 @@ def main() -> None:
     cfg = compose([f"exp={algo}_benchmarks", *overrides])
     total_steps = int(cfg.algo.total_steps)
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # the script dir is sys.path[0] when run as `python benchmarks/<script>.py`
     from calibration import calibration_verdict, device_calibration_ms, gate_quiet
 
     # Refuse to measure a loud chip; stamp pre/post readings + verdict so a

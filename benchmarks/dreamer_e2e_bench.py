@@ -88,7 +88,7 @@ def main() -> None:
     action_repeat = int(cfg.env.action_repeat)
     total_frames = int(cfg.algo.total_steps) * action_repeat
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # the script dir is sys.path[0] when run as `python benchmarks/<script>.py`
     from calibration import calibration_verdict, device_calibration_ms, gate_quiet
 
     accel = str(cfg.fabric.get("accelerator", "auto"))

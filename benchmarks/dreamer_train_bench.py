@@ -97,7 +97,7 @@ def main() -> None:
     jax.block_until_ready(params)
     compile_s = time.perf_counter() - t0
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # the script dir is sys.path[0] when run as `python benchmarks/<script>.py`
     from calibration import calibration_verdict, device_calibration_ms, gate_quiet
 
     calib_pre = gate_quiet()

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Decode a trained Dreamer-V3 world model's imagination.
+
+The TPU-framework port of the reference's capability demo
+(``notebooks/dreamer_v3_imagination.ipynb:1-452``): load a checkpoint, play
+``--initial-steps`` env steps with the real player while recording the
+latent states, then — starting ``--imagination-steps`` before the end —
+roll the world model forward in pure imagination (actor-sampled or replayed
+actions) and decode every latent back to pixels. Writes three GIFs plus a
+side-by-side PNG strip:
+
+- ``real.gif``            the frames the environment actually produced
+- ``reconstructed.gif``   decoder(representation-model latents) — how well
+                          the world model *encodes* what it saw
+- ``imagination.gif``     decoder(transition-model rollout) — what the
+                          world model *predicts* with no observations
+- ``strip.png``           the three rows side by side for a quick look
+
+Usage::
+
+    python examples/dreamer_v3_imagination.py <ckpt.ckpt> [--out DIR]
+        [--initial-steps 200] [--imagination-steps 45] [--replay-actions]
+
+Works with any Dreamer-V3 checkpoint that has a pixel decoder (the
+``rgb`` key), e.g. one produced by the test suite or
+``exp=dreamer_v3_100k_atari_dummy``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("checkpoint", type=pathlib.Path)
+    ap.add_argument("--out", type=pathlib.Path, default=pathlib.Path("imagination_out"))
+    ap.add_argument("--initial-steps", type=int, default=200)
+    ap.add_argument("--imagination-steps", type=int, default=45)
+    ap.add_argument(
+        "--replay-actions",
+        action="store_true",
+        help="feed the actions the agent actually took instead of sampling from the actor",
+    )
+    ap.add_argument("--cpu", action="store_true", help="pin JAX to the host CPU")
+    args = ap.parse_args()
+    if args.imagination_steps > args.initial_steps:
+        raise SystemExit("--imagination-steps must be <= --initial-steps")
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import gymnasium as gym
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs
+    from sheeprl_tpu.config import dotdict, load_yaml
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    ckpt = args.checkpoint.absolute()
+    cfg = dotdict(load_yaml(ckpt.parent.parent / "config.yaml"))
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = False
+    state = load_state(ckpt)
+
+    fabric = Fabric(devices=1)
+    env = make_env(cfg, cfg.seed, 0, None, "imagination")()
+    action_space = env.action_space
+    observation_space = env.observation_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape if is_continuous else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    if "rgb" not in cfg.algo.cnn_keys.decoder:
+        raise SystemExit("checkpoint has no rgb decoder — nothing to visualize")
+
+    world_model, actor, critic, params, player = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        gym.spaces.Dict(observation_space.spaces),
+        state["world_model"],
+        state["actor"],
+        state["critic"],
+        state["target_critic"],
+    )
+    rssm = world_model.rssm
+    wmp = params["world_model"]
+
+    decode = jax.jit(lambda latent: world_model.decode(wmp, latent)["rgb"])
+    imagine = jax.jit(lambda prior, rec, act, key: rssm.imagination(wmp, prior, rec, act, key))
+    from sheeprl_tpu.algos.dreamer_v3.agent import actor_sample
+
+    act_fn = jax.jit(
+        lambda latent, key: jnp.concatenate(actor_sample(actor, params["actor"], latent, key)[0], axis=-1)
+    )
+
+    # -- play: record frames + the player's latent trajectory ----------------
+    rng = jax.random.PRNGKey(cfg.seed)
+    player.init_states(params)
+    obs = env.reset(seed=cfg.seed)[0]
+    real_frames, recs, stochs, acts = [], [], [], []
+    for _ in range(args.initial_steps):
+        jobs = prepare_obs(fabric, {k: np.asarray(v) for k, v in obs.items()}, cnn_keys=cnn_keys, num_envs=1)
+        rng, key = jax.random.split(rng)
+        action_list = player.get_actions(params, jobs, key)
+        actions = np.asarray(jnp.concatenate(action_list, axis=-1))
+        if is_continuous:
+            real_actions = actions.reshape(action_space.shape)
+        else:
+            real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in action_list], axis=-1).squeeze()
+        recs.append(np.asarray(player.recurrent_state))
+        stochs.append(np.asarray(player.stochastic_state))
+        acts.append(actions)
+        real_frames.append(np.asarray(obs["rgb"]))
+        obs, reward, terminated, truncated, info = env.step(real_actions)
+        if terminated or truncated:
+            obs = env.reset()[0]
+            player.init_states(params, [0])
+    env.close()
+
+    start = args.initial_steps - args.imagination_steps
+
+    # -- reconstruction: decode the REPRESENTATION latents the player saw ----
+    recon_frames = []
+    for i in range(start, args.initial_steps):
+        latent = jnp.concatenate([jnp.asarray(stochs[i]), jnp.asarray(recs[i])], axis=-1)
+        recon_frames.append(np.asarray(decode(latent))[0])
+
+    # -- imagination: roll the TRANSITION model forward, no observations -----
+    imag_frames = []
+    prior = jnp.asarray(stochs[start])
+    rec = jnp.asarray(recs[start])
+    for i in range(args.imagination_steps):
+        latent = jnp.concatenate([prior, rec], axis=-1)
+        if args.replay_actions:
+            action = jnp.asarray(acts[start + i])
+        else:
+            rng, key = jax.random.split(rng)
+            action = act_fn(latent, key)
+        rng, key = jax.random.split(rng)
+        prior, rec = imagine(prior, rec, action, key)
+        imag_frames.append(np.asarray(decode(jnp.concatenate([prior, rec], axis=-1)))[0])
+
+    # -- render ---------------------------------------------------------------
+    def to_uint8(frame: np.ndarray) -> np.ndarray:
+        # decoder output is in [-0.5, 0.5] pixel space; real frames are uint8
+        if frame.dtype == np.uint8:
+            return frame
+        return np.clip((frame + 0.5) * 255.0, 0, 255).astype(np.uint8)
+
+    def save_gif(path: pathlib.Path, frames) -> None:
+        imgs = [Image.fromarray(to_uint8(f)) for f in frames]
+        imgs[0].save(path, format="GIF", append_images=imgs[1:], save_all=True, duration=100, loop=0)
+
+    args.out.mkdir(parents=True, exist_ok=True)
+    real_window = real_frames[start : args.initial_steps]
+    save_gif(args.out / "real.gif", real_window)
+    save_gif(args.out / "reconstructed.gif", recon_frames)
+    save_gif(args.out / "imagination.gif", imag_frames)
+
+    # PNG strip: rows = real / reconstructed / imagined, every 5th frame
+    cols = [
+        np.concatenate([to_uint8(real_window[i]), to_uint8(recon_frames[i]), to_uint8(imag_frames[i])], axis=0)
+        for i in range(0, args.imagination_steps, max(1, args.imagination_steps // 9))
+    ]
+    Image.fromarray(np.concatenate(cols, axis=1)).save(args.out / "strip.png")
+    print(f"wrote {args.out}/real.gif, reconstructed.gif, imagination.gif, strip.png")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""What ``replay_ratio`` means in practice (reference: ``examples/ratio.py``).
+
+The ``Ratio`` governor grants gradient steps so that, over the whole run,
+``gradient_steps / policy_steps`` converges to the configured replay ratio —
+regardless of ``num_envs``/``world_size`` chunking. This script simulates a
+run and prints when training fires and the realized ratio, plus the
+equivalent Hafner-style "train ratio" (gradient steps x replayed frames per
+step).
+
+    python examples/ratio.py [replay_ratio]
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from sheeprl_tpu.utils.utils import Ratio
+
+if __name__ == "__main__":
+    replay_ratio = float(sys.argv[1]) if len(sys.argv) > 1 else 0.0625
+    num_envs = 1
+    world_size = 1
+    per_rank_batch_size = 16
+    per_rank_sequence_length = 64
+    replayed_steps = world_size * per_rank_batch_size * per_rank_sequence_length
+    gradient_steps = 0
+    total_policy_steps = 2**10
+    r = Ratio(ratio=replay_ratio, pretrain_steps=0)
+    policy_steps_per_iter = num_envs * world_size
+    for i in range(0, total_policy_steps, policy_steps_per_iter):
+        if i >= 128:  # learning_starts
+            per_rank_repeats = r(i / world_size)
+            if per_rank_repeats > 0:
+                print(
+                    f"Training the agent with {per_rank_repeats} repeats on every rank "
+                    f"({per_rank_repeats * world_size} global repeats) at global iteration {i}"
+                )
+            gradient_steps += per_rank_repeats * world_size
+    print("Replay ratio", replay_ratio)
+    print("Hafner train ratio", replay_ratio * replayed_steps)
+    print("Final ratio", gradient_steps / total_policy_steps)

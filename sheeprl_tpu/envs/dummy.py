@@ -126,6 +126,7 @@ class AtariProtocolDummyEnv(gym.Env):
     """
 
     RAW_SHAPE = (210, 160, 3)
+    render_mode = "rgb_array"  # render() returns the raw frame; RecordVideo-compatible
 
     def __init__(
         self,
@@ -215,8 +216,13 @@ class AtariProtocolDummyEnv(gym.Env):
 
     def reset(self, seed=None, options=None):
         if seed is not None:
+            # gym seeding semantics: an explicit seed restarts the episode
+            # stream, so reset(seed=S) on a USED env replays the same episode
+            # a fresh env would produce (repro harnesses re-seed in place).
             self._seed = int(seed)
-        self._episode += 1
+            self._episode = 1
+        else:
+            self._episode += 1
         self._t = 0
         self._lives = self._start_lives
         self._life_deadlines = self._deadlines()
