@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.a2c.agent import build_agent, forward_with_actions
@@ -26,6 +27,7 @@ from sheeprl_tpu.algos.a2c.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.ops import gae as gae_op
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -86,7 +88,7 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int):
 
         zero = jax.tree.map(jnp.zeros_like, params)
         grads, losses = jax.lax.scan(body, zero, (batches, mb_weights))
-        grads = jax.lax.pmean(grads, "dp")
+        grads = pmean_grads(grads, "dp")
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         pg, v = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)

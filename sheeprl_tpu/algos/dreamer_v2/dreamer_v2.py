@@ -40,6 +40,7 @@ from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_ob
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.ring import build_burst_train_step
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal, OneHotCategorical
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
@@ -159,7 +160,7 @@ def make_train_step(
 
         (rec_loss, wm_aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
         recs, posts, post_logits, prior_logits, kl, state_loss, reward_loss, observation_loss, continue_loss = wm_aux
-        wm_grads = jax.lax.pmean(wm_grads, "dp")
+        wm_grads = pmean_grads(wm_grads, "dp")
         wupd, opts["world"] = txs["world"].update(wm_grads, opts["world"], params["world_model"])
         params = {**params, "world_model": optax.apply_updates(params["world_model"], wupd)}
 
@@ -230,7 +231,7 @@ def make_train_step(
         (policy_loss, (traj_sg, lambda_sg, discount)), actor_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
         )(params["actor"])
-        actor_grads = jax.lax.pmean(actor_grads, "dp")
+        actor_grads = pmean_grads(actor_grads, "dp")
         aupd, opts["actor"] = txs["actor"].update(actor_grads, opts["actor"], params["actor"])
         params = {**params, "actor": optax.apply_updates(params["actor"], aupd)}
 
@@ -240,7 +241,7 @@ def make_train_step(
             return -jnp.mean(discount[:-1, ..., 0] * qv.log_prob(lambda_sg))
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
-        critic_grads = jax.lax.pmean(critic_grads, "dp")
+        critic_grads = pmean_grads(critic_grads, "dp")
         cupd, opts["critic"] = txs["critic"].update(critic_grads, opts["critic"], params["critic"])
         params = {**params, "critic": optax.apply_updates(params["critic"], cupd)}
 

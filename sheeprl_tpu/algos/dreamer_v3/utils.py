@@ -49,7 +49,9 @@ def moments_update(
     (``utils.py:56-62``)."""
     x = jax.lax.stop_gradient(x).astype(jnp.float32)
     if axis_name is not None:
-        x = jax.lax.all_gather(x, axis_name)
+        from sheeprl_tpu.parallel.comm import all_gather_wire
+
+        x = all_gather_wire(x, axis_name)
     x = x.reshape(-1)
     low = jnp.quantile(x, percentile_low)
     high = jnp.quantile(x, percentile_high)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.droq.agent import DROQAgent, build_agent
@@ -33,6 +34,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -62,7 +64,7 @@ def make_train_step(agent: DROQAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh):
             return critic_loss(q, td_target, agent.critic.n)
 
         qf_loss, cgrads = jax.value_and_grad(c_loss)(params["critic"])
-        cgrads = jax.lax.pmean(cgrads, "dp")
+        cgrads = pmean_grads(cgrads, "dp")
         cupd, copt = critic_tx.update(cgrads, copt, params["critic"])
         params = {**params, "critic": optax.apply_updates(params["critic"], cupd)}
         # EMA after every critic update (reference: droq.py:116-118)
@@ -88,7 +90,7 @@ def make_train_step(agent: DROQAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh):
             return policy_loss(alpha, logp, mean_q), logp
 
         (actor_loss, logp), agrads = jax.value_and_grad(a_loss, has_aux=True)(params["actor"])
-        agrads = jax.lax.pmean(agrads, "dp")
+        agrads = pmean_grads(agrads, "dp")
         aupd, aopt = actor_tx.update(agrads, aopt, params["actor"])
         params = {**params, "actor": optax.apply_updates(params["actor"], aupd)}
 
@@ -96,7 +98,7 @@ def make_train_step(agent: DROQAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh):
             return entropy_loss(la, jax.lax.stop_gradient(logp), target_entropy)
 
         alpha_loss, lgrads = jax.value_and_grad(l_loss)(params["log_alpha"])
-        lgrads = jax.lax.pmean(lgrads, "dp")
+        lgrads = pmean_grads(lgrads, "dp")
         lupd, lopt = alpha_tx.update(lgrads, lopt, params["log_alpha"])
         params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
 

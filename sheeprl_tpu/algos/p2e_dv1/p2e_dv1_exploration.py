@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, ensembles_apply
 from sheeprl_tpu.algos.p2e_dv1.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
@@ -134,7 +135,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
 
         (rec_loss, wm_aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
         recs, posts, embedded, kl, state_loss, reward_loss, observation_loss, continue_loss, post_ent, prior_ent = wm_aux
-        wm_grads = jax.lax.pmean(wm_grads, "dp")
+        wm_grads = pmean_grads(wm_grads, "dp")
         wupd, opts["world"] = txs["world"].update(wm_grads, opts["world"], params["world_model"])
         params = {**params, "world_model": optax.apply_updates(params["world_model"], wupd)}
         metrics.update(
@@ -170,7 +171,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
             return per_member.sum()
 
         ens_loss, ens_grads = jax.value_and_grad(ens_loss_fn)(params["ensembles"])
-        ens_grads = jax.lax.pmean(ens_grads, "dp")
+        ens_grads = pmean_grads(ens_grads, "dp")
         eupd, opts["ensembles"] = txs["ensembles"].update(ens_grads, opts["ensembles"], params["ensembles"])
         params = {**params, "ensembles": optax.apply_updates(params["ensembles"], eupd)}
         metrics["Loss/ensemble_loss"] = ens_loss
@@ -208,7 +209,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
         (policy_loss_expl, (traj_sg, lambda_sg, discount, intr_mean)), a_grads = jax.value_and_grad(
             expl_actor_loss_fn, has_aux=True
         )(params["actor_exploration"])
-        a_grads = jax.lax.pmean(a_grads, "dp")
+        a_grads = pmean_grads(a_grads, "dp")
         aupd, opts["actor_exploration"] = txs["actor_exploration"].update(
             a_grads, opts["actor_exploration"], params["actor_exploration"]
         )
@@ -221,7 +222,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
             return critic_loss(qv, lambda_sg, discount[..., 0])
 
         vloss_expl, c_grads = jax.value_and_grad(expl_critic_loss_fn)(params["critic_exploration"])
-        c_grads = jax.lax.pmean(c_grads, "dp")
+        c_grads = pmean_grads(c_grads, "dp")
         cupd, opts["critic_exploration"] = txs["critic_exploration"].update(
             c_grads, opts["critic_exploration"], params["critic_exploration"]
         )
@@ -248,7 +249,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
         (policy_loss_task, (traj_sg_t, lambda_sg_t, discount_t)), at_grads = jax.value_and_grad(
             task_actor_loss_fn, has_aux=True
         )(params["actor_task"])
-        at_grads = jax.lax.pmean(at_grads, "dp")
+        at_grads = pmean_grads(at_grads, "dp")
         atupd, opts["actor_task"] = txs["actor_task"].update(at_grads, opts["actor_task"], params["actor_task"])
         params = {**params, "actor_task": optax.apply_updates(params["actor_task"], atupd)}
         metrics["Loss/policy_loss_task"] = policy_loss_task
@@ -258,7 +259,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
             return critic_loss(qv, lambda_sg_t, discount_t[..., 0])
 
         vloss_task, ct_grads = jax.value_and_grad(task_critic_loss_fn)(params["critic_task"])
-        ct_grads = jax.lax.pmean(ct_grads, "dp")
+        ct_grads = pmean_grads(ct_grads, "dp")
         ctupd, opts["critic_task"] = txs["critic_task"].update(ct_grads, opts["critic_task"], params["critic_task"])
         params = {**params, "critic_task": optax.apply_updates(params["critic_task"], ctupd)}
         metrics["Loss/value_loss_task"] = vloss_task

@@ -52,6 +52,7 @@ from sheeprl_tpu.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -165,7 +166,7 @@ def make_train_step(
             return jnp.mean(vloss * discount[:-1, ..., 0])
 
         vloss, grads = jax.value_and_grad(loss_fn)(params_c)
-        grads = jax.lax.pmean(grads, "dp")
+        grads = pmean_grads(grads, "dp")
         upd, opt_c = tx.update(grads, opt_c, params_c)
         return vloss, optax.apply_updates(params_c, upd), opt_c
 
@@ -235,7 +236,7 @@ def make_train_step(
 
         (rec_loss, wm_aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
         recs, posts, post_logits, prior_logits, kl, state_loss, reward_loss, observation_loss, continue_loss = wm_aux
-        wm_grads = jax.lax.pmean(wm_grads, "dp")
+        wm_grads = pmean_grads(wm_grads, "dp")
         wupd, opts["world"] = txs["world"].update(wm_grads, opts["world"], params["world_model"])
         params = {**params, "world_model": optax.apply_updates(params["world_model"], wupd)}
         metrics.update(
@@ -268,7 +269,7 @@ def make_train_step(
             return per_member.sum()
 
         ens_loss, ens_grads = jax.value_and_grad(ens_loss_fn)(params["ensembles"])
-        ens_grads = jax.lax.pmean(ens_grads, "dp")
+        ens_grads = pmean_grads(ens_grads, "dp")
         eupd, opts["ensembles"] = txs["ensembles"].update(ens_grads, opts["ensembles"], params["ensembles"])
         params = {**params, "ensembles": optax.apply_updates(params["ensembles"], eupd)}
         metrics["Loss/ensemble_loss"] = ens_loss
@@ -334,7 +335,7 @@ def make_train_step(
             )
         )
         moments_state = {**moments_state, "exploration": m_expl}
-        a_grads = jax.lax.pmean(a_grads, "dp")
+        a_grads = pmean_grads(a_grads, "dp")
         aupd, opts["actor_exploration"] = txs["actor_exploration"].update(
             a_grads, opts["actor_exploration"], params["actor_exploration"]
         )
@@ -390,7 +391,7 @@ def make_train_step(
             task_actor_loss_fn, has_aux=True
         )(params["actor_task"], moments_state["task"])
         moments_state = {**moments_state, "task": m_task}
-        at_grads = jax.lax.pmean(at_grads, "dp")
+        at_grads = pmean_grads(at_grads, "dp")
         atupd, opts["actor_task"] = txs["actor_task"].update(at_grads, opts["actor_task"], params["actor_task"])
         params = {**params, "actor_task": optax.apply_updates(params["actor_task"], atupd)}
         metrics["Loss/policy_loss_task"] = policy_loss_task

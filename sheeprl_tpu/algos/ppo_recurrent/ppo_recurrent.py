@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, forward_with_actions
@@ -39,6 +40,7 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import chunk_sequences, prepare_obs, 
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.ops import gae as gae_op
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -109,7 +111,7 @@ def make_train_step(agent, tx, cfg, mesh, s_local: int):
             return pg + vf_coef * v + ent_coef * ent, (pg, v, ent)
 
         (_, (pg, v, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        grads = jax.lax.pmean(grads, "dp")
+        grads = pmean_grads(grads, "dp")
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state, clip_coef, ent_coef), (pg, v, ent)

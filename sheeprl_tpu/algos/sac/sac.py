@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.sac.agent import SACAgent, build_agent
@@ -35,6 +36,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -71,7 +73,7 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
             return critic_loss(q, td_target, agent.critic.n)
 
         qf_loss, cgrads = jax.value_and_grad(c_loss)(params["critic"])
-        cgrads = jax.lax.pmean(cgrads, "dp")
+        cgrads = pmean_grads(cgrads, "dp")
         cupd, copt = critic_tx.update(cgrads, copt, params["critic"])
         params = {**params, "critic": optax.apply_updates(params["critic"], cupd)}
 
@@ -88,7 +90,7 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
             return policy_loss(alpha, logp, min_q), logp
 
         (actor_loss, logp), agrads = jax.value_and_grad(a_loss, has_aux=True)(params["actor"])
-        agrads = jax.lax.pmean(agrads, "dp")
+        agrads = pmean_grads(agrads, "dp")
         aupd, aopt = actor_tx.update(agrads, aopt, params["actor"])
         params = {**params, "actor": optax.apply_updates(params["actor"], aupd)}
 
@@ -97,7 +99,7 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
             return entropy_loss(la, jax.lax.stop_gradient(logp), target_entropy)
 
         alpha_loss, lgrads = jax.value_and_grad(l_loss)(params["log_alpha"])
-        lgrads = jax.lax.pmean(lgrads, "dp")
+        lgrads = pmean_grads(lgrads, "dp")
         lupd, lopt = alpha_tx.update(lgrads, lopt, params["log_alpha"])
         params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
 
@@ -184,7 +186,7 @@ def make_burst_train_step(
             return critic_loss(q, td_target, agent.critic.n)
 
         qf_loss, cgrads = jax.value_and_grad(c_loss)(params["critic"])
-        cgrads = jax.lax.pmean(cgrads, "dp")
+        cgrads = pmean_grads(cgrads, "dp")
         cupd, copt = critic_tx.update(cgrads, copt, params["critic"])
         params = {**params, "critic": optax.apply_updates(params["critic"], cupd)}
         params = {**params, "target_critic": agent.ema(params["critic"], params["target_critic"], ema_flag)}
@@ -197,7 +199,7 @@ def make_burst_train_step(
             return policy_loss(alpha, logp, jnp.min(q, axis=-1, keepdims=True)), logp
 
         (actor_loss, logp), agrads = jax.value_and_grad(a_loss, has_aux=True)(params["actor"])
-        agrads = jax.lax.pmean(agrads, "dp")
+        agrads = pmean_grads(agrads, "dp")
         aupd, aopt = actor_tx.update(agrads, aopt, params["actor"])
         params = {**params, "actor": optax.apply_updates(params["actor"], aupd)}
 
@@ -205,7 +207,7 @@ def make_burst_train_step(
             return entropy_loss(la, jax.lax.stop_gradient(logp), target_entropy)
 
         alpha_loss, lgrads = jax.value_and_grad(l_loss)(params["log_alpha"])
-        lgrads = jax.lax.pmean(lgrads, "dp")
+        lgrads = pmean_grads(lgrads, "dp")
         lupd, lopt = alpha_tx.update(lgrads, lopt, params["log_alpha"])
         params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
 

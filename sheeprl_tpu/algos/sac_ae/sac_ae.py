@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
@@ -36,6 +37,7 @@ from sheeprl_tpu.algos.sac_ae.agent import SACAEAgent, build_agent
 from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -81,7 +83,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, Any], cfg, mesh):
 
         critic_params = {"encoder": params["encoder"], "qfs": params["qfs"]}
         qf_loss, cgrads = jax.value_and_grad(c_loss)(critic_params)
-        cgrads = jax.lax.pmean(cgrads, "dp")
+        cgrads = pmean_grads(cgrads, "dp")
         cupd, opts["qf"] = txs["qf"].update(cgrads, opts["qf"], critic_params)
         params = {**params, **optax.apply_updates(critic_params, cupd)}
 
@@ -102,7 +104,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, Any], cfg, mesh):
 
             actor_params = {"actor": params["actor"], "actor_enc_head": params["actor_enc_head"]}
             (actor_loss, logp), agrads = jax.value_and_grad(a_loss, has_aux=True)(actor_params)
-            agrads = jax.lax.pmean(agrads, "dp")
+            agrads = pmean_grads(agrads, "dp")
             aupd, aopt = txs["actor"].update(agrads, aopt, actor_params)
             params = {**params, **optax.apply_updates(actor_params, aupd)}
 
@@ -110,7 +112,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, Any], cfg, mesh):
                 return entropy_loss(la, jax.lax.stop_gradient(logp), target_entropy)
 
             alpha_loss, lgrads = jax.value_and_grad(l_loss)(params["log_alpha"])
-            lgrads = jax.lax.pmean(lgrads, "dp")
+            lgrads = pmean_grads(lgrads, "dp")
             lupd, lopt = txs["alpha"].update(lgrads, lopt, params["log_alpha"])
             params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
             return (params, aopt, lopt), actor_loss, alpha_loss
@@ -142,7 +144,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, Any], cfg, mesh):
 
             ed_params = {"encoder": params["encoder"], "decoder": params["decoder"]}
             rec_loss, grads = jax.value_and_grad(r_loss)(ed_params)
-            grads = jax.lax.pmean(grads, "dp")
+            grads = pmean_grads(grads, "dp")
             eupd, eopt = txs["encoder"].update({"e": grads["encoder"]}, eopt, {"e": ed_params["encoder"]})
             dupd, dopt = txs["decoder"].update({"d": grads["decoder"]}, dopt, {"d": ed_params["decoder"]})
             params = {

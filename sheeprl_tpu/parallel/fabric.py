@@ -245,6 +245,11 @@ class Fabric:
     # -- factory -------------------------------------------------------------
     @classmethod
     def from_config(cls, fabric_cfg: Mapping[str, Any], callbacks: Optional[Sequence[Any]] = None) -> "Fabric":
+        from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
+
+        # Process-wide gradient-collective wire dtype; must land before any
+        # train step traces (see parallel/comm.py).
+        set_grad_reduce_dtype(fabric_cfg.get("grad_reduce_dtype", "float32"))
         return cls(
             devices=fabric_cfg.get("devices", "auto"),
             accelerator=fabric_cfg.get("accelerator", "auto"),

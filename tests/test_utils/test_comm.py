@@ -1,0 +1,111 @@
+"""fabric.grad_reduce_dtype: the bf16 gradient-collective wire dtype
+(parallel/comm.py). The bf16 path must (a) actually reduce in bf16 — halving
+the dominant DP collective's bytes, the point of the knob — while returning
+f32 grads close to the exact mean, and (b) train end-to-end through a real
+main on a 2-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.parallel.comm import get_grad_reduce_dtype, pmean_grads, set_grad_reduce_dtype
+from sheeprl_tpu.parallel.fabric import Fabric
+
+
+@pytest.fixture(autouse=True)
+def _restore_dtype():
+    yield
+    set_grad_reduce_dtype("float32")
+
+
+def _reduce(tree):
+    fabric = Fabric(devices=2)
+
+    def body(t):
+        return pmean_grads(t, "dp")
+
+    fn = jax.jit(
+        jax.shard_map(body, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+    )
+    return fn(tree)
+
+
+def test_f32_default_is_exact_mean():
+    set_grad_reduce_dtype("float32")
+    x = jnp.asarray(np.stack([np.full((3,), 1.0, np.float32), np.full((3,), 3.0, np.float32)]))
+    out = _reduce({"g": x})
+    np.testing.assert_allclose(np.asarray(out["g"]), 2.0)
+
+
+def test_bf16_reduces_on_the_wire_but_returns_f32():
+    set_grad_reduce_dtype("bfloat16")
+    assert get_grad_reduce_dtype() == jnp.bfloat16
+    x = jnp.asarray(np.stack([np.full((64,), 1.0, np.float32), np.full((64,), 3.0, np.float32)]))
+    out = _reduce({"g": x})
+    assert out["g"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["g"]), 2.0, rtol=1e-2)
+
+    # The wire-dtype cast must be emitted ahead of the collective. On TPU
+    # the all-reduce itself then runs in bf16; XLA:CPU *promotes* bf16
+    # all-reduces to f32 (no native bf16 reduction on host), so on this
+    # backend we assert the bf16 converts feeding the collective instead —
+    # the dtype decision is made at trace time, the promotion at lowering.
+    def body(t):
+        return pmean_grads(t, "dp")
+
+    fabric = Fabric(devices=2)
+    lowered = jax.jit(
+        jax.shard_map(body, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+    ).lower({"g": x})
+    hlo = lowered.compile().as_text()
+    bf16_converts = [l for l in hlo.splitlines() if "bf16[" in l and "convert" in l]
+    assert bf16_converts, "no bf16 wire-dtype converts in compiled HLO"
+
+
+def test_bf16_close_to_f32_on_realistic_grads():
+    rng = np.random.default_rng(0)
+    shards = jnp.asarray(rng.normal(scale=1e-2, size=(2, 4096)).astype(np.float32))
+    set_grad_reduce_dtype("float32")
+    exact = np.asarray(_reduce(shards))
+    set_grad_reduce_dtype("bfloat16")
+    approx = np.asarray(_reduce(shards))
+    # bf16 has ~8 mantissa bits: error is bounded relative to the INPUT
+    # magnitude (1e-2 scale), not the mean — near-cancelling shard pairs make
+    # the mean arbitrarily small while the rounding stays input-sized.
+    np.testing.assert_allclose(approx, exact, rtol=1e-2, atol=3e-4)
+
+
+def test_invalid_dtype_rejected():
+    with pytest.raises(ValueError, match="grad_reduce_dtype"):
+        set_grad_reduce_dtype("int8")
+
+
+def test_ppo_trains_with_bf16_reduction(tmp_path):
+    """End-to-end through the real CLI on 2 devices — from_config must apply
+    the setting before the train step traces."""
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "dry_run=True",
+            "buffer.memmap=False",
+            "fabric.devices=2",
+            "fabric.grad_reduce_dtype=bfloat16",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            f"log_root={tmp_path}/logs",
+            "algo.run_test=False",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
