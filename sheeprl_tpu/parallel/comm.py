@@ -28,7 +28,12 @@ _GRAD_REDUCE_DTYPE: Optional[Any] = None  # None = reduce in the gradients' own 
 _TRACED_WITH: "list" = []  # dtypes pmean_grads has already been traced under
 
 
-def set_grad_reduce_dtype(dtype_str: Optional[str]) -> None:
+def set_grad_reduce_dtype(dtype_str: Optional[str], fresh_run: bool = False) -> None:
+    """Set the wire dtype. ``fresh_run=True`` (how ``Fabric.from_config``
+    calls this at run start) marks a run boundary: traces from previous runs
+    in the same process are dead, so no mid-run-flip warning is raised for
+    them — the warning is reserved for a genuine dtype change after THIS
+    run's train steps have already traced."""
     global _GRAD_REDUCE_DTYPE
     name = str(dtype_str or "float32").lower()
     if name in ("float32", "f32", "fp32", "32", "none"):
@@ -37,10 +42,12 @@ def set_grad_reduce_dtype(dtype_str: Optional[str]) -> None:
         new = jnp.bfloat16
     else:
         raise ValueError(f"Unsupported fabric.grad_reduce_dtype: {dtype_str!r} (float32 or bfloat16)")
-    if _TRACED_WITH and any(t != new for t in _TRACED_WITH):
+    if fresh_run:
+        _TRACED_WITH.clear()
+    elif _TRACED_WITH and any(t != new for t in _TRACED_WITH):
         # The setting is read at TRACE time: already-compiled train steps keep
         # their old wire dtype while new traces pick up this one — warn loudly
-        # rather than silently mixing collective precisions in one process.
+        # rather than silently mixing collective precisions in one run.
         import warnings
 
         warnings.warn(

@@ -248,8 +248,9 @@ class Fabric:
         from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
 
         # Process-wide gradient-collective wire dtype; must land before any
-        # train step traces (see parallel/comm.py).
-        set_grad_reduce_dtype(fabric_cfg.get("grad_reduce_dtype", "float32"))
+        # train step traces. from_config is the run boundary, so previous
+        # runs' traces don't trip the mid-run-flip warning (parallel/comm.py).
+        set_grad_reduce_dtype(fabric_cfg.get("grad_reduce_dtype", "float32"), fresh_run=True)
         return cls(
             devices=fabric_cfg.get("devices", "auto"),
             accelerator=fabric_cfg.get("accelerator", "auto"),

@@ -109,3 +109,20 @@ def test_ppo_trains_with_bf16_reduction(tmp_path):
             "algo.mlp_keys.encoder=[state]",
         ]
     )
+
+
+def test_run_boundary_does_not_false_warn(recwarn):
+    """Back-to-back runs with different wire dtypes in one process (the
+    dryrun harness pattern) must NOT trip the mid-run-flip warning —
+    from_config marks a run boundary; only a genuine mid-run change warns."""
+    import warnings
+
+    set_grad_reduce_dtype("float32", fresh_run=True)
+    _reduce({"g": jnp.ones((2, 4), jnp.float32)})  # traces under f32
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        set_grad_reduce_dtype("bfloat16", fresh_run=True)  # new run: silent
+
+    _reduce({"g": jnp.ones((2, 4), jnp.float32)})  # traces under bf16
+    with pytest.warns(UserWarning, match="grad_reduce_dtype changed"):
+        set_grad_reduce_dtype("float32")  # mid-run flip: warns
