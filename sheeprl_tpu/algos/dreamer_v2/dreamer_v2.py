@@ -455,26 +455,39 @@ def main(fabric, cfg: Dict[str, Any]):
     # TPU-native overlap (same design as Dreamer-V3/SAC `hybrid_player`):
     # host-CPU policy from a packed bf16 snapshot, device-resident uint8
     # sequence ring, Ratio grants dispatched in bursts on a trainer thread.
-    # The ring implements the sequential-window sampling rule, so the
-    # episode-buffer option keeps the host-sampled path.
+    # The episode buffer rides the burst path via the ring's episode-rule
+    # sampling (windows never mix two episodes — `ring_sample_windows_episode`,
+    # deviations documented in howto/tpu_parallelism.md). Two cases stay on
+    # the host path: prioritize_ends (a host-only sampling bias) and an
+    # episode-buffer RESUME (the device ring can only be mirrored from the
+    # per-env sequential layout, not from an episode container).
     hp_cfg = cfg.algo.get("hybrid_player") or {}
     burst_mode = resolve_hybrid_player(hp_cfg, fabric.mesh)
-    if burst_mode and buffer_type != "sequential":
-        # The device ring implements the sequential-window sampling rule only;
-        # the episode buffer's whole-episode rule stays on the host path. An
-        # EXPLICIT enabled=true + episode buffer is a config conflict (erroring
-        # beats silently forfeiting the burst speedup); 'auto' documents the
-        # downgrade and keeps the host path (howto/tpu_parallelism.md).
+    episode_rule = burst_mode and buffer_type == "episode"
+    if episode_rule and bool(cfg.buffer.prioritize_ends):
+        # A config conflict, not a runtime condition — erroring under an
+        # EXPLICIT enabled=true beats silently dropping either the bias or
+        # the burst speedup.
         msg = (
-            "algo.hybrid_player burst mode samples fixed sequential windows from the device ring and "
-            "does not implement buffer.type=episode's whole-episode sampling rule. Use "
-            "buffer.type=sequential with the hybrid player, or set algo.hybrid_player.enabled=false "
-            "to keep the episode buffer on the host-sampled path (see howto/tpu_parallelism.md)."
+            "buffer.prioritize_ends is a host-path sampling bias not implemented by the device "
+            "ring's episode-rule sampling. Unset it to use the hybrid player with the episode "
+            "buffer, or set algo.hybrid_player.enabled=false (see howto/tpu_parallelism.md)."
         )
         if str(hp_cfg.get("enabled", "auto")).lower() == "true":
             raise ValueError(msg)
         warnings.warn(msg + " hybrid_player was 'auto': falling back to host-path sampling.")
-        burst_mode = False
+        burst_mode = episode_rule = False
+    if episode_rule and state is not None and cfg.buffer.checkpoint:
+        # A runtime condition a previously-valid burst config can hit on its
+        # own checkpoints — NEVER an error: the run must stay resumable with
+        # its unchanged config, so this downgrades (with a warning) even
+        # under an explicit enabled=true.
+        warnings.warn(
+            "Resuming an episode buffer cannot mirror the device ring (episodes are not a "
+            "per-env sequential layout): this resumed run keeps host-path sampling. Use "
+            "buffer.type=sequential if you need burst mode across resumes."
+        )
+        burst_mode = episode_rule = False
     host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
 
     if burst_mode:
@@ -497,11 +510,12 @@ def main(fabric, cfg: Dict[str, Any]):
             actions_dim=actions_dim, capacity=buffer_size, seq_len=seq_len, batch_size=batch_size,
             policy_steps_per_iter=policy_steps_per_iter,
             make_burst_fn=lambda ring: make_train_step(
-                world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs, ring=ring
+                world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs,
+                ring={**ring, "episode_rule": episode_rule},
             ),
             player_subset=_player_subset,
             carry=(params, opts, jnp.int32(0)),
-            rb=rb if (state is not None and cfg.buffer.checkpoint) else None,
+            rb=rb if (state is not None and cfg.buffer.checkpoint and buffer_type == "sequential") else None,
             with_is_first=True, metric_names=DREAMER_METRIC_NAMES, aggregator=aggregator,
         )
         host_player = PlayerDV2(

@@ -22,7 +22,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_append_rows", "ring_sample_windows", "build_burst_train_step"]
+__all__ = [
+    "ring_append_rows",
+    "ring_sample_windows",
+    "ring_sample_windows_episode",
+    "build_burst_train_step",
+]
 
 
 def ring_append_rows(pos, valid_n, staged_mask, capacity: int):
@@ -56,6 +61,72 @@ def ring_sample_windows(key, env_idx, pos, valid_n, capacity: int, seq_len: int)
     return (start[None, :] + jnp.arange(seq_len)[:, None]) % capacity
 
 
+def episode_window_table(pos, valid_n, is_first, capacity: int, seq_len: int):
+    """Per-env table of episode-rule-valid window starts (the
+    ``EpisodeBuffer`` analogue): a start is valid iff its window satisfies
+    the sequential rule AND contains no episode boundary in its interior
+    (``is_first`` may be 1 only at the window's first row), so training
+    never mixes two episodes in one sequence.
+
+    Envs with NO boundary-free window fall back to their sequential-rule
+    starts (the host buffer raises instead — a no-op is not expressible
+    in-graph). Returns ``(table, n_valid)``: ``table`` is ``(C, E)`` with
+    each env's valid starts packed to the front in ascending order,
+    ``n_valid`` the per-env count (min 1).
+
+    Everything here depends only on the ring state after the burst's single
+    append, so callers compute it ONCE per burst and draw per-step starts
+    with :func:`sample_window_starts` at O(batch) cost.
+    """
+    F = (is_first.reshape(capacity, -1) > 0).astype(jnp.int32)  # (C, E)
+    # interior[p, e] = any is_first in rows p+1 .. p+seq_len-1 (circular):
+    # windowed sum via a doubled cumsum.
+    G = jnp.concatenate([F, F[: seq_len]], axis=0)
+    cs = jnp.concatenate([jnp.zeros((1, F.shape[1]), jnp.int32), jnp.cumsum(G, axis=0)], axis=0)
+    p = jnp.arange(capacity)
+    interior = (cs[p + seq_len] - cs[p + 1]) > 0  # (C, E)
+
+    # sequential validity per position: distance from the env's oldest valid
+    # row is < n_starts (same arithmetic as ring_sample_windows, vectorized
+    # over positions).
+    full = valid_n >= capacity
+    n_starts = jnp.where(full, capacity - seq_len + 1, jnp.maximum(valid_n - seq_len + 1, 1))  # (E,)
+    base = jnp.where(full, pos, 0)  # (E,)
+    dist = (p[:, None] - base[None, :]) % capacity  # (C, E)
+    seq_ok = dist < n_starts[None, :]
+
+    ep_ok = seq_ok & ~interior  # (C, E)
+    env_has_ep = jnp.any(ep_ok, axis=0)  # (E,)
+    ok = jnp.where(env_has_ep[None, :], ep_ok, seq_ok)  # (C, E)
+    # valid positions packed to the front, ascending (stable sort on ~ok)
+    table = jnp.argsort(~ok, axis=0, stable=True).astype(jnp.int32)
+    n_valid = jnp.maximum(ok.sum(axis=0), 1)
+    return table, n_valid
+
+
+def sample_window_starts(key, env_idx, table, n_valid, capacity: int, seq_len: int):
+    """Uniform draw from a packed valid-start table: ``(T, B)`` time indices
+    for the given per-element env choices. O(batch) per call."""
+    u = jax.random.uniform(key, env_idx.shape)
+    nv = n_valid[env_idx]
+    idx = jnp.minimum((u * nv).astype(jnp.int32), nv - 1)
+    start = table[idx, env_idx]
+    return (start[None, :] + jnp.arange(seq_len)[:, None]) % capacity
+
+
+def ring_sample_windows_episode(key, env_idx, pos, valid_n, is_first, capacity: int, seq_len: int):
+    """One-shot episode-rule sampling (table + draw). TPU-native deviations
+    from the host ``EpisodeBuffer`` (documented in
+    ``howto/tpu_parallelism.md``): starts are uniform over valid *windows*
+    (longer episodes are sampled proportionally more, like the sequential
+    buffer) rather than uniform over episodes; the open episode's prefix is
+    sampleable; ``prioritize_ends`` stays a host-path feature. The burst
+    step uses the split form (:func:`episode_window_table` once per burst +
+    :func:`sample_window_starts` per gradient step)."""
+    table, n_valid = episode_window_table(pos, valid_n, is_first, capacity, seq_len)
+    return sample_window_starts(key, env_idx, table, n_valid, capacity, seq_len)
+
+
 def build_burst_train_step(
     gradient_step: Callable[[Any, Any], Any],
     mesh,
@@ -84,6 +155,7 @@ def build_burst_train_step(
     grad_chunk = int(ring["grad_chunk"])
     ring_seq = int(ring["seq_len"])
     ring_batch = int(ring["batch_size"])
+    episode_rule = bool(ring.get("episode_rule", False))  # Dreamer-V2 buffer.type=episode
     n_dev = mesh.devices.size
 
     def local_burst(carry, rb, staged, staged_mask, pos, valid_n, key, valid):
@@ -96,6 +168,14 @@ def build_burst_train_step(
         # raises in that case); until then every step is a no-op append.
         valid = valid * jnp.all(new_valid >= ring_seq).astype(valid.dtype)
 
+        if episode_rule:
+            # Ring contents are fixed after the single append above, so the
+            # episode-validity table is computed ONCE per burst; each
+            # gradient step then draws starts at O(batch).
+            ep_table, ep_n_valid = episode_window_table(
+                new_pos, new_valid, rb["is_first"], capacity, ring_seq
+            )
+
         def sampled_step(c, xs):
             k, valid_flag = xs
 
@@ -107,9 +187,14 @@ def build_burst_train_step(
                 k_env, k_start, k_grad = jax.random.split(k, 3)
                 B = ring_batch // n_dev
                 env_idx = jax.random.randint(k_env, (B,), 0, ring_envs)
-                t_idx = ring_sample_windows(
-                    k_start, env_idx, new_pos, new_valid, capacity, ring_seq
-                )  # (T, B)
+                if episode_rule:
+                    t_idx = sample_window_starts(
+                        k_start, env_idx, ep_table, ep_n_valid, capacity, ring_seq
+                    )  # (T, B)
+                else:
+                    t_idx = ring_sample_windows(
+                        k_start, env_idx, new_pos, new_valid, capacity, ring_seq
+                    )  # (T, B)
                 batch = {kk: rb[kk][t_idx, env_idx[None, :]] for kk in rb}
                 nc, m = gradient_step(c, (batch, k_grad))
                 # Metrics may be a tuple (Dreamers) or a dict (P2E) — keep

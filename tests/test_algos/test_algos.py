@@ -442,11 +442,40 @@ def test_p2e_dv2_exploration_hybrid_burst(tmp_path):
     run(_hybrid_burst_args(tmp_path, "p2e_dv2_exploration", P2E_DV2_FAST))
 
 
-def test_dreamer_v2_hybrid_burst_episode_buffer_errors(tmp_path):
-    """Explicit hybrid_player.enabled=true + buffer.type=episode is a config
-    conflict (the ring has no whole-episode sampling rule) — it must error,
-    not silently forfeit the burst speedup (howto/tpu_parallelism.md)."""
+def test_dreamer_v2_hybrid_burst_episode_buffer(tmp_path):
+    """buffer.type=episode rides the burst path via the ring's episode-rule
+    sampling (windows never mix episodes) — a full run incl. the greedy
+    test rollout (howto/tpu_parallelism.md)."""
     args = _hybrid_burst_args(tmp_path, "dreamer_v2", DREAMER_V2_FAST)
-    args += ["buffer.type=episode", "algo.per_rank_sequence_length=1"]
-    with pytest.raises(ValueError, match="whole-episode sampling"):
+    args += ["buffer.type=episode", "algo.per_rank_sequence_length=2"]
+    run(args)
+
+
+def test_dreamer_v2_hybrid_burst_prioritize_ends_errors(tmp_path):
+    """prioritize_ends is a host-path sampling bias: explicitly enabling the
+    hybrid player with it is a config conflict — it must error, not silently
+    forfeit either the bias or the burst speedup."""
+    args = _hybrid_burst_args(tmp_path, "dreamer_v2", DREAMER_V2_FAST)
+    args += ["buffer.type=episode", "buffer.prioritize_ends=True", "algo.per_rank_sequence_length=2"]
+    with pytest.raises(ValueError, match="prioritize_ends"):
         run(args)
+
+
+def test_dreamer_v2_episode_burst_checkpoint_resumes_on_host_path(tmp_path):
+    """A burst-written episode-buffer checkpoint must stay resumable with
+    its UNCHANGED config (incl. explicit enabled=true): the resume warns
+    and downgrades to host-path sampling rather than erroring — the ring
+    cannot be mirrored from an episode container."""
+    args = _hybrid_burst_args(tmp_path, "dreamer_v2", DREAMER_V2_FAST)
+    args += [
+        "buffer.type=episode",
+        "algo.per_rank_sequence_length=2",
+        "buffer.checkpoint=True",
+        "algo.run_test=False",
+    ]
+    args.remove("checkpoint.save_last=False")
+    args.append("checkpoint.save_last=True")
+    run(args)
+    ckpt = _latest_ckpt(f"{tmp_path}/logs")
+    with pytest.warns(UserWarning, match="Resuming an episode buffer"):
+        run(args + [f"checkpoint.resume_from={ckpt}", "algo.total_steps=128"])

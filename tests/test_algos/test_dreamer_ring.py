@@ -74,3 +74,92 @@ def test_windows_stay_in_valid_prefix_when_not_full():
     t_idx = np.asarray(ring_sample_windows(jax.random.PRNGKey(0), env_idx, pos, valid, CAP, seq))
     assert t_idx.min() >= 0
     assert t_idx.max() <= 6  # last valid start = 7 - 3 = 4 -> max index 6
+
+
+# -- episode-rule sampling (Dreamer-V2 buffer.type=episode on the ring) -------
+
+
+def _episode_ring(first_rows, cap, n_envs=1):
+    """A (cap, n_envs, 1) is_first channel with 1s at the given rows."""
+    f = np.zeros((cap, n_envs, 1), np.float32)
+    for r in first_rows:
+        f[r, :] = 1.0
+    return jnp.asarray(f)
+
+
+def test_episode_windows_never_contain_interior_boundary():
+    from sheeprl_tpu.data.ring import ring_sample_windows_episode
+
+    cap, seq = 16, 4
+    # episodes start at rows 0, 5, 9 in a 12-row valid prefix
+    is_first = _episode_ring([0, 5, 9], cap)
+    pos = jnp.asarray([12], jnp.int32)
+    valid = jnp.asarray([12], jnp.int32)
+    env_idx = jnp.zeros((512,), jnp.int32)
+    firsts = {0, 5, 9}
+    for s in range(10):
+        t_idx = np.asarray(
+            ring_sample_windows_episode(jax.random.PRNGKey(s), env_idx, pos, valid, is_first, cap, seq)
+        )
+        for b in range(t_idx.shape[1]):
+            window = t_idx[:, b].tolist()
+            # boundary rows may appear only as the window's FIRST element
+            for w in window[1:]:
+                assert w not in firsts, (window, s)
+            # and the sequential prefix rule still holds (valid rows 0..11,
+            # max start 12-4=8 -> max index 11)
+            assert max(window) <= 11
+
+
+def test_episode_windows_cover_all_valid_starts():
+    from sheeprl_tpu.data.ring import ring_sample_windows_episode
+
+    cap, seq = 16, 3
+    is_first = _episode_ring([0, 6], cap)
+    pos = jnp.asarray([12], jnp.int32)
+    valid = jnp.asarray([12], jnp.int32)
+    env_idx = jnp.zeros((2048,), jnp.int32)
+    t_idx = np.asarray(
+        ring_sample_windows_episode(jax.random.PRNGKey(1), env_idx, pos, valid, is_first, cap, seq)
+    )
+    starts = set(t_idx[0].tolist())
+    # valid starts: episode A rows 0..3 (windows end before 6), episode B rows
+    # 6..9 (end before head 12); rows 4,5 would straddle the boundary at 6
+    assert starts == {0, 1, 2, 3, 6, 7, 8, 9}, starts
+
+
+def test_episode_sampling_falls_back_when_no_boundary_free_window():
+    from sheeprl_tpu.data.ring import ring_sample_windows_episode
+
+    cap, seq = 16, 4
+    # every episode is 2 rows long -> no boundary-free window of length 4
+    is_first = _episode_ring([0, 2, 4, 6, 8, 10, 12, 14], cap)
+    pos = jnp.asarray([16], jnp.int32)
+    valid = jnp.asarray([16], jnp.int32)
+    env_idx = jnp.zeros((128,), jnp.int32)
+    t_idx = np.asarray(
+        ring_sample_windows_episode(jax.random.PRNGKey(2), env_idx, pos, valid, is_first, cap, seq)
+    )
+    # falls back to the sequential rule rather than emitting NaN/garbage
+    assert t_idx.min() >= 0 and t_idx[0].max() <= 16 - seq
+
+
+def test_episode_windows_respect_wrapped_ring():
+    from sheeprl_tpu.data.ring import ring_sample_windows_episode
+
+    cap, seq = 10, 3
+    # full ring, head at 6; episode boundary at row 9 (inside the wrapped
+    # valid range 6,7,...,9,0,...,5)
+    is_first = _episode_ring([9], cap)
+    pos = jnp.asarray([6], jnp.int32)
+    valid = jnp.asarray([cap], jnp.int32)
+    env_idx = jnp.zeros((1024,), jnp.int32)
+    t_idx = np.asarray(
+        ring_sample_windows_episode(jax.random.PRNGKey(3), env_idx, pos, valid, is_first, cap, seq)
+    )
+    for b in range(t_idx.shape[1]):
+        window = t_idx[:, b].tolist()
+        for w in window[1:]:
+            assert w != 9  # never interior
+        for a, bb in zip(window[:-1], window[1:]):
+            assert not (a == 5 and bb == 6)  # never straddles the head
