@@ -110,24 +110,20 @@ def test_sac_sample_next_obs(tmp_path):
     run(args)
 
 
+SAC_AE_FAST = [
+    "algo.per_rank_batch_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.hidden_size=16",
+    "algo.cnn_channels_multiplier=2",
+    "env.id=continuous_dummy",
+    "env.screen_size=64",
+]
+
+
 @pytest.mark.parametrize("devices", [1, 2])
 def test_sac_ae_dry_run(tmp_path, devices):
-    run(
-        _std_args(
-            tmp_path,
-            "sac_ae",
-            devices=devices,
-            extra=[
-                "algo.per_rank_batch_size=4",
-                "algo.cnn_keys.encoder=[rgb]",
-                "algo.mlp_keys.encoder=[state]",
-                "algo.hidden_size=16",
-                "algo.cnn_channels_multiplier=2",
-                "env.id=continuous_dummy",
-                "env.screen_size=64",
-            ],
-        )
-    )
+    run(_std_args(tmp_path, "sac_ae", devices=devices, extra=SAC_AE_FAST))
 
 
 @pytest.mark.parametrize("devices", [1, 2])
@@ -304,16 +300,16 @@ def test_ppo_decoupled_multi_iteration(tmp_path):
     assert len(glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)) >= 2
 
 
+SAC_DECOUPLED_FAST = [
+    "env.id=continuous_dummy",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.per_rank_batch_size=4",
+]
+
+
 @pytest.mark.parametrize("devices", [1, 2])
 def test_sac_decoupled_dry_run(tmp_path, devices):
-    run(
-        _std_args(
-            tmp_path,
-            "sac_decoupled",
-            devices=devices,
-            extra=["env.id=continuous_dummy", "algo.mlp_keys.encoder=[state]", "algo.per_rank_batch_size=4"],
-        )
-    )
+    run(_std_args(tmp_path, "sac_decoupled", devices=devices, extra=SAC_DECOUPLED_FAST))
 
 
 def test_ppo_share_data_two_devices(tmp_path):

@@ -159,3 +159,49 @@ def test_dreamer_v3_checkpoint_resume_round_trip(tmp_path):
         ]
     )
     assert _ckpts(f"{tmp_path}/resumed")
+
+
+# -- every registered evaluation is executable --------------------------------
+
+from tests.test_algos.test_algos import (  # noqa: E402
+    A2C_FAST,
+    DREAMER_FAST,
+    DREAMER_V1_FAST,
+    DREAMER_V2_FAST,
+    P2E_DV1_FAST,
+    P2E_DV2_FAST,
+    P2E_DV3_FAST,
+    PPO_REC_FAST,
+    SAC_AE_FAST,
+    SAC_DECOUPLED_FAST,
+    SAC_FAST,
+    _std_args,
+)
+
+# conftest auto-marks the compile-heavy families (dreamer/p2e/sac_ae/droq)
+# slow via the parametrized nodeid; the MLP cases stay in the fast lane.
+_EVAL_CASES = [
+    ("a2c", A2C_FAST),
+    ("ppo_recurrent", PPO_REC_FAST),
+    ("sac", SAC_FAST),
+    ("sac_decoupled", SAC_DECOUPLED_FAST),
+    ("droq", SAC_FAST),
+    ("sac_ae", SAC_AE_FAST),
+    ("dreamer_v1", DREAMER_V1_FAST),
+    ("dreamer_v2", DREAMER_V2_FAST),
+    ("dreamer_v3", DREAMER_FAST),
+    ("p2e_dv1_exploration", P2E_DV1_FAST),
+    ("p2e_dv2_exploration", P2E_DV2_FAST),
+    ("p2e_dv3_exploration", P2E_DV3_FAST),
+]
+
+
+@pytest.mark.parametrize("algo, fast", _EVAL_CASES, ids=[c[0] for c in _EVAL_CASES])
+def test_every_registered_evaluation_runs(tmp_path, capsys, algo, fast):
+    """Checkpoint → `evaluation()` round-trip for EVERY algorithm family's
+    registered evaluation entry (the reference registers one per family —
+    previously only ppo/ppo_decoupled were ever executed)."""
+    run(_std_args(tmp_path, algo, extra=list(fast)) + ["checkpoint.save_last=True"])
+    ckpt = _ckpts(tmp_path)[-1]
+    evaluation([f"checkpoint_path={ckpt}", "env.capture_video=False"])
+    assert "Test - Reward:" in capsys.readouterr().out
