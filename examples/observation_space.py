@@ -10,9 +10,10 @@ accordingly), so this composes the REAL config and builds the REAL env:
     python examples/observation_space.py exp=dreamer_v3 env=atari_dummy
 """
 
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(args) -> None:

@@ -11,9 +11,10 @@ step).
     python examples/ratio.py [replay_ratio]
 """
 
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from sheeprl_tpu.utils.utils import Ratio
 

@@ -92,3 +92,17 @@ def test_dreamer_v3_imagination_demo(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for name in ("real.gif", "reconstructed.gif", "imagination.gif", "strip.png"):
         assert (out / name).stat().st_size > 0
+
+
+def test_model_manager_example_dep_gates_cleanly():
+    """mlflow is an optional extra: without it the example must exit with
+    the actionable install message, not a traceback. (In an env where the
+    extra IS installed the gate doesn't fire — skip.)"""
+    import importlib.util
+
+    if importlib.util.find_spec("mlflow") is not None:
+        pytest.skip("mlflow installed: the dep gate does not fire")
+    proc = _run_example("model_manager.py", "/nonexistent/ckpt.ckpt")
+    assert proc.returncode != 0
+    assert "mlflow is an optional extra" in (proc.stdout + proc.stderr)
+    assert "Traceback" not in proc.stderr
