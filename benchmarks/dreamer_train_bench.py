@@ -23,6 +23,10 @@ import sys
 import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    # `python benchmarks/<script>.py` puts benchmarks/ (not the repo root) at
+    # sys.path[0]; make the package importable without an editable install.
+    sys.path.insert(0, _REPO_ROOT)
 
 
 def main() -> None:

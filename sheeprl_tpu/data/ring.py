@@ -16,10 +16,11 @@ Shared by the Dreamer-V1/V2/V3 burst paths; the index math is unit-tested in
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 __all__ = [
@@ -27,6 +28,10 @@ __all__ = [
     "ring_sample_windows",
     "ring_sample_windows_episode",
     "build_burst_train_step",
+    "BlobLayout",
+    "effective_stage_buckets",
+    "make_blob_layouts",
+    "pack_burst_blob",
 ]
 
 
@@ -127,6 +132,101 @@ def ring_sample_windows_episode(key, env_idx, pos, valid_n, is_first, capacity: 
     return sample_window_starts(key, env_idx, table, n_valid, capacity, seq_len)
 
 
+def effective_stage_buckets(stage_buckets, stage_max: int) -> Tuple[int, ...]:
+    """The normalized flush-bucket set (always ends with ``stage_max``).
+
+    Shared by ``BurstRunner`` and the packed-blob layout construction so the
+    host packer and the device unpacker can never disagree on bucket sizes."""
+    buckets = sorted(set(int(b) for b in (stage_buckets or ()) if 0 < int(b) <= int(stage_max)))
+    if not buckets or buckets[-1] < int(stage_max):
+        buckets.append(int(stage_max))
+    return tuple(buckets)
+
+
+class BlobLayout(NamedTuple):
+    """Byte layout of one packed burst upload (one staging bucket size)."""
+
+    nbytes: int
+    segments: Tuple[Tuple[str, int, tuple, Any], ...]  # (name, offset, shape, np.dtype)
+
+
+def make_blob_layouts(
+    ring_keys: Dict[str, Tuple[tuple, Any]],
+    n_envs: int,
+    grad_chunk: int,
+    buckets: Tuple[int, ...],
+    key_width: int = 2,
+) -> Dict[int, BlobLayout]:
+    """Per-bucket byte layouts for the single-upload burst job.
+
+    A remote accelerator charges per-transfer latency, not just bytes: the
+    unpacked burst job ships ~8 separate host arrays and pays that latency
+    for each one, serially, on every flush. Packing the staged rows, write
+    masks, ring heads, PRNG key, and grant mask into ONE uint8 blob makes a
+    flush a single host→device transfer; the (statically shaped) segments
+    are sliced and bitcast back out on device inside the burst program.
+
+    Returns ``{bucket_size: BlobLayout}``. Segment offsets are 4-byte
+    aligned so 32-bit segments can be bitcast from the byte view. Blob
+    lengths are unique across buckets (the length doubles as the jit trace
+    key on the device side).
+    """
+    layouts: Dict[int, BlobLayout] = {}
+    seen_lengths = set()
+    for size in buckets:
+        segs = []
+        off = 0
+
+        def add(name, shape, dtype):
+            nonlocal off
+            off = (off + 3) & ~3
+            segs.append((name, off, tuple(int(s) for s in shape), np.dtype(dtype)))
+            off += int(np.prod(shape)) * np.dtype(dtype).itemsize
+
+        for k, (shape, dtype) in ring_keys.items():
+            add(k, (size, n_envs) + tuple(shape), dtype)
+        add("__mask__", (size, n_envs), np.int32)
+        add("__pos__", (n_envs,), np.int32)
+        add("__valid_n__", (n_envs,), np.int32)
+        add("__key__", (key_width,), np.uint32)
+        add("__validmask__", (grad_chunk,), np.float32)
+        total = (off + 3) & ~3
+        while total in seen_lengths:
+            total += 4
+        seen_lengths.add(total)
+        layouts[int(size)] = BlobLayout(total, tuple(segs))
+    return layouts
+
+
+def pack_burst_blob(layout: BlobLayout, values: Dict[str, np.ndarray]) -> np.ndarray:
+    """Host side: copy every segment's bytes into one fresh uint8 blob.
+
+    Always a fresh allocation: the blob is queued to the trainer thread, so
+    reusing a buffer across flushes would mutate a job still in flight."""
+    blob = np.zeros(layout.nbytes, np.uint8)
+    for name, off, shape, dtype in layout.segments:
+        arr = np.ascontiguousarray(values[name], dtype=dtype)
+        blob[off : off + arr.nbytes] = arr.view(np.uint8).ravel()
+    return blob
+
+
+def _unpack_burst_blob(blob: jax.Array, layout: BlobLayout) -> Dict[str, jax.Array]:
+    """Device side (traced): slice + bitcast each segment back out."""
+    out = {}
+    for name, off, shape, dtype in layout.segments:
+        itemsize = np.dtype(dtype).itemsize
+        n = int(np.prod(shape))
+        seg = jax.lax.slice_in_dim(blob, off, off + n * itemsize, axis=0)
+        if itemsize == 1:
+            arr = seg.reshape(shape)
+            if np.dtype(dtype) != np.uint8:
+                arr = jax.lax.bitcast_convert_type(arr, jnp.dtype(dtype))
+        else:
+            arr = jax.lax.bitcast_convert_type(seg.reshape((n, itemsize)), jnp.dtype(dtype)).reshape(shape)
+        out[name] = arr
+    return out
+
+
 def build_burst_train_step(
     gradient_step: Callable[[Any, Any], Any],
     mesh,
@@ -223,6 +323,41 @@ def build_burst_train_step(
         out_specs=(P(),) * 3,
         check_vma=False,
     )
+
+    ring_keys = ring.get("ring_keys")
+    if ring_keys is not None:
+        # Packed single-upload variant: the host ships ONE uint8 blob per
+        # flush (see make_blob_layouts); each bucket's blob length selects
+        # its layout, so every bucket gets its own trace exactly as the
+        # unpacked path did.
+        raw_buckets = tuple(int(b) for b in ring["stage_buckets"])
+        layouts = make_blob_layouts(
+            ring_keys,
+            ring_envs,
+            grad_chunk,
+            # Same normalization BurstRunner applies to its flush buckets, so
+            # every bucket the runner can select has a layout here.
+            effective_stage_buckets(raw_buckets, int(ring.get("stage_max", max(raw_buckets)))),
+        )
+        by_length = {layout.nbytes: layout for layout in layouts.values()}
+
+        def packed_burst(carry, rb, blob):
+            layout = by_length[blob.shape[0]]
+            u = _unpack_burst_blob(blob, layout)
+            return shard_burst(
+                carry,
+                rb,
+                {k: u[k] for k in ring_keys},
+                u["__mask__"],
+                u["__pos__"],
+                u["__valid_n__"],
+                u["__key__"],
+                u["__validmask__"],
+            )
+
+        fn = jax.jit(packed_burst, donate_argnums=(1,), compiler_options=compiler_options)
+        return fn
+
     # Only the ring is donated: the carry handles (params/opts/...) are read
     # by the main thread (checkpoints) while a burst may be in flight —
     # donation would hand it deleted buffers.

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
+from sheeprl_tpu.data.ring import BlobLayout, effective_stage_buckets, make_blob_layouts, pack_burst_blob
+
 __all__ = [
     "HostSnapshot",
     "TrainerThread",
@@ -114,7 +116,12 @@ class HostSnapshot:
 
     def __init__(self, subset_fn: Callable[[Any], Any], params: Any, wire_dtype=jnp.bfloat16):
         self.host_device = jax.devices("cpu")[0]
-        _, unravel = ravel_pytree(jax.tree.map(np.asarray, subset_fn(params)))
+        # Pull the subset once to build the unravel spec — as ONE pipelined
+        # batch of transfers, not leaf-by-leaf blocking pulls (a remote
+        # accelerator charges a full round-trip per blocking pull).
+        subset_host = jax.device_put(subset_fn(params), self.host_device)
+        jax.block_until_ready(subset_host)
+        _, unravel = ravel_pytree(jax.tree.map(np.asarray, subset_host))
         self._pack = jax.jit(lambda p: ravel_pytree(subset_fn(p))[0].astype(wire_dtype))
         self._unpack = jax.jit(lambda v: unravel(v.astype(jnp.float32)))
         self._slot: list = [None]
@@ -130,9 +137,12 @@ class HostSnapshot:
         self._slot[0] = jax.device_put(self._pack(params), self.host_device)
 
     def refresh_async(self, params: Any) -> bool:
-        """Main thread: kick off the device→host pull on a one-shot thread so
-        the env loop never waits on the wire. Skipped (returns False) while a
-        previous pull is still in flight."""
+        """Kick off the device→host pull on a one-shot thread so the caller
+        never waits on the wire. Skipped (returns False) while a previous
+        pull is still in flight. Single-caller-thread contract: the
+        check-then-act on ``_refresh_thread`` is not locked, so exactly ONE
+        thread may call this per snapshot instance (the trainer thread in
+        the BurstRunner wiring)."""
         if self._refresh_thread is not None and self._refresh_thread.is_alive():
             return False
         packed = self._pack(params)
@@ -217,7 +227,13 @@ class TrainerThread:
         self._q.put(None)
         self._thread.join()
         self.raise_if_failed()
-        return self._state["carry"]
+        # Joining the thread only drains the Python queue; the last dispatched
+        # burst may still be executing on-device (JAX dispatch is async).
+        # Block so wall-clock accounting and post-run calibration probes see a
+        # finished program, not our own in-flight work.
+        carry = self._state["carry"]
+        jax.block_until_ready(carry)
+        return carry
 
 
 class BurstRunner:
@@ -245,8 +261,10 @@ class BurstRunner:
         snapshot_every: int = 4,
         params_of: Callable[[Any], Any] = lambda carry: carry[0],
         stage_buckets: Optional[Tuple[int, ...]] = None,
+        blob_layouts: Optional[Dict[int, "BlobLayout"]] = None,
     ) -> None:
         self._burst_fn = burst_fn
+        self._layouts = blob_layouts
         self._params_of = params_of
         self._ring_keys = ring_keys
         self._n_envs = int(n_envs)
@@ -260,10 +278,7 @@ class BurstRunner:
         # bucket that fits (one jit trace per bucket). Without buckets every
         # flush ships the full ``stage_max`` staging array — for a pixel ring
         # over a thin link that is ~4x the bytes actually staged.
-        buckets = sorted(set(int(b) for b in (stage_buckets or ()) if 0 < int(b) <= self._stage_max))
-        if not buckets or buckets[-1] < self._stage_max:
-            buckets.append(self._stage_max)
-        self._stage_buckets = buckets
+        self._stage_buckets = list(effective_stage_buckets(stage_buckets, self._stage_max))
 
         self.dev_pos = np.zeros(self._n_envs, np.int64)
         self.dev_valid = np.zeros(self._n_envs, np.int64)
@@ -327,13 +342,20 @@ class BurstRunner:
 
     def _step(self, carry_rb, job):
         carry, rb = carry_rb
-        staged_j, mask_j, pos_j, valid_j, key_j, validmask_j, trained = job
-        carry, rb, metrics = self._burst_fn(carry, rb, staged_j, mask_j, pos_j, valid_j, key_j, validmask_j)
+        if self._layouts is not None:
+            blob, trained = job
+            carry, rb, metrics = self._burst_fn(carry, rb, blob)
+        else:
+            staged_j, mask_j, pos_j, valid_j, key_j, validmask_j, trained = job
+            carry, rb, metrics = self._burst_fn(carry, rb, staged_j, mask_j, pos_j, valid_j, key_j, validmask_j)
         if trained:
             self._bursts += 1
             if self._snapshot is not None and self._bursts % self._snapshot_every == 0:
-                # One packed pull; blocking is fine on this thread.
-                self._snapshot.refresh(self._params_of(carry))
+                # Non-blocking: the packed device→host pull costs ~0.4 s on a
+                # tunneled chip and would stall the training pipeline if this
+                # thread waited on it (measured as +95% burst latency on every
+                # snapshot burst); the one-shot pull thread owns the wait.
+                self._snapshot.refresh_async(self._params_of(carry))
             return (carry, rb), metrics
         return (carry, rb), None  # append-only bursts produce junk metrics
 
@@ -361,11 +383,27 @@ class BurstRunner:
         chunk = min(self.grad_chunk, grant_backlog) if ready else 0
         validmask = np.zeros((self.grad_chunk,), np.float32)
         validmask[:chunk] = 1.0
-        self._thread.submit((
-            arrs, jnp.asarray(mask), jnp.asarray(self.dev_pos, jnp.int32),
-            jnp.asarray(self.dev_valid, jnp.int32), key, jnp.asarray(validmask),
-            chunk > 0,
-        ))
+        if self._layouts is not None:
+            # One uint8 blob = one host→device transfer per flush. The
+            # remote transport charges per-transfer latency, so shipping 8
+            # separate arrays serialized the trainer thread on the wire.
+            layout = self._layouts[size]
+            values = dict(arrs)
+            values["__mask__"] = mask
+            values["__pos__"] = self.dev_pos
+            values["__valid_n__"] = self.dev_valid
+            values["__key__"] = np.asarray(key, np.uint32)
+            values["__validmask__"] = validmask
+            # Fresh blob per flush: the queued job must not alias a buffer a
+            # later flush would overwrite while this one is still in flight.
+            blob = pack_burst_blob(layout, values)
+            self._thread.submit((blob, chunk > 0))
+        else:
+            self._thread.submit((
+                arrs, jnp.asarray(mask), jnp.asarray(self.dev_pos, jnp.int32),
+                jnp.asarray(self.dev_valid, jnp.int32), key, jnp.asarray(validmask),
+                chunk > 0,
+            ))
         self.dev_pos[:] = (self.dev_pos + env_counts) % self._capacity
         self.dev_valid[:] = np.minimum(self.dev_valid + env_counts, self._capacity)
         return chunk
@@ -421,9 +459,13 @@ class HybridPlayerHarness:
 
         self.grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
         stage_max, stage_buckets = dreamer_stage_sizes(train_every, n_envs, capacity)
+        buckets = effective_stage_buckets(stage_buckets, stage_max)
         self.ring_keys = dreamer_ring_keys(
             observation_space, cnn_keys, mlp_keys, actions_dim, with_is_first=with_is_first
         )
+        # ring_keys + stage_buckets switch build_burst_train_step to the
+        # packed single-upload job; the layouts here are the same ones the
+        # device side derives (both call make_blob_layouts on these args).
         burst_fn = make_burst_fn(
             {
                 "capacity": capacity,
@@ -431,8 +473,12 @@ class HybridPlayerHarness:
                 "grad_chunk": self.grad_chunk,
                 "seq_len": seq_len,
                 "batch_size": batch_size,
+                "ring_keys": self.ring_keys,
+                "stage_buckets": buckets,
+                "stage_max": stage_max,
             }
         )
+        blob_layouts = make_blob_layouts(self.ring_keys, n_envs, self.grad_chunk, buckets)
         rb_dev, dev_pos, dev_valid = init_device_ring(fabric, self.ring_keys, capacity, n_envs, rb=rb)
 
         params = params_of(carry)
@@ -440,7 +486,10 @@ class HybridPlayerHarness:
         self.host_device = self.snapshot.host_device
         self.host_params = self.snapshot.pull(params)
         self._host_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 17), self.host_device)
-        self._rng = jax.random.PRNGKey(cfg.seed)
+        # Train-key stream on the host CPU device: threefry is platform-
+        # deterministic (bit-identical split results), and a host-resident
+        # key lets the packed flush read its bytes without a device pull.
+        self._rng = jax.device_put(jax.random.PRNGKey(cfg.seed), self.host_device)
 
         self.runner = BurstRunner(
             burst_fn,
@@ -456,6 +505,7 @@ class HybridPlayerHarness:
             snapshot_every=snapshot_every,
             params_of=params_of,
             stage_buckets=stage_buckets,
+            blob_layouts=blob_layouts,
         )
         self.runner.set_ring_state(dev_pos, dev_valid)
 

@@ -36,14 +36,29 @@ _RB_SUFFIX = ".rb"
 
 
 def _to_host(tree: Any) -> Any:
-    """Convert any jax arrays in a pytree (incl. inside lists/dicts) to numpy."""
+    """Convert any jax arrays in a pytree (incl. inside lists/dicts) to numpy.
+
+    The device→host pulls are issued for every leaf up front (``device_put``
+    to the host CPU device is asynchronous) and synchronized once: a remote
+    accelerator charges a full round-trip per *blocking* pull, so pulling a
+    few hundred leaves one-by-one costs minutes where one pipelined batch
+    costs a round-trip plus the transfer bytes."""
+    cpu = jax.devices("cpu")[0]
+
+    def pull(x):
+        if isinstance(x, jax.Array):
+            return jax.device_put(x, cpu)
+        return x
+
+    staged = jax.tree.map(pull, tree)
+    jax.block_until_ready([x for x in jax.tree.leaves(staged) if isinstance(x, jax.Array)])
 
     def leaf(x):
         if isinstance(x, jax.Array):
-            return np.asarray(jax.device_get(x))
+            return np.asarray(x)
         return x
 
-    return jax.tree.map(leaf, tree)
+    return jax.tree.map(leaf, staged)
 
 
 def _checkpointer():
