@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.sac.agent import SACAgent, build_agent
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.ring import pack_burst_blob
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -137,6 +138,7 @@ def make_burst_train_step(
     n_envs: int,
     stage_max: int,
     grad_chunk: int,
+    dims: "Dict[str, int] | None" = None,
 ):
     """Device-resident-replay burst update (TPU-native; no reference
     counterpart — the reference host-samples every iteration).
@@ -241,7 +243,36 @@ def make_burst_train_step(
         out_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shard_train, donate_argnums=(4,))
+    if dims is None:
+        return jax.jit(shard_train, donate_argnums=(4,))
+
+    # Packed single-upload variant (same rationale as the Dreamer ring's
+    # packed burst, data/ring.py): the job's ~10 separate host arrays each
+    # paid per-transfer latency on the trainer thread; one uint8 blob pays
+    # it once per flush.
+    from sheeprl_tpu.data.ring import make_layout, unpack_burst_blob
+
+    spec = [(k, (stage_max, n_envs, d), np.float32) for k, d in dims.items()]
+    spec += [
+        ("__pos__", (), np.int32),
+        ("__count__", (), np.int32),
+        ("__valid_n__", (), np.int32),
+        ("__key__", (2,), np.uint32),
+        ("__flags__", (grad_chunk,), np.float32),
+        ("__valid__", (grad_chunk,), np.float32),
+    ]
+    layout = make_layout(spec)
+
+    def packed_train(params, aopt, copt, lopt, rb, blob):
+        u = unpack_burst_blob(blob, layout)
+        return shard_train(
+            params, aopt, copt, lopt, rb,
+            {k: u[k] for k in dims},
+            u["__pos__"], u["__count__"], u["__valid_n__"],
+            u["__key__"], u["__flags__"], u["__valid__"],
+        )
+
+    return jax.jit(packed_train, donate_argnums=(4,)), layout
 
 
 @register_algorithm()
@@ -410,14 +441,15 @@ def main(fabric, cfg: Dict[str, Any]):
         # staging buffer only ever holds transitions since the last flush.
         base_learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
         stage_max = min(base_learning_starts + 2 * train_every + 1, buffer_size)
-        burst_fn = make_burst_train_step(
-            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh,
-            capacity=buffer_size, n_envs=int(cfg.env.num_envs), stage_max=stage_max, grad_chunk=grad_chunk,
-        )
         dims = {
             "observations": obs_dim, "next_observations": obs_dim,
             "actions": act_dim, "rewards": 1, "terminated": 1,
         }
+        burst_fn, burst_layout = make_burst_train_step(
+            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh,
+            capacity=buffer_size, n_envs=int(cfg.env.num_envs), stage_max=stage_max, grad_chunk=grad_chunk,
+            dims=dims,
+        )
         from sheeprl_tpu.utils.burst import init_device_ring
 
         rb_dev, _, _ = init_device_ring(
@@ -443,17 +475,18 @@ def main(fabric, cfg: Dict[str, Any]):
 
         def _burst_step(carry, job):
             params_, aopt_, copt_, lopt_, rb_dev_ = carry
-            staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j = job
             params_, aopt_, copt_, lopt_, rb_dev_, qf_l, a_l, al_l = burst_fn(
-                params_, aopt_, copt_, lopt_, rb_dev_,
-                staged_j, pos_j, count_j, total_j, key_j, flags_j, valid_j,
+                params_, aopt_, copt_, lopt_, rb_dev_, job
             )
             return (params_, aopt_, copt_, lopt_, rb_dev_), (qf_l, a_l, al_l)
 
         trainer = TrainerThread(
             _burst_step,
             (params, aopt, copt, lopt, rb_dev),
-            on_step=lambda carry, _m: snapshot.refresh(carry[0]),
+            # refresh_async: the packed pull would otherwise block this
+            # trainer thread for a wire round-trip per burst (single-caller
+            # contract holds — only the trainer thread calls it).
+            on_step=lambda carry, _m: snapshot.refresh_async(carry[0]),
         )
 
         def _flush_burst():
@@ -485,11 +518,14 @@ def main(fabric, cfg: Dict[str, Any]):
             valid[:chunk] = 1.0
             with timer("Time/train_time", SumMetric):
                 rng, train_key = jax.random.split(rng)
-                trainer.submit((
-                    staged_arr,
-                    jnp.int32(dev_pos), jnp.int32(count), jnp.int32(dev_total),
-                    train_key, jnp.asarray(flags), jnp.asarray(valid),
-                ))
+                values = dict(staged_arr)
+                values["__pos__"] = np.asarray(dev_pos, np.int32)
+                values["__count__"] = np.asarray(count, np.int32)
+                values["__valid_n__"] = np.asarray(dev_total, np.int32)
+                values["__key__"] = np.asarray(train_key, np.uint32)
+                values["__flags__"] = flags
+                values["__valid__"] = valid
+                trainer.submit(pack_burst_blob(burst_layout, values))
                 latest = trainer.metrics
                 if aggregator and not aggregator.disabled and latest is not None:
                     qf_l, a_l, al_l = latest
@@ -506,6 +542,14 @@ def main(fabric, cfg: Dict[str, Any]):
     data_sharding = NamedSharding(fabric.mesh, P(None, "dp"))
 
     rng = jax.random.PRNGKey(cfg.seed)
+    if burst_mode:
+        # Host-resident key stream (threefry is platform-deterministic, so
+        # the values are unchanged): the burst path consumes keys on the
+        # host — action sampling on the CPU policy, key bytes packed into
+        # the burst blob — and a device-resident key would cost a device
+        # pull per flush. Burst mode only: the non-burst hybrid path still
+        # feeds train_fn on the mesh, which rejects a CPU-committed key.
+        rng = jax.device_put(rng, snapshot.host_device)
     mlp_keys = cfg.algo.mlp_keys.encoder
 
     step_data: Dict[str, np.ndarray] = {}

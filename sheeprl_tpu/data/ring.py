@@ -31,7 +31,9 @@ __all__ = [
     "BlobLayout",
     "effective_stage_buckets",
     "make_blob_layouts",
+    "make_layout",
     "pack_burst_blob",
+    "unpack_burst_blob",
 ]
 
 
@@ -150,6 +152,20 @@ class BlobLayout(NamedTuple):
     segments: Tuple[Tuple[str, int, tuple, Any], ...]  # (name, offset, shape, np.dtype)
 
 
+def make_layout(spec) -> BlobLayout:
+    """Build a :class:`BlobLayout` from ``(name, shape, dtype)`` triples.
+
+    Segment offsets are 4-byte aligned so 32-bit segments can be bitcast
+    from the uint8 view; total length is padded to a 4-byte multiple."""
+    segs = []
+    off = 0
+    for name, shape, dtype in spec:
+        off = (off + 3) & ~3
+        segs.append((name, off, tuple(int(s) for s in shape), np.dtype(dtype)))
+        off += int(np.prod(shape)) * np.dtype(dtype).itemsize
+    return BlobLayout((off + 3) & ~3, tuple(segs))
+
+
 def make_blob_layouts(
     ring_keys: Dict[str, Tuple[tuple, Any]],
     n_envs: int,
@@ -174,27 +190,20 @@ def make_blob_layouts(
     layouts: Dict[int, BlobLayout] = {}
     seen_lengths = set()
     for size in buckets:
-        segs = []
-        off = 0
-
-        def add(name, shape, dtype):
-            nonlocal off
-            off = (off + 3) & ~3
-            segs.append((name, off, tuple(int(s) for s in shape), np.dtype(dtype)))
-            off += int(np.prod(shape)) * np.dtype(dtype).itemsize
-
-        for k, (shape, dtype) in ring_keys.items():
-            add(k, (size, n_envs) + tuple(shape), dtype)
-        add("__mask__", (size, n_envs), np.int32)
-        add("__pos__", (n_envs,), np.int32)
-        add("__valid_n__", (n_envs,), np.int32)
-        add("__key__", (key_width,), np.uint32)
-        add("__validmask__", (grad_chunk,), np.float32)
-        total = (off + 3) & ~3
+        spec = [(k, (size, n_envs) + tuple(shape), dtype) for k, (shape, dtype) in ring_keys.items()]
+        spec += [
+            ("__mask__", (size, n_envs), np.int32),
+            ("__pos__", (n_envs,), np.int32),
+            ("__valid_n__", (n_envs,), np.int32),
+            ("__key__", (key_width,), np.uint32),
+            ("__validmask__", (grad_chunk,), np.float32),
+        ]
+        layout = make_layout(spec)
+        total = layout.nbytes
         while total in seen_lengths:
             total += 4
         seen_lengths.add(total)
-        layouts[int(size)] = BlobLayout(total, tuple(segs))
+        layouts[int(size)] = BlobLayout(total, layout.segments)
     return layouts
 
 
@@ -210,7 +219,7 @@ def pack_burst_blob(layout: BlobLayout, values: Dict[str, np.ndarray]) -> np.nda
     return blob
 
 
-def _unpack_burst_blob(blob: jax.Array, layout: BlobLayout) -> Dict[str, jax.Array]:
+def unpack_burst_blob(blob: jax.Array, layout: BlobLayout) -> Dict[str, jax.Array]:
     """Device side (traced): slice + bitcast each segment back out."""
     out = {}
     for name, off, shape, dtype in layout.segments:
@@ -343,7 +352,7 @@ def build_burst_train_step(
 
         def packed_burst(carry, rb, blob):
             layout = by_length[blob.shape[0]]
-            u = _unpack_burst_blob(blob, layout)
+            u = unpack_burst_blob(blob, layout)
             return shard_burst(
                 carry,
                 rb,
