@@ -36,8 +36,17 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Public v5e specs (Google Cloud TPU docs / the scaling-book numbers):
 # 197 bf16 TFLOP/s per chip; 1600 Gbps (= 200 GB/s) aggregate ICI per chip.
 V5E_ICI_BYTES_PER_S = 200e9
-# Measured quiet-chip step times from BENCH_NOTES.md (single chip):
-MEASURED_STEP_S = {"dreamer_v3": 2.14e-3, "ppo": 16.0e-3 / 20}  # ppo: 512-batch CPU proxy scaled
+# Measured quiet-chip step times from BENCH_NOTES.md (single chip).
+# dreamer_v3: the batch-16 x seq-64 S step measured 35.23 ms
+# (dreamer_train_bench, calibration-passed) — the analysis meshes carry the
+# same batch-16 PER DEVICE (weak scaling), so this is the per-device compute
+# at every dp. The 2.14 ms recorded in round 3 was an artifact of the
+# transport's pre-pull optimistic mode, where block_until_ready returns
+# without a real device sync (BENCH_NOTES "transport latency modes") — it
+# under-read the step ~16x and with it the collective/compute ratio.
+# ppo: 512-batch CPU proxy scaled (measured on the CPU backend, which has
+# no optimistic-mode artifact).
+MEASURED_STEP_S = {"dreamer_v3": 35.23e-3, "ppo": 16.0e-3 / 20}
 
 
 _TUPLE_ELEM_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
