@@ -1,11 +1,13 @@
 """Chip-quietness gate shared by every wall-clock benchmark.
 
-The sandbox TPU is time-shared between tenants: the same jitted program
-measured at 2.14 ms has been observed at 24.6 ms under co-tenant load, and
-round 3's flagship number was silently re-measured 40% low during a loud
-window (BENCH_NOTES.md "Measurement caveat"). A bench run is therefore only
-a measurement if the chip was quiet when it started AND when it ended —
-anything else is a load report.
+The sandbox TPU is time-shared between tenants: the same jitted program has
+been observed ~11x slower under co-tenant load (2.14 ms -> 24.6 ms in round
+3 — both readings were later shown to carry the optimistic-mode timing
+artifact, BENCH_NOTES "transport latency modes", but the relative swing is
+real), and round 3's flagship number was silently re-measured 40% low
+during a loud window (BENCH_NOTES.md "Measurement caveat"). A bench run is
+therefore only a measurement if the chip was quiet when it started AND when
+it ended — anything else is a load report.
 
 ``gate_quiet()`` probes a fixed ~1 GFLOP matmul chain, retries while the
 chip is loud, and REFUSES (exit status 3) if it never quiets down; benches

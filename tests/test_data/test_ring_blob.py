@@ -1,0 +1,127 @@
+"""Packed burst-blob layout/pack/unpack round-trips (data/ring.py).
+
+The burst flush ships ONE uint8 blob per dispatch (host-side
+``pack_burst_blob`` → device-side ``unpack_burst_blob`` inside the jit);
+these tests pin the byte-level contract the two sides share.
+"""
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.data.ring import (
+    BlobLayout,
+    effective_stage_buckets,
+    make_blob_layouts,
+    make_layout,
+    pack_burst_blob,
+    unpack_burst_blob,
+)
+
+
+def _roundtrip(layout, values):
+    blob = pack_burst_blob(layout, values)
+    assert blob.dtype == np.uint8 and blob.shape == (layout.nbytes,)
+    out = jax.jit(lambda b: unpack_burst_blob(b, layout))(blob)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def test_mixed_dtype_roundtrip_bit_exact():
+    rs = np.random.RandomState(0)
+    layout = make_layout(
+        [
+            ("pix", (3, 2, 4, 4, 3), np.uint8),
+            ("act", (3, 2, 6), np.float32),
+            ("idx", (2,), np.int32),
+            ("key", (2,), np.uint32),
+        ]
+    )
+    values = {
+        "pix": rs.randint(0, 256, (3, 2, 4, 4, 3)).astype(np.uint8),
+        "act": rs.randn(3, 2, 6).astype(np.float32),
+        "idx": np.array([7, -3], np.int32),
+        "key": np.array([0xDEADBEEF, 0x12345678], np.uint32),
+    }
+    out = _roundtrip(layout, values)
+    for k, v in values.items():
+        np.testing.assert_array_equal(out[k], v)
+
+
+def test_scalar_and_special_float_segments():
+    layout = make_layout([("pos", (), np.int32), ("x", (4,), np.float32)])
+    values = {
+        "pos": np.asarray(41, np.int32),
+        # NaN/inf/-0.0 must survive: the transport is a bitcast, not a cast.
+        "x": np.array([np.nan, np.inf, -0.0, 1e-38], np.float32),
+    }
+    out = _roundtrip(layout, values)
+    assert out["pos"].shape == () and int(out["pos"]) == 41
+    np.testing.assert_array_equal(
+        out["x"].view(np.uint32), values["x"].view(np.uint32)
+    )
+
+
+def test_pack_casts_to_segment_dtype():
+    layout = make_layout([("r", (3,), np.float32)])
+    # float64 rewards from the host are cast (not bitcast) before packing.
+    out = _roundtrip(layout, {"r": np.array([1.5, -2.0, 0.25], np.float64)})
+    np.testing.assert_array_equal(out["r"], np.array([1.5, -2.0, 0.25], np.float32))
+
+
+def test_offsets_are_4_byte_aligned():
+    layout = make_layout([("a", (3,), np.uint8), ("b", (2,), np.float32), ("c", (5,), np.uint8), ("d", (1,), np.int32)])
+    for name, off, shape, dtype in layout.segments:
+        if np.dtype(dtype).itemsize > 1:
+            assert off % 4 == 0, (name, off)
+    assert layout.nbytes % 4 == 0
+
+
+def test_every_runner_bucket_has_a_layout():
+    # The invariant the packed flush depends on: whatever bucket
+    # effective_stage_buckets yields, make_blob_layouts built a layout for it
+    # when fed the same normalized set.
+    ring_keys = {"rgb": ((4, 4, 3), np.uint8), "actions": ((2,), np.float32)}
+    raw = (18, 34)  # raw dreamer_stage_sizes-style tuple, no stage_max entry
+    stage_max = 67
+    buckets = effective_stage_buckets(raw, stage_max)
+    assert buckets[-1] == stage_max
+    layouts = make_blob_layouts(ring_keys, n_envs=2, grad_chunk=8, buckets=buckets)
+    for b in buckets:
+        assert b in layouts
+
+
+def test_blob_lengths_distinct_across_buckets():
+    # The blob length is the device-side trace/layout key: every distinct
+    # bucket must map to a distinct length (a layout lookup by length that
+    # could alias two buckets would unpack with the wrong shapes).
+    ring_keys = {"x": ((1,), np.float32), "pix": ((2, 2, 3), np.uint8)}
+    layouts = make_blob_layouts(ring_keys, n_envs=2, grad_chunk=4, buckets=(3, 9, 20))
+    assert isinstance(layouts[3], BlobLayout)
+    lengths = [l.nbytes for l in layouts.values()]
+    assert len(lengths) == len(set(lengths)) == 3
+    # and lengths grow with the bucket (segments scale with S)
+    assert lengths == sorted(lengths)
+
+
+def test_dreamer_layout_matches_runner_values():
+    # The exact segment set BurstRunner.flush packs, at a realistic shape.
+    ring_keys = {"rgb": ((8, 8, 3), np.uint8), "actions": ((4,), np.float32), "is_first": ((1,), np.float32)}
+    n_envs, grad_chunk = 2, 4
+    layouts = make_blob_layouts(ring_keys, n_envs, grad_chunk, (5,))
+    layout = layouts[5]
+    rs = np.random.RandomState(1)
+    values = {
+        "rgb": rs.randint(0, 256, (5, n_envs, 8, 8, 3)).astype(np.uint8),
+        "actions": rs.randn(5, n_envs, 4).astype(np.float32),
+        "is_first": rs.randint(0, 2, (5, n_envs, 1)).astype(np.float32),
+        "__mask__": rs.randint(0, 2, (5, n_envs)).astype(np.int32),
+        "__pos__": np.array([11, 3], np.int64),  # runner heads are int64; pack casts
+        "__valid_n__": np.array([40, 40], np.int64),
+        "__key__": np.asarray(jax.random.PRNGKey(7), np.uint32),
+        "__validmask__": np.array([1, 1, 0, 0], np.float32),
+    }
+    out = _roundtrip(layout, values)
+    np.testing.assert_array_equal(out["rgb"], values["rgb"])
+    np.testing.assert_array_equal(out["__mask__"], values["__mask__"])
+    np.testing.assert_array_equal(out["__pos__"], values["__pos__"].astype(np.int32))
+    np.testing.assert_array_equal(out["__key__"], values["__key__"])
+    np.testing.assert_array_equal(out["__validmask__"], values["__validmask__"])
