@@ -30,7 +30,9 @@ def main() -> None:
         # cpu`); pin the whole platform so backend discovery never contacts a
         # remote accelerator — the tunneled chip can wedge for minutes and
         # this metric must not hang with it.
-        jax.config.update("jax_platforms", "cpu")
+        from sheeprl_tpu.utils.utils import pin_cpu_platform
+
+        pin_cpu_platform("cpu")
         jax.config.update("jax_compilation_cache_dir", os.environ.get("BENCH_XLA_CACHE", "/root/repo/.xla_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:

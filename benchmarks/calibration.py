@@ -41,11 +41,10 @@ PROBE_FAILED = -1.0
 
 def pin_platform_for(accelerator: "str | None") -> None:
     """Pin ``jax_platforms=cpu`` for CPU-pinned benches BEFORE any backend
-    discovery (same guard as bench.py). No-op for accelerator=auto/tpu."""
-    if accelerator is not None and str(accelerator).lower() == "cpu":
-        import jax
+    discovery. No-op for accelerator=auto/tpu."""
+    from sheeprl_tpu.utils.utils import pin_cpu_platform
 
-        jax.config.update("jax_platforms", "cpu")
+    pin_cpu_platform(accelerator)
 
 
 def device_calibration_ms(accelerator: "str | None" = None) -> "float | None":

@@ -99,7 +99,10 @@ def _load_utils_module(entry: Dict[str, Any]):
 
 def run_algorithm(cfg: DotDict) -> None:
     """(reference: ``cli.py:59-198``)"""
+    from sheeprl_tpu.utils.utils import pin_cpu_platform
+
     os.environ.setdefault("OMP_NUM_THREADS", str(cfg.num_threads))
+    pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
 
     entry = resolve_algorithm(cfg.algo.name)
     if entry is None:
@@ -184,6 +187,9 @@ def eval_algorithm(cfg: DotDict) -> None:
     """(reference: ``cli.py:201-267``)"""
     from sheeprl_tpu.parallel import Fabric
     from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.utils.utils import pin_cpu_platform
+
+    pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
 
     fabric = Fabric(devices=1, accelerator=cfg.fabric.get("accelerator", "auto"), precision=str(cfg.fabric.get("precision", "32-true")))
     fabric.seed_everything(cfg.seed if cfg.get("seed") is not None else 42)

@@ -102,17 +102,35 @@ def set_validate_args(enabled: bool) -> None:
     Distribution.validate_args = bool(enabled)
 
 
+def _lift(x: Any) -> Any:
+    """Promote sub-f32 floating parameters (bf16-mixed trunk outputs) to f32.
+
+    Mixed-precision policy shared by every distribution here: matmuls/convs
+    run in the fabric compute dtype (``Precision.compute_dtype``), but
+    distribution math — softmax normalizers, log-probs, KLs, entropies —
+    runs in f32, because sub-f32 logsumexp/log arithmetic visibly degrades
+    DreamerV3's KL-balanced losses. Samples are cast back to the
+    pre-promotion dtype (kept as ``_sample_dtype`` on each instance) so
+    ``lax.scan`` carries built from samples keep their bf16 avals. No-op
+    for f32 parameters, so pure-f32 configs are bit-identical.
+    """
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype.itemsize < 4:
+        return x.astype(jnp.float32)
+    return x
+
+
 class Normal(Distribution):
     def __init__(self, loc: jax.Array, scale: jax.Array):
         self._check_broadcastable("Normal", loc, scale)
         self._check_floating("Normal", loc=loc, scale=scale)
-        self.loc = loc
-        self.scale = scale
+        self._sample_dtype = jnp.result_type(loc)
+        self.loc = _lift(loc)
+        self.scale = _lift(scale)
 
     def sample(self, key, sample_shape=()):
         shape = tuple(sample_shape) + jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
         eps = jax.random.normal(key, shape, dtype=jnp.result_type(self.loc))
-        return self.loc + self.scale * eps
+        return (self.loc + self.scale * eps).astype(self._sample_dtype)
 
     def log_prob(self, value):
         var = self.scale**2
@@ -123,7 +141,11 @@ class Normal(Distribution):
 
     @property
     def mean(self):
-        return jnp.broadcast_to(self.loc, jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale)))
+        # Same dtype as sample(): greedy (mode/mean) and sampled action paths
+        # must produce identical avals or the policy jit retraces on eval.
+        return jnp.broadcast_to(self.loc, jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))).astype(
+            self._sample_dtype
+        )
 
     @property
     def mode(self):
@@ -172,6 +194,7 @@ class Categorical(Distribution):
     """Integer-valued categorical over the last axis of ``logits``."""
 
     def __init__(self, logits: jax.Array):
+        logits = _lift(logits)
         self.logits = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
 
     @property
@@ -215,7 +238,8 @@ class OneHotCategorical(Distribution):
     def __init__(self, logits: jax.Array, unimix: float = 0.0):
         if Distribution.validate_args and jnp.ndim(logits) < 1:
             raise ValueError(f"OneHotCategorical: logits must have at least 1 dim, got {jnp.ndim(logits)}")
-        logits = _unimix_logits(logits, unimix)
+        self._sample_dtype = jnp.result_type(logits)
+        logits = _unimix_logits(_lift(logits), unimix)
         self.logits = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
 
     @property
@@ -228,7 +252,7 @@ class OneHotCategorical(Distribution):
 
     def sample(self, key, sample_shape=()):
         idx = jax.random.categorical(key, self.logits, axis=-1, shape=tuple(sample_shape) + self.logits.shape[:-1])
-        sample = jax.nn.one_hot(idx, self.num_classes, dtype=self.logits.dtype)
+        sample = jax.nn.one_hot(idx, self.num_classes, dtype=self._sample_dtype)
         return jax.lax.stop_gradient(sample)
 
     def log_prob(self, value):
@@ -239,7 +263,7 @@ class OneHotCategorical(Distribution):
 
     @property
     def mode(self):
-        return jax.nn.one_hot(jnp.argmax(self.logits, axis=-1), self.num_classes, dtype=self.logits.dtype)
+        return jax.nn.one_hot(jnp.argmax(self.logits, axis=-1), self.num_classes, dtype=self._sample_dtype)
 
     @property
     def mean(self):
@@ -252,7 +276,9 @@ class OneHotCategoricalStraightThrough(OneHotCategorical):
 
     def rsample(self, key, sample_shape=()):
         hard = super().sample(key, sample_shape)
-        probs = self.probs
+        # The straight-through pass-through rides the sample dtype (the f32
+        # probs would otherwise promote the sample and break carry avals).
+        probs = self.probs.astype(self._sample_dtype)
         return hard + probs - jax.lax.stop_gradient(probs)
 
     def sample(self, key, sample_shape=()):
@@ -266,27 +292,36 @@ class TanhNormal(Distribution):
     def __init__(self, loc: jax.Array, scale: jax.Array):
         self.base = Normal(loc, scale)
 
+    def _pre_sample(self, key, sample_shape=()):
+        # f32 pre-squash draw: ``base.sample`` would cast back to the bf16
+        # sample dtype, where tanh saturates to exactly ±1 for |pre| ≳ 3.3
+        # and the log1p(-action²) correction below returns -inf.
+        b = self.base
+        shape = tuple(sample_shape) + jnp.broadcast_shapes(jnp.shape(b.loc), jnp.shape(b.scale))
+        eps = jax.random.normal(key, shape, dtype=jnp.result_type(b.loc))
+        return b.loc + b.scale * eps
+
     def sample(self, key, sample_shape=()):
-        return jnp.tanh(self.base.sample(key, sample_shape))
+        return jnp.tanh(self._pre_sample(key, sample_shape)).astype(self.base._sample_dtype)
 
     def sample_and_log_prob(self, key, sample_shape=()):
-        pre = self.base.sample(key, sample_shape)
+        pre = self._pre_sample(key, sample_shape)
         action = jnp.tanh(pre)
         log_prob = self.base.log_prob(pre) - jnp.log1p(-action**2 + 1e-6)
-        return action, log_prob
+        return action.astype(self.base._sample_dtype), log_prob
 
     def log_prob(self, value):
-        value = jnp.clip(value, -1 + 1e-6, 1 - 1e-6)
+        value = jnp.clip(_lift(value), -1 + 1e-6, 1 - 1e-6)
         pre = jnp.arctanh(value)
         return self.base.log_prob(pre) - jnp.log1p(-value**2 + 1e-6)
 
     @property
     def mean(self):
-        return jnp.tanh(self.base.mean)
+        return jnp.tanh(_lift(self.base.mean)).astype(self.base._sample_dtype)
 
     @property
     def mode(self):
-        return jnp.tanh(self.base.mode)
+        return jnp.tanh(_lift(self.base.mode)).astype(self.base._sample_dtype)
 
 
 # -- truncated normal --------------------------------------------------------
@@ -315,6 +350,8 @@ class TruncatedNormal(Distribution):
             self._check_broadcastable("TruncatedNormal", loc, scale)
             if not (float(low) < float(high)):
                 raise ValueError(f"TruncatedNormal: low ({low}) must be < high ({high})")
+        self._sample_dtype = jnp.result_type(loc)
+        loc, scale = _lift(loc), _lift(scale)
         self.loc = loc
         self.scale = scale
         self.low = low
@@ -335,7 +372,7 @@ class TruncatedNormal(Distribution):
         u = jax.random.uniform(key, shape, dtype=jnp.result_type(self.loc))
         p = self._phi_alpha + u * self._Z
         x = self.loc + self.scale * self._big_phi_inv(p)
-        return jnp.clip(x, self.low + self.eps, self.high - self.eps)
+        return jnp.clip(x, self.low + self.eps, self.high - self.eps).astype(self._sample_dtype)
 
     def log_prob(self, value):
         z = (value - self.loc) / self.scale
@@ -357,14 +394,17 @@ class TruncatedNormal(Distribution):
     @property
     def mean(self):
         phi = lambda x: jnp.exp(-0.5 * x**2) / math.sqrt(2 * math.pi)  # noqa: E731
-        return self.loc + self.scale * (phi(self._alpha) - phi(self._beta)) / self._Z
+        return (self.loc + self.scale * (phi(self._alpha) - phi(self._beta)) / self._Z).astype(self._sample_dtype)
 
     @property
     def mode(self):
-        return jnp.clip(self.loc, self.low, self.high)
+        return jnp.clip(self.loc, self.low, self.high).astype(self._sample_dtype)
 
 
 # -- Dreamer decoder heads ---------------------------------------------------
+# (no _lift on the stored mode: log_prob subtracts against f32 targets, which
+# promotes the arithmetic anyway — lifting would materialize the full-pixel
+# recon tensor in f32 for nothing)
 
 
 class SymlogDistribution(Distribution):
@@ -428,6 +468,7 @@ class TwoHotEncodingDistribution(Distribution):
         transfwd=symlog,
         transbwd=symexp,
     ):
+        logits = _lift(logits)
         self.logits = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
         self.dims = dims
         self.low = low
@@ -475,7 +516,8 @@ class BernoulliSafeMode(Distribution):
     (reference: ``distribution.py:407-414``)."""
 
     def __init__(self, logits: jax.Array):
-        self.logits = logits
+        self._sample_dtype = jnp.result_type(logits)
+        self.logits = _lift(logits)
 
     @property
     def probs(self):
@@ -484,7 +526,7 @@ class BernoulliSafeMode(Distribution):
     def sample(self, key, sample_shape=()):
         shape = tuple(sample_shape) + jnp.shape(self.logits)
         u = jax.random.uniform(key, shape)
-        return (u < self.probs).astype(self.logits.dtype)
+        return (u < self.probs).astype(self._sample_dtype)
 
     def log_prob(self, value):
         return -_binary_cross_entropy_with_logits(self.logits, value)
@@ -495,7 +537,7 @@ class BernoulliSafeMode(Distribution):
 
     @property
     def mode(self):
-        return (self.probs > 0.5).astype(self.logits.dtype)
+        return (self.probs > 0.5).astype(self._sample_dtype)
 
     @property
     def mean(self):

@@ -26,6 +26,25 @@ __all__ = [
 ]
 
 
+def pin_cpu_platform(accelerator: Any) -> None:
+    """Pin ``jax_platforms=cpu`` for CPU-pinned runs BEFORE any backend
+    discovery. A ``fabric.accelerator: cpu`` run must never initialize the
+    remote accelerator: discovery contacts every registered platform, and a
+    wedged tunneled chip then hangs the process at init — before the CPU
+    mesh is even built. No-op for accelerator=auto/tpu. The sandbox's
+    sitecustomize overrides the ``JAX_PLATFORMS`` env var, so this must be
+    a config update; shared by the CLI, ``bench.py``,
+    ``benchmarks/calibration.py`` and ``tests/conftest.py``."""
+    if accelerator is None or str(accelerator).lower() != "cpu":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # pragma: no cover - only after a backend is live
+        warnings.warn(f"Could not pin jax_platforms=cpu: {e}")
+
+
 def polynomial_decay(
     current_step: int,
     *,

@@ -13,7 +13,9 @@ import jax  # noqa: E402
 
 # The sandbox may pin an accelerator platform via sitecustomize; force CPU
 # (the reference's LT_DEVICES analogue needs a local many-device mesh).
-jax.config.update("jax_platforms", "cpu")
+from sheeprl_tpu.utils.utils import pin_cpu_platform  # noqa: E402
+
+pin_cpu_platform("cpu")
 
 # Persistent XLA compilation cache: the dreamer/p2e train steps take tens of
 # seconds to compile; caching them across test runs keeps the suite usable.

@@ -360,6 +360,17 @@ def test_dreamer_v3_bf16_precision(tmp_path):
     run(_std_args(tmp_path, "dreamer_v3", extra=DREAMER_FAST + ["fabric.precision=bf16-mixed"]))
 
 
+def test_dreamer_v1_bf16_precision(tmp_path):
+    """Normal-posterior RSSM under bf16-mixed: samples carry bf16 through the
+    scan while distribution math is promoted to f32 (distributions/core.py
+    ``_lift``)."""
+    run(_std_args(tmp_path, "dreamer_v1", extra=DREAMER_V1_FAST + ["fabric.precision=bf16-mixed"]))
+
+
+def test_dreamer_v2_bf16_precision(tmp_path):
+    run(_std_args(tmp_path, "dreamer_v2", extra=DREAMER_V2_FAST + ["fabric.precision=bf16-mixed"]))
+
+
 def test_unknown_algorithm_errors(tmp_path):
     with pytest.raises(Exception):
         run([f"exp=not_an_algo", f"log_root={tmp_path}/logs"])

@@ -150,3 +150,67 @@ def test_validate_args_static_checks():
         set_validate_args(False)
     # disabled: no checks
     Normal(jnp.zeros(3, dtype=jnp.int32), jnp.ones(3))
+
+
+def test_bf16_params_promote_math_but_not_samples():
+    """Mixed-precision policy (bf16-mixed trunks): distribution math runs in
+    f32, samples keep the parameter dtype so scan carries keep bf16 avals;
+    f32 parameters are untouched."""
+    from sheeprl_tpu.distributions import (
+        BernoulliSafeMode,
+        TanhNormal,
+        TwoHotEncodingDistribution,
+    )
+
+    key = jax.random.PRNGKey(0)
+    logits16 = jax.random.normal(key, (4, 8)).astype(jnp.bfloat16)
+
+    d = OneHotCategoricalStraightThrough(logits=logits16, unimix=0.01)
+    s = d.rsample(key)
+    assert s.dtype == jnp.bfloat16
+    assert d.logits.dtype == jnp.float32
+    assert d.log_prob(s).dtype == jnp.float32
+    assert d.entropy().dtype == jnp.float32
+
+    # f32 math matches an all-f32 construction to f32-roundoff of the inputs
+    d32 = OneHotCategoricalStraightThrough(logits=logits16.astype(jnp.float32), unimix=0.01)
+    np.testing.assert_allclose(np.asarray(d.logits), np.asarray(d32.logits), rtol=1e-6)
+
+    n = Normal(jnp.zeros(3, jnp.bfloat16), jnp.ones(3, jnp.bfloat16))
+    assert n.sample(key).dtype == jnp.bfloat16
+    assert n.log_prob(n.sample(key)).dtype == jnp.float32
+
+    t = TwoHotEncodingDistribution(jnp.zeros((4, 255), jnp.bfloat16))
+    assert t.mean.dtype == jnp.float32
+    assert t.log_prob(jnp.ones((4, 1))).dtype == jnp.float32
+
+    b = BernoulliSafeMode(jnp.zeros((4,), jnp.bfloat16))
+    assert b.mode.dtype == jnp.bfloat16
+    assert b.log_prob(jnp.ones(4)).dtype == jnp.float32
+
+    a, lp = TanhNormal(jnp.zeros(3, jnp.bfloat16), jnp.ones(3, jnp.bfloat16)).sample_and_log_prob(key)
+    assert a.dtype == jnp.bfloat16 and lp.dtype == jnp.float32
+
+    # greedy (mode/mean) and sampled paths must produce the SAME aval, or the
+    # policy jit retraces between train and eval
+    from sheeprl_tpu.distributions import TruncatedNormal
+
+    for d in (
+        Normal(jnp.zeros(3, jnp.bfloat16), jnp.ones(3, jnp.bfloat16)),
+        TanhNormal(jnp.zeros(3, jnp.bfloat16), jnp.ones(3, jnp.bfloat16)),
+        TruncatedNormal(jnp.zeros(3, jnp.bfloat16), jnp.ones(3, jnp.bfloat16)),
+        OneHotCategoricalStraightThrough(logits=logits16),
+    ):
+        assert d.mode.dtype == d.sample(key).dtype == jnp.bfloat16, type(d).__name__
+        assert d.mean.dtype in (jnp.bfloat16, jnp.float32)
+
+    # saturation: a far-out-in-the-tail draw must NOT produce inf/NaN
+    # log-probs — the tanh correction runs in f32 even when samples are bf16
+    big = TanhNormal(jnp.full(4, 4.0, jnp.bfloat16), jnp.full(4, 0.1, jnp.bfloat16))
+    act, lp = big.sample_and_log_prob(key)
+    assert bool(jnp.all(jnp.isfinite(lp))), np.asarray(lp)
+    assert bool(jnp.all(jnp.isfinite(big.log_prob(act)))), np.asarray(big.log_prob(act))
+
+    # pure-f32 configs: bit-identical to before (no hidden casts)
+    f = OneHotCategoricalStraightThrough(logits=jnp.zeros((2, 4)), unimix=0.01)
+    assert f.rsample(key).dtype == jnp.float32 and f.logits.dtype == jnp.float32
