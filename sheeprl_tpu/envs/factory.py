@@ -273,15 +273,34 @@ def make_env(
     return thunk
 
 
-def vectorize_env(cfg: Dict[str, Any], seed: int, rank: int, run_name: Optional[str] = None, prefix: str = ""):
+def vectorize_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    restart_on_exception: bool = False,
+):
     """Build the Sync/Async vector env with SAME_STEP autoreset
-    (reference launch point: ``ppo.py:137-150``)."""
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+    (reference launch point: ``ppo.py:137-150``). The sync path uses
+    :class:`sheeprl_tpu.envs.vector.FastSyncVectorEnv` (the env step is on
+    the host critical path of every coupled main — see its docstring);
+    ``restart_on_exception`` wraps each sub-env in
+    :class:`~sheeprl_tpu.envs.wrappers.RestartOnException` (the long-run
+    Dreamer/P2E mains, mirroring the reference's minedojo resilience)."""
+    from functools import partial
+
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode
+
+    from sheeprl_tpu.envs.vector import FastSyncVectorEnv
+    from sheeprl_tpu.envs.wrappers import RestartOnException
 
     thunks = [
         make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix=prefix, vector_env_idx=i)
         for i in range(cfg.env.num_envs)
     ]
+    if restart_on_exception:
+        thunks = [partial(RestartOnException, t) for t in thunks]
     if cfg.env.sync_env:
-        return SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        return FastSyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
     return AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)

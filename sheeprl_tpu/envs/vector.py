@@ -1,0 +1,89 @@
+"""Lean synchronous vector env (TPU-native hot-loop component).
+
+The env step is on the host critical path of every coupled algorithm: with
+the policy a single jitted dispatch (see ``PPOPlayer.rollout_step``), the
+reference-conditions PPO benchmark spends ~40% of its per-step budget inside
+``gymnasium.vector.SyncVectorEnv``'s generic glue — ``iterate`` over the
+action space, per-env ``_add_info`` calls on empty infos, and a full
+``deepcopy`` of the batched observations every step. None of that is needed
+by this repo's algorithm mains, which copy what they keep into replay
+buffers within the same step.
+
+:class:`FastSyncVectorEnv` keeps gymnasium's semantics — SAME_STEP autoreset
+(``final_obs``/``final_info`` + ``_final_obs`` masks via the inherited
+``_add_info``), identical reset/seed behavior, identical spaces — but:
+
+- indexes the batched action array directly instead of ``iterate()`` (with a
+  fallback to the parent implementation for non-array action spaces);
+- skips ``_add_info`` when a sub-env returned an empty info dict (the common
+  case on every non-terminal step);
+- writes batched observations into ping-pong buffers instead of deepcopying:
+  the returned batch stays valid until the *next* ``step()`` call, which is
+  the lifetime every main needs (data is copied into buffers/jit inputs in
+  the same iteration).
+
+Used by ``envs.factory.vectorize_env`` for ``env.sync_env=True``; the async
+path stays on gymnasium's ``AsyncVectorEnv`` (worker processes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+from gymnasium import Env
+from gymnasium.spaces import Box, Discrete, MultiBinary, MultiDiscrete
+from gymnasium.vector import AutoresetMode, SyncVectorEnv
+from gymnasium.vector.utils import concatenate, create_empty_array
+
+__all__ = ["FastSyncVectorEnv"]
+
+
+class FastSyncVectorEnv(SyncVectorEnv):
+    """Drop-in :class:`gymnasium.vector.SyncVectorEnv` with a fast SAME_STEP
+    hot path (see module docstring). ``copy`` is forced off; observation
+    batches are double-buffered instead."""
+
+    def __init__(
+        self,
+        env_fns: Iterator[Callable[[], Env]] | Sequence[Callable[[], Env]],
+        autoreset_mode: AutoresetMode = AutoresetMode.SAME_STEP,
+    ):
+        super().__init__(env_fns, copy=False, autoreset_mode=autoreset_mode)
+        self._obs_buffers = [
+            create_empty_array(self.single_observation_space, n=self.num_envs, fn=np.zeros) for _ in range(2)
+        ]
+        self._buf_idx = 0
+        # Array-indexable batched action spaces take the fast path; anything
+        # exotic (Dict/Tuple actions) falls back to gymnasium's step.
+        self._fast_actions = isinstance(self.single_action_space, (Box, Discrete, MultiDiscrete, MultiBinary))
+
+    def step(self, actions):
+        if not self._fast_actions or self.autoreset_mode != AutoresetMode.SAME_STEP:
+            return super().step(actions)
+
+        actions = np.asarray(actions)
+        infos: dict[str, Any] = {}
+        for i in range(self.num_envs):
+            obs_i, self._rewards[i], term, trunc, env_info = self.envs[i].step(actions[i])
+            self._terminations[i] = term
+            self._truncations[i] = trunc
+            if term or trunc:
+                infos = self._add_info(infos, {"final_obs": obs_i, "final_info": env_info}, i)
+                obs_i, env_info = self.envs[i].reset()
+            self._env_obs[i] = obs_i
+            if env_info:
+                infos = self._add_info(infos, env_info, i)
+
+        buf = self._obs_buffers[self._buf_idx]
+        self._buf_idx ^= 1
+        self._observations = concatenate(self.single_observation_space, self._env_obs, buf)
+        self._autoreset_envs = np.logical_or(self._terminations, self._truncations)
+
+        return (
+            self._observations,
+            np.copy(self._rewards),
+            np.copy(self._terminations),
+            np.copy(self._truncations),
+            infos,
+        )
