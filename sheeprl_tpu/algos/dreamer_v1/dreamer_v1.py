@@ -34,7 +34,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.ring import build_burst_train_step
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal
 from sheeprl_tpu.parallel.comm import pmean_grads
-from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -246,21 +246,8 @@ def main(fabric, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg)
     print(f"Log dir: {log_dir}")
 
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
-    thunks = [
-        make_env(
-            cfg,
-            cfg.seed + rank * cfg.env.num_envs + i,
-            rank,
-            log_dir if rank == 0 else None,
-            prefix="train",
-            vector_env_idx=i,
-        )
-        for i in range(cfg.env.num_envs)
-    ]
-    vector_cls = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
-    envs = vector_cls(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    envs = vectorize_env(cfg, cfg.seed, rank, log_dir if rank == 0 else None, prefix="train")
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

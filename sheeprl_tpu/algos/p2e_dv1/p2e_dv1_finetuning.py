@@ -25,7 +25,7 @@ from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_train_step
 from sheeprl_tpu.algos.dreamer_v1.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.envs.factory import make_env
+from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -66,21 +66,8 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg)
     print(f"Log dir: {log_dir}")
 
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
-    thunks = [
-        make_env(
-            cfg,
-            cfg.seed + rank * cfg.env.num_envs + i,
-            rank,
-            log_dir if rank == 0 else None,
-            prefix="train",
-            vector_env_idx=i,
-        )
-        for i in range(cfg.env.num_envs)
-    ]
-    vector_cls = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
-    envs = vector_cls(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    envs = vectorize_env(cfg, cfg.seed, rank, log_dir if rank == 0 else None, prefix="train")
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import os
 import warnings
-from functools import partial
 from typing import Any, Dict, Sequence
 
 import gymnasium as gym
@@ -53,8 +52,7 @@ from sheeprl_tpu.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.parallel.comm import pmean_grads
-from sheeprl_tpu.envs.factory import make_env
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -457,24 +455,10 @@ def main(fabric, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg)
     print(f"Log dir: {log_dir}")
 
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
-    thunks = [
-        partial(
-            RestartOnException,
-            make_env(
-                cfg,
-                cfg.seed + rank * cfg.env.num_envs + i,
-                rank,
-                log_dir if rank == 0 else None,
-                prefix="train",
-                vector_env_idx=i,
-            ),
-        )
-        for i in range(cfg.env.num_envs)
-    ]
-    vector_cls = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
-    envs = vector_cls(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    envs = vectorize_env(
+        cfg, cfg.seed, rank, log_dir if rank == 0 else None, prefix="train", restart_on_exception=True
+    )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

@@ -15,7 +15,6 @@ from __future__ import annotations
 import os
 import pathlib
 import warnings
-from functools import partial
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -28,8 +27,7 @@ from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
 from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.envs.factory import make_env
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -73,24 +71,10 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg)
     print(f"Log dir: {log_dir}")
 
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
-    thunks = [
-        partial(
-            RestartOnException,
-            make_env(
-                cfg,
-                cfg.seed + rank * cfg.env.num_envs + i,
-                rank,
-                log_dir if rank == 0 else None,
-                prefix="train",
-                vector_env_idx=i,
-            ),
-        )
-        for i in range(cfg.env.num_envs)
-    ]
-    vector_cls = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
-    envs = vector_cls(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    envs = vectorize_env(
+        cfg, cfg.seed, rank, log_dir if rank == 0 else None, prefix="train", restart_on_exception=True
+    )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

@@ -153,7 +153,12 @@ class Normal(Distribution):
 
     @property
     def stddev(self):
-        return jnp.broadcast_to(self.scale, jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale)))
+        # Same dtype contract as mean/mode/sample (uniform _sample_dtype
+        # surface): a bf16 carry built from stddev must not retrace against
+        # the sampled path (ADVICE r4).
+        return jnp.broadcast_to(
+            self.scale, jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+        ).astype(self._sample_dtype)
 
 
 class Independent(Distribution):
@@ -267,7 +272,10 @@ class OneHotCategorical(Distribution):
 
     @property
     def mean(self):
-        return self.probs
+        # Same _sample_dtype contract as mode/sample (see Normal.mean): the
+        # probs are f32 math internally but the surface dtype must match the
+        # sampled path or a carry built from mean retraces under bf16.
+        return self.probs.astype(self._sample_dtype)
 
 
 class OneHotCategoricalStraightThrough(OneHotCategorical):

@@ -60,9 +60,20 @@ class FastSyncVectorEnv(SyncVectorEnv):
 
     def step(self, actions):
         if not self._fast_actions or self.autoreset_mode != AutoresetMode.SAME_STEP:
-            return super().step(actions)
+            obs, rewards, terminations, truncations, infos = super().step(actions)
+            # The parent ran with copy=False, so ``obs`` is an internal buffer
+            # overwritten by the NEXT step. Re-concatenate from the per-env
+            # observations into the alternating buffer so the fallback honors
+            # the same 2-step lifetime contract as the fast path (the mains
+            # read the previous batch after the next step() call).
+            buf = self._obs_buffers[self._buf_idx]
+            self._buf_idx ^= 1
+            self._observations = concatenate(self.single_observation_space, self._env_obs, buf)
+            return self._observations, rewards, terminations, truncations, infos
 
         actions = np.asarray(actions)
+        if len(actions) != self.num_envs:
+            raise ValueError(f"Expected {self.num_envs} actions, got {len(actions)}")
         infos: dict[str, Any] = {}
         for i in range(self.num_envs):
             obs_i, self._rewards[i], term, trunc, env_info = self.envs[i].step(actions[i])
