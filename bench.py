@@ -6,6 +6,16 @@ ppo_benchmarks.yaml``: 65536 total steps, 1 env, sync, logging/checkpoints
 off; reference wall-clock 81.27 s on 4 CPUs → ~806 env-steps/s, see
 BASELINE.md).
 
+``BENCH_METRIC`` selects the measured topology (default unchanged so the
+recorded trajectory stays comparable):
+
+- ``host`` (default) — ``ppo_cartpole_env_steps_per_sec``: the host-loop
+  PPO (``exp=ppo_benchmarks``), one jitted policy dispatch per env step;
+- ``ondevice`` — ``ppo_cartpole_ondevice_env_steps_per_sec``: the Anakin
+  path (``exp=ppo_anakin_benchmarks``, same model/optim/data conditions)
+  with the rollout fused in-graph over the pure-JAX CartPole
+  (howto/on_device_rollout.md).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
@@ -38,9 +48,24 @@ def main() -> None:
     except Exception:
         pass
 
-    total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", 65536))
+    which = os.environ.get("BENCH_METRIC", "host").strip().lower()
+    if which in ("", "host", "default", "ppo_cartpole_env_steps_per_sec"):
+        metric = "ppo_cartpole_env_steps_per_sec"
+        exp = "ppo_benchmarks"
+        default_steps = 65536
+    elif which in ("ondevice", "anakin", "ppo_cartpole_ondevice_env_steps_per_sec"):
+        metric = "ppo_cartpole_ondevice_env_steps_per_sec"
+        exp = "ppo_anakin_benchmarks"
+        # The fused path retires 65536 steps in ~3s of loop time: at the host
+        # metric's step count the measurement is interpreter/compile-bound,
+        # not framework-bound. 16x the steps keeps the whole-wall convention
+        # while the training loop dominates (still well under a minute).
+        default_steps = 1048576
+    else:
+        raise SystemExit(f"Unknown BENCH_METRIC '{which}' (expected 'host' or 'ondevice')")
+    total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", default_steps))
     overrides = [
-        "exp=ppo_benchmarks",
+        f"exp={exp}",
         f"algo.total_steps={total_steps}",
         "env.capture_video=False",
         "buffer.memmap=False",
@@ -57,7 +82,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "ppo_cartpole_env_steps_per_sec",
+                "metric": metric,
                 "value": round(steps_per_sec, 2),
                 "unit": "env-steps/s",
                 "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),

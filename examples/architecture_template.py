@@ -32,6 +32,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+from sheeprl_tpu.parallel.compat import shard_map
 
 
 def main() -> None:
@@ -76,7 +77,7 @@ def main() -> None:
         return optax.apply_updates(params, updates), opt, loss
 
     train_step = jax.jit(
-        jax.shard_map(
+        shard_map(
             _step,
             mesh=fabric.mesh,
             in_specs=(P(), P(), P("dp"), P("dp")),

@@ -33,6 +33,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregat
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import save_configs
+from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = ["main", "make_train_step"]
 
@@ -94,7 +95,7 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int):
         pg, v = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, opt_state, pg, v
 
-    shard_train = jax.shard_map(
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P("dp"), P()),

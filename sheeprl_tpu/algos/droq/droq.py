@@ -40,6 +40,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregat
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = ["main", "make_train_step"]
 
@@ -107,7 +108,7 @@ def make_train_step(agent: DROQAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh):
         ll = jax.lax.pmean(alpha_loss, "dp")
         return params, aopt, copt, lopt, qf, al, ll
 
-    shard_train = jax.shard_map(
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, "dp"), P("dp"), P()),

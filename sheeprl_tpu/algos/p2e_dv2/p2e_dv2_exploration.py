@@ -35,6 +35,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregat
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, conv_heavy_compile_options, resolve_hybrid_player, save_configs
+from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = ["main", "make_train_step"]
 
@@ -331,7 +332,7 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
         metrics = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), metrics)
         return params, opts, metrics
 
-    shard_train = jax.shard_map(
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P(None, None, "dp"), P(), P()),

@@ -47,12 +47,17 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregat
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.parallel.compat import axis_size, shard_map
 
-__all__ = ["main", "make_train_step"]
+__all__ = ["main", "make_train_step", "make_local_train"]
 
 
-def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True):
-    """Build the fully-jitted optimization step (see module docstring).
+def make_local_train(agent, tx, cfg, local_batch: int):
+    """Build the per-device epoch/minibatch optimization body (see module
+    docstring) — a function ``(params, opt_state, data, key, clip_coef,
+    ent_coef) -> (params, opt_state, pg, v, ent)`` that must run inside a
+    ``shard_map`` with a ``dp`` axis. :func:`make_train_step` wraps it for
+    the host-loop path; ``ppo_anakin`` fuses it after an on-device rollout.
 
     ``buffer.share_data`` (reference ``ppo.py:40-47,362-366``: all_gather +
     DistributedSampler) maps to an in-graph ``lax.all_gather`` over ``dp``
@@ -64,6 +69,14 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True)
     mb_size = int(cfg.algo.per_rank_batch_size)
     n_mb = max(1, -(-local_batch // mb_size))
     padded = n_mb * mb_size
+    if local_batch % mb_size != 0:
+        warnings.warn(
+            f"Per-device batch ({local_batch}) is not divisible by per_rank_batch_size ({mb_size}): "
+            f"the last minibatch of every epoch cyclically repeats {padded - local_batch} already-sampled "
+            "transitions (the reference instead emits a ragged last batch). Adjust rollout_steps/num_envs/"
+            "per_rank_batch_size to avoid duplicated gradient samples.",
+            UserWarning,
+        )
     update_epochs = int(cfg.algo.update_epochs)
     clip_vloss = bool(cfg.algo.clip_vloss)
     normalize_adv = bool(cfg.algo.normalize_advantages)
@@ -101,7 +114,7 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True)
 
     def local_train(params, opt_state, data, key, clip_coef, ent_coef):
         # shapes here are per-device: (local_batch, ...)
-        n_dev = jax.lax.axis_size("dp")
+        n_dev = axis_size("dp")
         if share_data:
             # every device sees the GLOBAL batch; the sampler key stays
             # common across devices (the reference's same-seed
@@ -132,7 +145,15 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True)
         pg, v, ent = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, opt_state, pg, v, ent
 
-    shard_train = jax.shard_map(
+    return local_train
+
+
+def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True):
+    """Wrap :func:`make_local_train` in the jitted ``shard_map`` used by the
+    host-loop path: data batch-sharded on ``dp``, params replicated."""
+    local_train = make_local_train(agent, tx, cfg, local_batch)
+
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P("dp"), P(), P(), P()),
@@ -343,17 +364,21 @@ def main(fabric, cfg: Dict[str, Any]):
                         print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
         # GAE on device (reference: ppo.py:346-360)
-        local_data = rb.to_tensor()
+        local_data = rb.to_numpy()
         jobs = prepare_obs(fabric, next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
         next_values = player.get_values(params, jobs)
         returns, advantages = gae_fn(
             local_data["rewards"], local_data["values"], local_data["dones"], next_values
         )
-        local_data["returns"] = returns
-        local_data["advantages"] = advantages
 
-        # Flatten (T, N) → batch and shard over the mesh
+        # Stage ONCE: flatten (T, N) → batch as host-side views (contiguous
+        # reshape, no copy), keep the GAE outputs on device, and ship the
+        # whole dict in a single sharded device_put — the old path staged
+        # every key to the default device (to_tensor) and then re-sharded it
+        # key by key, two copies per key per iteration.
         flat_data = {k: v.reshape(-1, *v.shape[2:]) for k, v in local_data.items()}
+        flat_data["returns"] = returns.reshape(-1, *returns.shape[2:])
+        flat_data["advantages"] = advantages.reshape(-1, *advantages.shape[2:])
         flat_data = fabric.shard_data(flat_data)
 
         with timer("Time/train_time", SumMetric):

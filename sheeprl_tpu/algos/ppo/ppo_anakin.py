@@ -1,0 +1,434 @@
+"""PPO — fully on-device (Anakin) training over pure-JAX envs.
+
+The host-loop PPO (``ppo.py``) drives its rollout from Python: one jitted
+policy dispatch plus a host↔device round-trip per env step, which caps the
+CartPole benchmark at a few thousand env-steps/s with the TPU idle between
+dispatches. Following the Podracer/Anakin architecture
+(https://arxiv.org/pdf/2104.06272), when the environment itself is a JAX
+function the ENTIRE iteration — rollout, bootstrap, GAE, ``update_epochs`` ×
+minibatches — compiles into one XLA program:
+
+- the env step is a :class:`~sheeprl_tpu.envs.jax_envs.BatchedJaxEnv`
+  (``vmap`` over envs, SAME_STEP auto-reset in-graph);
+- the rollout is a ``lax.scan`` over time inside the program — zero per-step
+  dispatch;
+- GAE reuses :func:`sheeprl_tpu.ops.gae`; the optimization phase reuses the
+  SAME per-device epoch/minibatch machinery as the host loop
+  (:func:`sheeprl_tpu.algos.ppo.ppo.make_local_train`) — identical sampling,
+  loss and ``pmean`` semantics;
+- the whole thing is one jitted ``shard_map`` over the ``dp`` mesh axis with
+  ENVS sharded across devices (params replicated), wrapped in a
+  multi-iteration ``lax.scan`` (a ``fori_loop`` with stacked per-iteration
+  metric outputs) so host dispatch is amortized over a *block* of
+  iterations. Episode returns/lengths and losses are ferried out once per
+  block, sized to ``metric.log_every`` / ``checkpoint.every`` so logging and
+  checkpoint cadence match the host loop's counter semantics.
+
+Truncation handling matches the host loop: on a time-limit truncation the
+reward is bootstrapped in-graph with ``gamma * V(final_obs)`` (the host loop
+does the same from ``info["final_obs"]``), and GAE masks the terminal
+bootstrap with ``done = terminated | truncated``.
+
+Annealing (lr / clip / entropy coefficients) is applied at block granularity
+rather than per iteration — identical when annealing is off (the default) and
+a block-sized staircase of the same schedule otherwise.
+
+Requires a registered pure-JAX env (``env.id`` in
+``sheeprl_tpu.envs.jax_envs.JAX_ENV_REGISTRY``); arbitrary gymnasium envs
+stay on the host-loop path.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
+from sheeprl_tpu.algos.ppo.ppo import make_local_train
+from sheeprl_tpu.algos.ppo.utils import test
+from sheeprl_tpu.envs.jax_envs import BatchedJaxEnv, is_jax_env, make_jax_env
+from sheeprl_tpu.ops import gae as gae_op
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.parallel.compat import shard_map
+
+__all__ = ["main", "make_anakin_block"]
+
+
+def make_anakin_block(
+    agent, tx, cfg, mesh, benv, local_envs: int, iters_per_block: int, obs_key: str, ferry_episodes: bool = True
+):
+    """Build the jitted fused block: ``iters_per_block`` × (rollout ``lax.scan``
+    → GAE → epoch/minibatch optimization) as ONE ``shard_map`` over ``dp``.
+
+    Inputs/outputs sharded on ``dp``: env state pytree, observations and
+    episode accumulators (leading env axis), per-device rollout keys.
+    Replicated: params, optimizer state, the common train key (preserving
+    ``buffer.share_data`` permutation semantics) and loss/coef scalars.
+
+    ``ferry_episodes=False`` (``metric.log_level == 0``) drops the per-step
+    episode arrays — ``(iters, T, num_envs)`` × 3 — from the program outputs,
+    so a metrics-off run (the benchmark path) transfers only the per-iteration
+    loss scalars per block.
+    """
+    T = int(cfg.algo.rollout_steps)
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+    is_continuous = agent.is_continuous
+    n_heads = 1 if is_continuous else len(agent.actions_dim)
+    local_train = make_local_train(agent, tx, cfg, T * local_envs)
+
+    def rollout_step(carry, _):
+        params, env_state, obs, ep_ret, ep_len, key = carry
+        key, akey = jax.random.split(key)
+        acts, logprob, value = sample_actions(agent, params, {obs_key: obs}, akey)
+        if is_continuous:
+            buf_action = jnp.concatenate(acts, axis=-1)
+            env_action = buf_action
+        else:
+            buf_action = jnp.concatenate(acts, axis=-1)
+            idx = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)
+            env_action = idx[..., 0] if n_heads == 1 else idx
+        env_state, next_obs, reward, done, info = benv.step(env_state, env_action)
+
+        # time-limit bootstrap, fused (host loop: rewards[trunc] += gamma *
+        # V(final_obs)); cond-gated so the extra critic forward only runs on
+        # the rare steps where some env actually hit the time limit
+        truncated = info["truncated"]
+
+        def bootstrap(r):
+            v_final = agent.apply(params, {obs_key: info["final_obs"]})[1]
+            return r + gamma * v_final[..., 0] * truncated.astype(jnp.float32)
+
+        train_reward = jax.lax.cond(truncated.any(), bootstrap, lambda r: r, reward)
+
+        ep_ret = ep_ret + reward
+        ep_len = ep_len + 1
+        y = {
+            "obs": obs,
+            "actions": buf_action,
+            "logprobs": logprob,
+            "values": value,
+            "rewards": train_reward[..., None],
+            "dones": done.astype(jnp.float32)[..., None],
+        }
+        if ferry_episodes:
+            y["ep_done"] = done
+            y["ep_ret"] = jnp.where(done, ep_ret, 0.0)
+            y["ep_len"] = jnp.where(done, ep_len, 0)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        ep_len = jnp.where(done, 0, ep_len)
+        return (params, env_state, next_obs, ep_ret, ep_len, key), y
+
+    def one_iter(carry, train_key):
+        params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef = carry
+        (params, env_state, obs, ep_ret, ep_len, env_key), traj = jax.lax.scan(
+            rollout_step, (params, env_state, obs, ep_ret, ep_len, env_key), None, length=T
+        )
+        next_value = agent.apply(params, {obs_key: obs})[1]
+        returns, advantages = gae_op(
+            traj["rewards"], traj["values"], traj["dones"], next_value, gamma=gamma, gae_lambda=gae_lambda
+        )
+        data = {
+            obs_key: traj["obs"],
+            "actions": traj["actions"],
+            "logprobs": traj["logprobs"],
+            "values": traj["values"],
+            "returns": returns,
+            "advantages": advantages,
+        }
+        data = {k: v.reshape(T * local_envs, *v.shape[2:]) for k, v in data.items()}
+        params, opt_state, pg, v, ent = local_train(params, opt_state, data, train_key, clip_coef, ent_coef)
+        metrics = {"pg": pg, "v": v, "ent": ent}
+        if ferry_episodes:
+            metrics.update(ep_done=traj["ep_done"], ep_ret=traj["ep_ret"], ep_len=traj["ep_len"])
+        return (params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef), metrics
+
+    def local_block(params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key, clip_coef, ent_coef):
+        env_key = env_keys[0]
+        train_keys = jax.random.split(train_key, iters_per_block)
+        carry = (params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef)
+        carry, metrics = jax.lax.scan(one_iter, carry, train_keys)
+        params, opt_state, env_state, obs, ep_ret, ep_len, env_key, _, _ = carry
+        return params, opt_state, env_state, obs, ep_ret, ep_len, env_key[None], metrics
+
+    env_sharded = P("dp")
+    metric_specs = {"pg": P(), "v": P(), "ent": P()}
+    if ferry_episodes:
+        metric_specs.update(ep_done=P(None, None, "dp"), ep_ret=P(None, None, "dp"), ep_len=P(None, None, "dp"))
+    shard_block = shard_map(
+        local_block,
+        mesh=mesh,
+        in_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, P(), P(), P()),
+        out_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, metric_specs),
+        check_vma=False,
+    )
+    return jax.jit(shard_block, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    if jax.process_count() > 1:  # pragma: no cover - single-host subsystem
+        raise NotImplementedError(
+            "ppo_anakin ferries block metrics from a single controller; use the host-loop `algo=ppo` "
+            "for multi-host runs."
+        )
+
+    initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
+    initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
+    rank = fabric.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_state(cfg.checkpoint.resume_from)
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if fabric.is_global_zero:
+        logger.log_hyperparams(cfg)
+    print(f"Log dir: {log_dir}")
+
+    # Pure-JAX environment (the whole point: no host env in the hot path)
+    if not is_jax_env(cfg.env.id):
+        from sheeprl_tpu.envs.jax_envs import JAX_ENV_REGISTRY
+
+        raise ValueError(
+            f"algo=ppo_anakin requires a pure-JAX environment; '{cfg.env.id}' is not registered "
+            f"(available: {sorted(JAX_ENV_REGISTRY)}). Use algo=ppo for host-loop training."
+        )
+    env_kwargs: Dict[str, Any] = {}
+    if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+        env_kwargs["max_episode_steps"] = int(cfg.env.max_episode_steps)
+    jenv = make_jax_env(cfg.env.id, **env_kwargs)
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder or [])
+    mlp_keys = list(cfg.algo.mlp_keys.encoder or [])
+    if cnn_keys or len(mlp_keys) != 1:
+        raise ValueError(
+            "ppo_anakin supports exactly one vector observation key (the classic-control JaxEnvs); got "
+            f"cnn={cnn_keys} mlp={mlp_keys}"
+        )
+    obs_key = mlp_keys[0]
+    observation_space = gym.spaces.Dict({obs_key: jenv.observation_space})
+
+    is_continuous = isinstance(jenv.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(jenv.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        jenv.action_space.shape
+        if is_continuous
+        else (jenv.action_space.nvec.tolist() if is_multidiscrete else [jenv.action_space.n])
+    )
+
+    agent, params, player = build_agent(
+        fabric, actions_dim, is_continuous, cfg, observation_space,
+        state["agent"] if state is not None else None,
+    )
+
+    from sheeprl_tpu.optim.builders import build_optimizer
+
+    lr0 = float(cfg.algo.optimizer.lr)
+    tx = optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=lr0)
+    opt_state = tx.init(params)
+    if state is not None:
+        opt_state = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, opt_state, state["optimizer"])
+    opt_state = fabric.put_replicated(opt_state)
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = build_aggregator(cfg.metric.aggregator)
+
+    # Envs sharded over the mesh (the Anakin layout: params replicated,
+    # environments split across devices)
+    num_envs = int(cfg.env.num_envs)
+    world = fabric.world_size
+    if num_envs % world != 0:
+        raise ValueError(f"env.num_envs ({num_envs}) must be divisible by the number of devices ({world})")
+    local_envs = num_envs // world
+    T = int(cfg.algo.rollout_steps)
+
+    # Counters (same convention as the host loop: policy steps advance by
+    # num_envs per env step regardless of mesh size)
+    policy_steps_per_iter = int(num_envs * T)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    start_iter = state["iter_num"] + 1 if state is not None else 1
+    policy_step = state["iter_num"] * policy_steps_per_iter if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    train_step = 0
+    last_train = 0
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # Block size: iterations fused per host dispatch — the log/checkpoint
+    # interval, so metrics surface exactly when the host loop would emit them.
+    if cfg.algo.get("iters_per_block"):
+        iters_per_block = int(cfg.algo.iters_per_block)
+    else:
+        intervals = []
+        if cfg.metric.log_level > 0 and cfg.metric.log_every > 0:
+            intervals.append(int(cfg.metric.log_every))
+        if cfg.checkpoint.every > 0:
+            intervals.append(int(cfg.checkpoint.every))
+        interval = min(intervals) if intervals else cfg.algo.total_steps
+        iters_per_block = max(1, int(interval) // policy_steps_per_iter)
+    ferry_episodes = cfg.metric.log_level > 0
+    iters_per_block = max(1, min(iters_per_block, total_iters))
+    if ferry_episodes:
+        # bound the per-block metric ferry (3 arrays of (iters, T, num_envs))
+        iters_per_block = max(1, min(iters_per_block, (1 << 24) // max(1, T * num_envs)))
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    rng, env_reset_key, rollout_root = jax.random.split(rng, 3)
+
+    benv = BatchedJaxEnv(jenv, num_envs)
+    env_state, first_obs = jax.jit(benv.reset)(env_reset_key)
+    env_sharding = fabric.data_sharding
+    env_state = jax.device_put(env_state, env_sharding)
+    obs = jax.device_put(first_obs, env_sharding)
+    ep_ret = jax.device_put(jnp.zeros((num_envs,), jnp.float32), env_sharding)
+    ep_len = jax.device_put(jnp.zeros((num_envs,), jnp.int32), env_sharding)
+    env_keys = jax.device_put(jax.random.split(rollout_root, world), env_sharding)
+
+    block_fns: Dict[int, Any] = {}
+
+    def get_block_fn(n_iters: int):
+        # one compile per distinct block length (at most two: body + remainder)
+        if n_iters not in block_fns:
+            block_fns[n_iters] = make_anakin_block(
+                agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key, ferry_episodes=ferry_episodes
+            )
+        return block_fns[n_iters]
+
+    lr = lr0
+    clip_coef = float(cfg.algo.clip_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+
+    from sheeprl_tpu.utils.profiler import TraceProfiler
+
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir)
+
+    iter_num = start_iter - 1
+    while iter_num < total_iters:
+        block_iters = min(iters_per_block, total_iters - iter_num)
+        block_fn = get_block_fn(block_iters)
+        profiler.tick(iter_num + 1)
+
+        rng, train_key = jax.random.split(rng)
+        with timer("Time/train_time", SumMetric):
+            params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, metrics = block_fn(
+                params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key,
+                jnp.asarray(clip_coef, dtype=jnp.float32), jnp.asarray(ent_coef, dtype=jnp.float32),
+            )
+            metrics = jax.device_get(metrics)
+
+        # Host-side bookkeeping for the fused block, iteration by iteration
+        # (same counters/cadence the host loop maintains per iteration)
+        for i in range(block_iters):
+            iter_num += 1
+            policy_step += policy_steps_per_iter
+            train_step += 1
+            if aggregator and not aggregator.disabled:
+                aggregator.update("Loss/policy_loss", metrics["pg"][i])
+                aggregator.update("Loss/value_loss", metrics["v"][i])
+                aggregator.update("Loss/entropy_loss", metrics["ent"][i])
+            if cfg.metric.log_level > 0:
+                done_mask = np.asarray(metrics["ep_done"][i])
+                if done_mask.any():
+                    rets = np.asarray(metrics["ep_ret"][i])
+                    lens = np.asarray(metrics["ep_len"][i])
+                    ts, envs_idx = np.nonzero(done_mask)
+                    for t_i, e_i in zip(ts, envs_idx):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", rets[t_i, e_i])
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", lens[t_i, e_i])
+                        print(f"Rank-0: policy_step={policy_step}, reward_env_{e_i}={rets[t_i, e_i]}")
+
+        if cfg.metric.log_level > 0:
+            logger.log_dict({"Info/learning_rate": lr, "Info/clip_coef": clip_coef, "Info/ent_coef": ent_coef}, policy_step)
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_dict(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_dict(
+                            {
+                                "Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"],
+                                "Time/sps_env_interaction": (policy_step - last_log) / timer_metrics["Time/train_time"],
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        # Annealing at block granularity (identical when annealing is off)
+        if cfg.algo.anneal_lr:
+            lr = polynomial_decay(iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0)
+            opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "scheduler": None,
+                "iter_num": iter_num,
+                "batch_size": cfg.algo.per_rank_batch_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+    profiler.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, params, fabric, cfg, log_dir, writer=logger)
+
+    if not cfg.model_manager.disabled and fabric.is_global_zero:  # pragma: no cover - mlflow optional
+        from sheeprl_tpu.utils.mlflow import register_model
+
+        from sheeprl_tpu.algos.ppo.utils import log_models
+
+        register_model(fabric, log_models, cfg, {"agent": params})
+    logger.close()

@@ -43,6 +43,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregat
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
+from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = ["main", "make_train_step"]
 
@@ -116,7 +117,7 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
         qf, al, ll = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, aopt, copt, lopt, qf, al, ll
 
-    shard_train = jax.shard_map(
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, "dp"), P(), P()),
@@ -236,7 +237,7 @@ def make_burst_train_step(
         qf, al, ll = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, aopt, copt, lopt, rb, qf, al, ll
 
-    shard_train = jax.shard_map(
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P()),

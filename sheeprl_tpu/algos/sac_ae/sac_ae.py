@@ -43,6 +43,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregat
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = ["main", "make_train_step"]
 
@@ -172,7 +173,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, Any], cfg, mesh):
         qf, al, ll, rl = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, opts, qf, al, ll, rl
 
-    shard_train = jax.shard_map(
+    shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P(None, "dp"), P(), P()),

@@ -240,6 +240,19 @@ class ReplayBuffer:
     def to_tensor(self, dtype: Any = None, clone: bool = False, sharding: Any = None) -> Dict[str, Any]:
         return {k: to_device(v, dtype=dtype, sharding=sharding, clone=clone) for k, v in self._buf.items()}
 
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        """Host-side views of the storage, so callers can stage the whole
+        batch with ONE sharded ``device_put`` instead of a per-key transfer.
+        Zero-copy except for float64 keys, which are downcast (copied) to
+        float32 — the same rule :func:`to_device` applies before placement."""
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v.array if isinstance(v, MemmapArray) else v)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            out[k] = arr
+        return out
+
     def __getitem__(self, key: str) -> np.ndarray | MemmapArray:
         if not isinstance(key, str):
             raise TypeError(f"buffer keys are strings (got {type(key)})")

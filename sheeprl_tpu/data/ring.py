@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = [
     "ring_append_rows",
@@ -325,7 +326,7 @@ def build_burst_train_step(
         metrics = jax.tree.map(lambda x: jax.lax.pmean((x * valid).sum() / denom, "dp"), metrics)
         return carry, rb, metrics
 
-    shard_burst = jax.shard_map(
+    shard_burst = shard_map(
         local_burst,
         mesh=mesh,
         in_specs=(P(),) * 8,

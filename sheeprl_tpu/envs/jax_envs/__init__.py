@@ -1,0 +1,41 @@
+"""Pure-JAX environments for fully on-device (Anakin-style) training.
+
+When the environment itself is a jittable function, the whole
+rollout→advantage→update loop compiles into ONE XLA program (Podracer /
+Anakin, https://arxiv.org/pdf/2104.06272): no per-step host dispatch, no
+host↔device transfers, envs `vmap`-batched and sharded across the mesh.
+:mod:`sheeprl_tpu.algos.ppo.ppo_anakin` is the first consumer.
+
+Surface:
+
+- :class:`~sheeprl_tpu.envs.jax_envs.base.JaxEnv` — the protocol
+  (``reset(key) -> (state, obs)``,
+  ``step(state, action) -> (state, obs, reward, done, info)``);
+- :class:`~sheeprl_tpu.envs.jax_envs.base.BatchedJaxEnv` — ``vmap`` batching
+  + SAME_STEP auto-reset (gymnasium semantics: on the done step the returned
+  obs is the NEW episode's first observation and the terminal observation is
+  delivered in ``info["final_obs"]``);
+- :func:`~sheeprl_tpu.envs.jax_envs.base.make_jax_env` /
+  :func:`~sheeprl_tpu.envs.jax_envs.base.is_jax_env` — registry keyed by the
+  gymnasium id, so ``env.id=CartPole-v1`` selects the pure-JAX twin.
+"""
+
+from sheeprl_tpu.envs.jax_envs.base import (
+    JAX_ENV_REGISTRY,
+    BatchedJaxEnv,
+    JaxEnv,
+    is_jax_env,
+    make_jax_env,
+)
+from sheeprl_tpu.envs.jax_envs.cartpole import JaxCartPole
+from sheeprl_tpu.envs.jax_envs.pendulum import JaxPendulum
+
+__all__ = [
+    "JaxEnv",
+    "BatchedJaxEnv",
+    "JaxCartPole",
+    "JaxPendulum",
+    "JAX_ENV_REGISTRY",
+    "make_jax_env",
+    "is_jax_env",
+]

@@ -1,0 +1,151 @@
+"""JaxEnv protocol, vmap batching and SAME_STEP auto-reset.
+
+Design notes
+------------
+
+*Raw envs do NOT auto-reset.* ``step`` returns the true next observation and
+``done = terminated | truncated``; episode bookkeeping (the TimeLimit step
+counter) lives inside the env state so the whole thing stays a pure function.
+``info`` always carries ``{"terminated", "truncated"}`` so callers can
+distinguish bootstrap-at-truncation from true termination (the same split the
+host loop reads off gymnasium).
+
+:class:`BatchedJaxEnv` adds the two things every rollout loop needs:
+
+- ``vmap`` over a leading env axis, with an independent PRNG key per env;
+- gymnasium SAME_STEP auto-reset: on the step where ``done`` is observed the
+  returned observation is the NEW episode's first observation, while the
+  terminal observation rides in ``info["final_obs"]`` (mask = ``done``) —
+  exactly what :class:`~sheeprl_tpu.envs.vector.FastSyncVectorEnv` delivers to
+  the host loops, so the Anakin rollout consumes the same contract fully
+  in-graph.
+
+The reset branch runs unconditionally every step (a fresh-episode state is
+computed and selected by ``jnp.where``): shapes stay static, and for the
+closed-form resets of the classic-control envs the cost is a handful of
+scalar ops per env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+__all__ = ["JaxEnv", "BatchedJaxEnv", "JAX_ENV_REGISTRY", "register_jax_env", "make_jax_env", "is_jax_env"]
+
+
+class JaxEnv:
+    """Protocol for a single pure-JAX environment.
+
+    Subclasses implement ``reset``/``step`` as pure jittable functions and
+    expose gymnasium ``observation_space``/``action_space`` (single-env) so
+    agent builders work unchanged.
+    """
+
+    #: gymnasium id this env mirrors (used by the registry / parity tests)
+    id: str = ""
+
+    @property
+    def observation_space(self) -> gym.Space:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def action_space(self) -> gym.Space:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def reset(self, key: jax.Array) -> Tuple[Any, jax.Array]:  # pragma: no cover - interface
+        """Start a new episode: ``key -> (state, obs)``."""
+        raise NotImplementedError
+
+    def step(
+        self, state: Any, action: jax.Array
+    ) -> Tuple[Any, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:  # pragma: no cover - interface
+        """``(state, action) -> (state, obs, reward, done, info)`` with
+        ``info = {"terminated": bool, "truncated": bool}``."""
+        raise NotImplementedError
+
+
+class BatchedState(NamedTuple):
+    """Per-env raw state stacked on a leading env axis + per-env PRNG keys
+    (consumed one split per auto-reset)."""
+
+    env_state: Any
+    keys: jax.Array  # (num_envs, 2) uint32
+
+
+class BatchedJaxEnv:
+    """``vmap``-batched wrapper with gymnasium SAME_STEP auto-reset."""
+
+    def __init__(self, env: JaxEnv, num_envs: int):
+        if num_envs < 1:
+            raise ValueError(f"num_envs must be >= 1, got {num_envs}")
+        self.env = env
+        self.num_envs = num_envs
+
+    @property
+    def single_observation_space(self) -> gym.Space:
+        return self.env.observation_space
+
+    @property
+    def single_action_space(self) -> gym.Space:
+        return self.env.action_space
+
+    def reset(self, key: jax.Array) -> Tuple[BatchedState, jax.Array]:
+        def reset_one(k):
+            k, sub = jax.random.split(k)
+            state, obs = self.env.reset(sub)
+            return k, state, obs
+
+        keys = jax.random.split(key, self.num_envs)
+        keys, states, obs = jax.vmap(reset_one)(keys)
+        return BatchedState(env_state=states, keys=keys), obs
+
+    def step(
+        self, state: BatchedState, action: jax.Array
+    ) -> Tuple[BatchedState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        def step_one(k, s, a):
+            s2, obs, reward, done, info = self.env.step(s, a)
+            # unconditional fresh episode, selected only when done (the key
+            # is consumed only on reset so un-done envs keep their stream)
+            k2, sub = jax.random.split(k)
+            rs, robs = self.env.reset(sub)
+            new_state = jax.tree.map(lambda a_, b_: jnp.where(done, b_, a_), s2, rs)
+            new_key = jnp.where(done, k2, k)
+            new_obs = jnp.where(done, robs, obs)
+            info = dict(info)
+            info["final_obs"] = obs  # pre-reset obs; meaningful where done
+            return new_key, new_state, new_obs, reward, done, info
+
+        keys, states, obs, reward, done, info = jax.vmap(step_one)(state.keys, state.env_state, action)
+        return BatchedState(env_state=states, keys=keys), obs, reward, done, info
+
+
+JAX_ENV_REGISTRY: Dict[str, Callable[..., JaxEnv]] = {}
+
+
+def register_jax_env(env_id: str) -> Callable:
+    """Class decorator: register a :class:`JaxEnv` under its gymnasium id."""
+
+    def decorator(cls):
+        JAX_ENV_REGISTRY[env_id] = cls
+        cls.id = env_id
+        return cls
+
+    return decorator
+
+
+def is_jax_env(env_id: str) -> bool:
+    return env_id in JAX_ENV_REGISTRY
+
+
+def make_jax_env(env_id: str, **kwargs: Any) -> JaxEnv:
+    if env_id not in JAX_ENV_REGISTRY:
+        raise ValueError(
+            f"No pure-JAX environment registered for '{env_id}'. "
+            f"Available: {sorted(JAX_ENV_REGISTRY)}. On-device (Anakin) training requires a JaxEnv; "
+            "use the host-loop algorithms (e.g. algo=ppo) for arbitrary gymnasium envs."
+        )
+    return JAX_ENV_REGISTRY[env_id](**kwargs)

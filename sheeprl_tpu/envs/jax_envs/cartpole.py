@@ -1,0 +1,94 @@
+"""Pure-JAX CartPole-v1, dynamics-exact against gymnasium.
+
+Same constants, Euler integrator, termination bounds, +1-per-step reward and
+U(-0.05, 0.05) reset as ``gymnasium.envs.classic_control.CartPoleEnv``
+(gymnasium computes in float64, this env in float32 — parity is within float
+tolerance per episode, asserted by ``tests/test_envs/test_jax_envs.py``).
+TimeLimit truncation (500 steps for CartPole-v1) is folded into the env state
+as a step counter so the whole env stays a pure function.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
+
+__all__ = ["JaxCartPole", "CartPoleState"]
+
+
+class CartPoleState(NamedTuple):
+    physics: jax.Array  # (4,) float32: x, x_dot, theta, theta_dot
+    t: jax.Array  # () int32 steps taken this episode
+
+
+@register_jax_env("CartPole-v1")
+class JaxCartPole(JaxEnv):
+    # gymnasium CartPoleEnv constants
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    total_mass = masspole + masscart
+    length = 0.5  # half the pole's length
+    polemass_length = masspole * length
+    force_mag = 10.0
+    tau = 0.02
+    theta_threshold = 12 * 2 * np.pi / 360
+    x_threshold = 2.4
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = int(max_episode_steps)
+
+    @property
+    def observation_space(self) -> gym.Space:
+        high = np.array(
+            [self.x_threshold * 2, np.finfo(np.float32).max, self.theta_threshold * 2, np.finfo(np.float32).max],
+            dtype=np.float32,
+        )
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    @property
+    def action_space(self) -> gym.Space:
+        return gym.spaces.Discrete(2)
+
+    def reset(self, key: jax.Array) -> Tuple[CartPoleState, jax.Array]:
+        physics = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05, dtype=jnp.float32)
+        return CartPoleState(physics=physics, t=jnp.zeros((), jnp.int32)), physics
+
+    def step(
+        self, state: CartPoleState, action: jax.Array
+    ) -> Tuple[CartPoleState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        x, x_dot, theta, theta_dot = state.physics
+        force = jnp.where(action.astype(jnp.int32) == 1, self.force_mag, -self.force_mag)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+
+        temp = (force + self.polemass_length * theta_dot**2 * sintheta) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / self.total_mass)
+        )
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        physics = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+
+        t = state.t + 1
+        terminated = (
+            (x < -self.x_threshold)
+            | (x > self.x_threshold)
+            | (theta < -self.theta_threshold)
+            | (theta > self.theta_threshold)
+        )
+        truncated = t >= self.max_episode_steps
+        done = terminated | truncated
+        reward = jnp.ones((), jnp.float32)
+        info = {"terminated": terminated, "truncated": truncated}
+        return CartPoleState(physics=physics, t=t), physics, reward, done, info

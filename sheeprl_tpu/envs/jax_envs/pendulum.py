@@ -1,0 +1,82 @@
+"""Pure-JAX Pendulum-v1, dynamics-exact against gymnasium.
+
+Same constants, semi-implicit Euler update, cost function and
+U([-pi, pi] x [-1, 1]) reset as
+``gymnasium.envs.classic_control.PendulumEnv`` (float32 here vs gymnasium's
+float64; parity within float tolerance is asserted by
+``tests/test_envs/test_jax_envs.py``). The episode never terminates; the
+200-step TimeLimit truncation is a step counter in the env state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
+
+__all__ = ["JaxPendulum", "PendulumState"]
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array  # () float32
+    theta_dot: jax.Array  # () float32
+    t: jax.Array  # () int32 steps taken this episode
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+@register_jax_env("Pendulum-v1")
+class JaxPendulum(JaxEnv):
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    length = 1.0
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = int(max_episode_steps)
+
+    @property
+    def observation_space(self) -> gym.Space:
+        high = np.array([1.0, 1.0, self.max_speed], dtype=np.float32)
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    @property
+    def action_space(self) -> gym.Space:
+        return gym.spaces.Box(-self.max_torque, self.max_torque, (1,), dtype=np.float32)
+
+    def _obs(self, theta: jax.Array, theta_dot: jax.Array) -> jax.Array:
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot]).astype(jnp.float32)
+
+    def reset(self, key: jax.Array) -> Tuple[PendulumState, jax.Array]:
+        high = jnp.array([jnp.pi, 1.0], dtype=jnp.float32)
+        th, thdot = jax.random.uniform(key, (2,), minval=-high, maxval=high, dtype=jnp.float32)
+        return PendulumState(theta=th, theta_dot=thdot, t=jnp.zeros((), jnp.int32)), self._obs(th, thdot)
+
+    def step(
+        self, state: PendulumState, action: jax.Array
+    ) -> Tuple[PendulumState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        th, thdot = state.theta, state.theta_dot
+        u = jnp.clip(jnp.reshape(action, ()), -self.max_torque, self.max_torque)
+
+        cost = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+
+        newthdot = thdot + (3.0 * self.g / (2.0 * self.length) * jnp.sin(th) + 3.0 / (self.m * self.length**2) * u) * self.dt
+        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
+        newth = th + newthdot * self.dt
+
+        t = state.t + 1
+        terminated = jnp.zeros((), bool)
+        truncated = t >= self.max_episode_steps
+        done = terminated | truncated
+        info = {"terminated": terminated, "truncated": truncated}
+        new_state = PendulumState(theta=newth.astype(jnp.float32), theta_dot=newthdot.astype(jnp.float32), t=t)
+        return new_state, self._obs(newth, newthdot), -cost.astype(jnp.float32), done, info

@@ -54,22 +54,39 @@ class FastSyncVectorEnv(SyncVectorEnv):
             create_empty_array(self.single_observation_space, n=self.num_envs, fn=np.zeros) for _ in range(2)
         ]
         self._buf_idx = 0
+        # Scratch batch for gymnasium's in-place concatenate on the fallback
+        # path: the parent writes into ``self._observations`` DURING step(),
+        # so that attribute must never point at a batch we handed out.
+        self._parent_scratch = create_empty_array(self.single_observation_space, n=self.num_envs, fn=np.zeros)
         # Array-indexable batched action spaces take the fast path; anything
         # exotic (Dict/Tuple actions) falls back to gymnasium's step.
         self._fast_actions = isinstance(self.single_action_space, (Box, Discrete, MultiDiscrete, MultiBinary))
 
+    def _rehome_fallback_batch(self):
+        """Copy the per-env observations into the next ping-pong buffer and
+        park the parent's write target on its own scratch, so the batch we
+        return survives the parent's next in-place concatenate (the 2-step
+        lifetime contract the fast path provides)."""
+        buf = self._obs_buffers[self._buf_idx]
+        self._buf_idx ^= 1
+        out = concatenate(self.single_observation_space, self._env_obs, buf)
+        self._observations = self._parent_scratch
+        return out
+
+    def reset(self, *, seed=None, options=None):
+        obs, infos = super().reset(seed=seed, options=options)
+        if self._fast_actions and self.autoreset_mode == AutoresetMode.SAME_STEP:
+            # the fast step never writes into the parent's reset buffer, so
+            # the returned batch already satisfies the lifetime contract
+            return obs, infos
+        return self._rehome_fallback_batch(), infos
+
     def step(self, actions):
         if not self._fast_actions or self.autoreset_mode != AutoresetMode.SAME_STEP:
             obs, rewards, terminations, truncations, infos = super().step(actions)
-            # The parent ran with copy=False, so ``obs`` is an internal buffer
-            # overwritten by the NEXT step. Re-concatenate from the per-env
-            # observations into the alternating buffer so the fallback honors
-            # the same 2-step lifetime contract as the fast path (the mains
-            # read the previous batch after the next step() call).
-            buf = self._obs_buffers[self._buf_idx]
-            self._buf_idx ^= 1
-            self._observations = concatenate(self.single_observation_space, self._env_obs, buf)
-            return self._observations, rewards, terminations, truncations, infos
+            # The parent ran with copy=False: ``obs`` is the parent's internal
+            # buffer, which the parent overwrites in-place on the NEXT step.
+            return self._rehome_fallback_batch(), rewards, terminations, truncations, infos
 
         actions = np.asarray(actions)
         if len(actions) != self.num_envs:

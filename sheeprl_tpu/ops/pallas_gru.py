@@ -73,12 +73,23 @@ def _pallas_forward(fused: jax.Array, h: jax.Array, interpret: bool) -> jax.Arra
     )(fused, h)
 
 
+def _process_has_tpu() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
 @functools.partial(jax.named_call, name="pallas_gru_gates")
 def _forward(fused: jax.Array, h: jax.Array) -> jax.Array:
     # Per-platform dispatch at LOWERING time: one process can trace the same
     # cell for both the TPU (compiled kernel) and the host CPU player
     # (interpret mode) — a process-global default_backend switch cannot.
-    # Every non-TPU platform interprets, as before.
+    # TPU-less processes skip the dispatch entirely: older jax lowers BOTH
+    # platform_dependent branches under lax.scan, and the non-interpret
+    # pallas_call rejects CPU lowering outright.
+    if not _process_has_tpu():
+        return _pallas_forward(fused, h, interpret=True)
     return jax.lax.platform_dependent(
         fused,
         h,

@@ -40,6 +40,39 @@ def sgd(lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0, nest
     return tx
 
 
+def _torch_rmsprop(lr: float, alpha: float, eps: float, centered: bool, momentum: float):
+    """Torch-semantics RMSprop (eps OUTSIDE the sqrt) for optax < 0.2.4,
+    where ``optax.rmsprop`` has no ``eps_in_sqrt`` switch and always adds
+    eps inside the sqrt (the TF convention)."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        state = {"nu": jax.tree.map(jnp.zeros_like, params)}
+        state["mu"] = jax.tree.map(jnp.zeros_like, params) if centered else None
+        state["mom"] = jax.tree.map(jnp.zeros_like, params) if momentum else None
+        return state
+
+    def update(grads, state, params=None):
+        del params
+        nu = jax.tree.map(lambda n, g: alpha * n + (1 - alpha) * g * g, state["nu"], grads)
+        if centered:
+            mu = jax.tree.map(lambda m, g: alpha * m + (1 - alpha) * g, state["mu"], grads)
+            upd = jax.tree.map(lambda g, n, m: g / (jnp.sqrt(n - m * m) + eps), grads, nu, mu)
+        else:
+            mu = None
+            upd = jax.tree.map(lambda g, n: g / (jnp.sqrt(n) + eps), grads, nu)
+        if momentum:
+            mom = jax.tree.map(lambda b, u: momentum * b + u, state["mom"], upd)
+            upd = mom
+        else:
+            mom = None
+        upd = jax.tree.map(lambda u: -lr * u, upd)
+        return upd, {"nu": nu, "mu": mu, "mom": mom}
+
+    return optax.GradientTransformation(init, update)
+
+
 def rmsprop(
     lr: float = 1e-3,
     alpha: float = 0.99,
@@ -50,7 +83,10 @@ def rmsprop(
     **_: Any,
 ):
     # torch-style: eps added outside the sqrt
-    tx = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=False, centered=centered, momentum=momentum or None)
+    try:
+        tx = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=False, centered=centered, momentum=momentum or None)
+    except TypeError:  # optax < 0.2.4
+        tx = _torch_rmsprop(lr, alpha, eps, centered, momentum)
     if weight_decay:
         tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
     return tx
@@ -66,7 +102,10 @@ def rmsprop_tf(
     **_: Any,
 ):
     """TF-style RMSprop: eps inside the sqrt (reference: ``sheeprl/optim/rmsprop_tf.py``)."""
-    tx = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=True, centered=centered, momentum=momentum or None)
+    try:
+        tx = optax.rmsprop(lr, decay=alpha, eps=eps, eps_in_sqrt=True, centered=centered, momentum=momentum or None)
+    except TypeError:  # optax < 0.2.4: eps-in-sqrt IS the (only) behavior
+        tx = optax.rmsprop(lr, decay=alpha, eps=eps, centered=centered, momentum=momentum or None)
     if weight_decay:
         tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
     return tx

@@ -1,0 +1,39 @@
+"""JAX API compatibility shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (jax <= 0.4.x,
+``check_rep=`` kwarg) to top-level ``jax.shard_map`` (``check_vma=`` kwarg).
+Every train-step builder in this repo goes through this wrapper so the same
+code runs on both API generations — the pinned container image ships 0.4.37,
+where the top-level symbol does not exist yet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["shard_map", "axis_size"]
+
+
+def axis_size(axis_name: Any) -> Any:
+    """``jax.lax.axis_size`` for new jax; ``psum(1, axis)`` (a compile-time
+    constant under shard_map/pmap) on jax <= 0.4.x where it does not exist."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: bool = True,
+) -> Callable:
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sheeprl_tpu.ops.attention import block_attention, online_softmax_merge, _bh_to_bqh
+from sheeprl_tpu.parallel.compat import axis_size, shard_map
 
 __all__ = [
     "ring_attention",
@@ -48,7 +49,7 @@ def ring_attention(
 ) -> jax.Array:
     """Ring attention over ``axis_name``; call inside ``shard_map`` with the
     sequence dim of q/k/v sharded on that axis."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -86,7 +87,7 @@ def ulysses_attention(
     ``shard_map`` with the sequence dim sharded on that axis."""
     from sheeprl_tpu.ops.attention import reference_attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(f"heads ({q.shape[2]}) must be divisible by the sp axis size ({n})")
 
@@ -101,7 +102,7 @@ def ulysses_attention(
 
 
 def _make(fn, mesh: Mesh, axis_name: str, causal: bool, scale: Optional[float]):
-    mapped = jax.shard_map(
+    mapped = shard_map(
         partial(fn, axis_name=axis_name, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),

@@ -18,6 +18,7 @@ evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
 _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
     "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_anakin",
     "sheeprl_tpu.algos.ppo.ppo_decoupled",
     "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
     "sheeprl_tpu.algos.sac.sac",
@@ -95,6 +96,26 @@ def _ensure_populated() -> None:
 
 
 def resolve_algorithm(name: str) -> Optional[Dict[str, Any]]:
+    # Fast path: algo names equal their module leaf (see register_algorithm),
+    # so import ONLY the matching builtin module — eagerly importing every
+    # algorithm family costs ~2s of process startup per CLI run.
+    entries = algorithm_registry.get(name)
+    if entries:
+        return entries[0]
+    for mod in _BUILTIN_ALGO_MODULES:
+        if mod.rsplit(".", 1)[-1] == name:
+            try:
+                importlib.import_module(mod)
+            except ModuleNotFoundError as e:
+                # only the algo module itself may be absent (bootstrap); a
+                # missing internal dependency is a real failure to surface
+                if e.name != mod:
+                    raise
+    entries = algorithm_registry.get(name)
+    if entries:
+        return entries[0]
+    # Unknown leaf (e.g. externally registered algos): fall back to the full
+    # eager populate.
     _ensure_populated()
     entries = algorithm_registry.get(name)
     return entries[0] if entries else None

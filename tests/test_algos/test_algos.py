@@ -57,6 +57,23 @@ def test_ppo_continuous(tmp_path):
     run(_std_args(tmp_path, "ppo", extra=PPO_FAST + ["env.id=continuous_dummy"]))
 
 
+# On-device (Anakin) PPO: the rollout runs in-graph over a pure-JAX env, so
+# these dry-runs go through the real CLI on the jax CartPole/Pendulum twins
+# (env=gym ids) instead of the host dummies.
+@pytest.mark.parametrize("devices", [1, 2])
+def test_ppo_anakin_dry_run(tmp_path, devices):
+    run(_std_args(tmp_path, "ppo_anakin", env="gym", devices=devices, extra=PPO_FAST))
+
+
+def test_ppo_anakin_continuous(tmp_path):
+    run(_std_args(tmp_path, "ppo_anakin", env="gym", extra=PPO_FAST + ["env.id=Pendulum-v1"]))
+
+
+def test_ppo_anakin_rejects_host_env(tmp_path):
+    with pytest.raises(ValueError, match="pure-JAX"):
+        run(_std_args(tmp_path, "ppo_anakin", env="gym", extra=PPO_FAST + ["env.id=discrete_dummy"]))
+
+
 def test_ppo_multidiscrete(tmp_path):
     run(_std_args(tmp_path, "ppo", extra=PPO_FAST + ["env.id=multidiscrete_dummy"]))
 

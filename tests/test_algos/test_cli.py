@@ -92,6 +92,40 @@ def test_ppo_checkpoint_resume_round_trip(tmp_path):
     assert any("ckpt_64" in c for c in resumed_ckpts)
 
 
+PPO_ANAKIN_TINY = [
+    "exp=ppo_anakin",
+    "env=gym",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def test_ppo_anakin_checkpoint_and_evaluation(tmp_path):
+    """On-device training → checkpoint → `evaluation()`: the Anakin
+    checkpoint shares the host PPO layout, and the policy trained on the
+    pure-JAX CartPole evaluates on the real gymnasium CartPole."""
+    run(
+        PPO_ANAKIN_TINY
+        + [
+            f"log_root={tmp_path}/anakin",
+            "algo.total_steps=64",
+            "checkpoint.every=32",
+            "checkpoint.save_last=True",
+        ]
+    )
+    ckpts = _ckpts(f"{tmp_path}/anakin")
+    assert ckpts, "the anakin run saved no checkpoint"
+    evaluation([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
 def test_resume_env_mismatch_errors(tmp_path):
     run(PPO_TINY + [f"log_root={tmp_path}", "dry_run=True", "checkpoint.save_last=True"])
     ckpt = _ckpts(tmp_path)[-1]

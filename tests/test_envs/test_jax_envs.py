@@ -1,0 +1,200 @@
+"""Pure-JAX env parity against gymnasium + auto-reset/vmap semantics.
+
+Parity strategy: jax PRNG and numpy PRNG cannot produce the same reset
+states, so the gymnasium twin is *state-synced* from the jax env at every
+episode start (``env.unwrapped.state = ...``) and both are driven with the
+same seeded action sequence. The jax envs compute in float32 vs gymnasium's
+float64, so trace comparisons carry a small per-episode drift tolerance;
+single-step checks (re-synced every step) are tight.
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.jax_envs import (
+    BatchedJaxEnv,
+    JaxCartPole,
+    JaxPendulum,
+    is_jax_env,
+    make_jax_env,
+)
+
+TRACE_STEPS = 200
+
+
+def test_registry():
+    assert is_jax_env("CartPole-v1") and is_jax_env("Pendulum-v1")
+    assert not is_jax_env("MsPacmanNoFrameskip-v4")
+    assert isinstance(make_jax_env("CartPole-v1"), JaxCartPole)
+    assert isinstance(make_jax_env("Pendulum-v1"), JaxPendulum)
+    with pytest.raises(ValueError, match="No pure-JAX environment"):
+        make_jax_env("Walker2d-v4")
+
+
+def _sync_cartpole(genv, state):
+    genv.unwrapped.state = np.asarray(state.physics, dtype=np.float64)
+
+
+def _sync_pendulum(genv, state):
+    genv.unwrapped.state = np.array([float(state.theta), float(state.theta_dot)], dtype=np.float64)
+
+
+def test_cartpole_trace_parity():
+    """Seeded 200-step trace: obs/reward/termination match gymnasium, with
+    state re-sync (both PRNGs differ) at each episode start only."""
+    jenv = JaxCartPole()
+    genv = gym.make("CartPole-v1")
+    genv.reset(seed=0)
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    state, obs = jenv.reset(sub)
+    _sync_cartpole(genv, state)
+    rng = np.random.RandomState(1)
+    for t in range(TRACE_STEPS):
+        a = int(rng.randint(2))
+        state, jobs, jr, jdone, jinfo = jenv.step(state, jnp.asarray(a))
+        gobs, gr, gterm, gtrunc, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, atol=1e-4, rtol=1e-4)
+        assert float(jr) == float(gr) == 1.0
+        assert bool(jinfo["terminated"]) == gterm
+        assert bool(jdone) == (gterm or gtrunc)
+        if jdone:
+            key, sub = jax.random.split(key)
+            state, obs = jenv.reset(sub)
+            genv.reset()
+            _sync_cartpole(genv, state)
+    genv.close()
+
+
+def test_cartpole_single_step_parity_tight():
+    """Dynamics-exact check: re-sync every step, so no drift accumulates."""
+    jenv = JaxCartPole()
+    genv = gym.make("CartPole-v1")
+    genv.reset(seed=0)
+    state, _ = jenv.reset(jax.random.PRNGKey(7))
+    rng = np.random.RandomState(2)
+    for t in range(50):
+        _sync_cartpole(genv, state)
+        a = int(rng.randint(2))
+        state, jobs, _, jdone, _ = jenv.step(state, jnp.asarray(a))
+        gobs, _, gterm, _, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, atol=1e-5, rtol=1e-5)
+        if jdone:
+            state, _ = jenv.reset(jax.random.PRNGKey(100 + t))
+            genv.reset()
+    genv.close()
+
+
+def test_pendulum_trace_parity():
+    """200-step trace = exactly one episode (no termination, truncated at
+    200). float32-vs-float64 drift bounds the tolerance."""
+    jenv = JaxPendulum()
+    genv = gym.make("Pendulum-v1")
+    genv.reset(seed=0)
+    state, obs = jenv.reset(jax.random.PRNGKey(3))
+    _sync_pendulum(genv, state)
+    rng = np.random.RandomState(3)
+    for t in range(TRACE_STEPS):
+        a = rng.uniform(-2, 2, size=(1,)).astype(np.float32)
+        state, jobs, jr, jdone, jinfo = jenv.step(state, jnp.asarray(a))
+        gobs, gr, gterm, gtrunc, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, atol=2e-2, rtol=2e-2)
+        np.testing.assert_allclose(float(jr), float(gr), atol=5e-2)
+        assert not bool(jinfo["terminated"]) and not gterm
+        assert bool(jdone) == (gterm or gtrunc)
+        assert bool(jdone) == (t == TRACE_STEPS - 1)
+    genv.close()
+
+
+def test_pendulum_single_step_parity_tight():
+    jenv = JaxPendulum()
+    genv = gym.make("Pendulum-v1")
+    genv.reset(seed=0)
+    state, _ = jenv.reset(jax.random.PRNGKey(4))
+    rng = np.random.RandomState(4)
+    for _ in range(50):
+        _sync_pendulum(genv, state)
+        a = rng.uniform(-2, 2, size=(1,)).astype(np.float32)
+        state, jobs, jr, _, _ = jenv.step(state, jnp.asarray(a))
+        gobs, gr, _, _, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(float(jr), float(gr), atol=1e-4)
+    genv.close()
+
+
+def test_truncation_flag_cartpole():
+    """A time-limited CartPole sets truncated (not terminated) at the limit,
+    mirroring gymnasium's TimeLimit."""
+    jenv = JaxCartPole(max_episode_steps=5)
+    state, _ = jenv.reset(jax.random.PRNGKey(0))
+    for t in range(5):
+        state, _, _, done, info = jenv.step(state, jnp.asarray(0))
+        if bool(info["terminated"]):
+            pytest.skip("episode terminated before the tiny time limit")
+        assert bool(info["truncated"]) == (t == 4)
+        assert bool(done) == (t == 4)
+
+
+def test_batched_autoreset_matches_manual_key_stream():
+    """BatchedJaxEnv == a hand-rolled per-env loop with the same key
+    discipline, bitwise (same ops, same dtypes), including SAME_STEP
+    auto-resets: on the done step the returned obs is the NEW episode's
+    first obs and info['final_obs'] is the terminal obs."""
+    N = 4
+    raw = JaxCartPole(max_episode_steps=20)
+    benv = BatchedJaxEnv(raw, N)
+    master = jax.random.PRNGKey(11)
+    bstate, bobs = benv.reset(master)
+
+    # manual replica of the wrapper's key discipline
+    keys = jax.random.split(master, N)
+    man_state, man_obs, man_keys = [], [], []
+    for i in range(N):
+        k, sub = jax.random.split(keys[i])
+        s, o = raw.reset(sub)
+        man_keys.append(k)
+        man_state.append(s)
+        man_obs.append(o)
+    np.testing.assert_array_equal(np.asarray(bobs), np.stack([np.asarray(o) for o in man_obs]))
+
+    rng = np.random.RandomState(5)
+    for t in range(60):
+        acts = rng.randint(2, size=(N,))
+        bstate, bobs, brew, bdone, binfo = benv.step(bstate, jnp.asarray(acts))
+        for i in range(N):
+            s2, o2, r2, d2, info2 = raw.step(man_state[i], jnp.asarray(acts[i]))
+            assert float(brew[i]) == float(r2)
+            assert bool(bdone[i]) == bool(d2)
+            # terminal obs rides in final_obs on the done step
+            np.testing.assert_array_equal(np.asarray(binfo["final_obs"][i]), np.asarray(o2))
+            assert bool(binfo["terminated"][i]) == bool(info2["terminated"])
+            assert bool(binfo["truncated"][i]) == bool(info2["truncated"])
+            if bool(d2):
+                k2, sub = jax.random.split(man_keys[i])
+                man_state[i], o_reset = raw.reset(sub)
+                man_keys[i] = k2
+                np.testing.assert_array_equal(np.asarray(bobs[i]), np.asarray(o_reset))
+            else:
+                man_state[i] = s2
+                np.testing.assert_array_equal(np.asarray(bobs[i]), np.asarray(o2))
+
+
+def test_batched_shapes_and_spaces():
+    for env_id, n in [("CartPole-v1", 3), ("Pendulum-v1", 2)]:
+        raw = make_jax_env(env_id)
+        benv = BatchedJaxEnv(raw, n)
+        assert benv.single_observation_space == raw.observation_space
+        assert benv.single_action_space == raw.action_space
+        state, obs = jax.jit(benv.reset)(jax.random.PRNGKey(0))
+        assert obs.shape == (n, *raw.observation_space.shape)
+        if isinstance(raw.action_space, gym.spaces.Box):
+            acts = jnp.zeros((n, *raw.action_space.shape), jnp.float32)
+        else:
+            acts = jnp.zeros((n,), jnp.int32)
+        state, obs, rew, done, info = jax.jit(benv.step)(state, acts)
+        assert obs.shape == (n, *raw.observation_space.shape)
+        assert rew.shape == (n,) and done.shape == (n,)
+        assert info["final_obs"].shape == obs.shape

@@ -1,0 +1,158 @@
+"""FastSyncVectorEnv coverage — the fallback path for non-array action
+spaces (``sheeprl_tpu/envs/vector.py``): gymnasium's ``step`` runs, but the
+returned observation batch must still honor the fast path's two-step
+lifetime contract (valid until the NEXT ``step()``), and infos must match
+gymnasium's ``SyncVectorEnv`` bit-for-bit."""
+
+import copy
+
+import gymnasium as gym
+import numpy as np
+import pytest
+from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+from sheeprl_tpu.envs.vector import FastSyncVectorEnv
+
+
+class DictActionEnv(gym.Env):
+    """Deterministic env with a Dict action space (not array-indexable, so
+    FastSyncVectorEnv must take its gymnasium fallback path). Observations
+    count steps; episodes terminate after ``n_steps``; odd steps emit a
+    non-empty info."""
+
+    def __init__(self, n_steps: int = 5, offset: int = 0):
+        self.action_space = gym.spaces.Dict(
+            {"d": gym.spaces.Discrete(3), "c": gym.spaces.Box(-1.0, 1.0, (2,), dtype=np.float32)}
+        )
+        self.observation_space = gym.spaces.Box(-1e6, 1e6, (4,), dtype=np.float32)
+        self._n_steps = n_steps
+        self._offset = offset
+        self._t = 0
+
+    def _obs(self):
+        return np.full((4,), self._t + self._offset, dtype=np.float32)
+
+    def reset(self, seed=None, options=None):
+        super().reset(seed=seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        assert isinstance(action, dict) and set(action) == {"d", "c"}
+        self._t += 1
+        terminated = self._t >= self._n_steps
+        info = {"odd": True} if self._t % 2 == 1 else {}
+        return self._obs(), float(self._t), terminated, False, info
+
+
+def _thunks():
+    # different episode lengths so dones are staggered across sub-envs
+    return [lambda: DictActionEnv(n_steps=5, offset=0), lambda: DictActionEnv(n_steps=3, offset=100)]
+
+
+def _actions(space, seed):
+    space.seed(seed)
+    return space.sample()
+
+
+def _assert_infos_equal(a, b, path="infos"):
+    assert set(a.keys()) == set(b.keys()), f"{path}: keys {set(a.keys())} != {set(b.keys())}"
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, dict):
+            _assert_infos_equal(va, vb, f"{path}.{k}")
+        elif isinstance(va, np.ndarray) and va.dtype == object:
+            assert len(va) == len(vb), f"{path}.{k}"
+            for i, (xa, xb) in enumerate(zip(va, vb)):
+                if xa is None or xb is None:
+                    assert xa is None and xb is None, f"{path}.{k}[{i}]"
+                else:
+                    np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb), err_msg=f"{path}.{k}[{i}]")
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=f"{path}.{k}")
+
+
+def test_fallback_path_is_taken():
+    env = FastSyncVectorEnv(_thunks())
+    assert not env._fast_actions
+    env.close()
+
+
+def test_fallback_matches_gymnasium_bit_for_bit():
+    fast = FastSyncVectorEnv(_thunks())
+    ref = SyncVectorEnv(_thunks(), autoreset_mode=AutoresetMode.SAME_STEP, copy=True)
+
+    fobs, finfo = fast.reset(seed=42)
+    robs, rinfo = ref.reset(seed=42)
+    np.testing.assert_array_equal(fobs, robs)
+    _assert_infos_equal(finfo, rinfo)
+
+    for t in range(12):
+        action = _actions(fast.single_action_space, seed=1000 + t)
+        # gymnasium's Dict-space iterate() consumes the BATCHED dict layout
+        actions = {k: np.stack([action[k]] * 2) for k in action}
+        fobs, frew, fterm, ftrunc, finfo = fast.step(actions)
+        robs, rrew, rterm, rtrunc, rinfo = ref.step(actions)
+        np.testing.assert_array_equal(fobs, robs, err_msg=f"step {t} obs")
+        np.testing.assert_array_equal(frew, rrew, err_msg=f"step {t} rew")
+        np.testing.assert_array_equal(fterm, rterm, err_msg=f"step {t} term")
+        np.testing.assert_array_equal(ftrunc, rtrunc, err_msg=f"step {t} trunc")
+        _assert_infos_equal(finfo, rinfo)
+    fast.close()
+    ref.close()
+
+
+def test_fallback_two_step_observation_lifetime():
+    """The batch returned by step(t) must keep its values through step(t+1)
+    (the mains read the previous batch after the next step), and consecutive
+    steps must return distinct buffers (the ping-pong pair)."""
+    env = FastSyncVectorEnv(_thunks())
+    env.reset(seed=0)
+    action = _actions(env.single_action_space, seed=7)
+    actions = {k: np.stack([action[k]] * 2) for k in action}
+
+    obs_t, *_ = env.step(actions)
+    snapshot_t = np.copy(obs_t)
+
+    obs_t1, *_ = env.step(actions)
+    snapshot_t1 = np.copy(obs_t1)
+
+    # contract: obs_t still valid after ONE further step
+    np.testing.assert_array_equal(obs_t, snapshot_t)
+    # ping-pong: the two live batches are distinct storage
+    assert obs_t is not obs_t1
+    assert not np.shares_memory(obs_t, obs_t1)
+
+    env.step(actions)
+    # obs_t1 (the previous batch) is still intact now
+    np.testing.assert_array_equal(obs_t1, snapshot_t1)
+    env.close()
+
+
+def test_fast_path_matches_gymnasium_bit_for_bit():
+    """Control experiment: the array-action fast path against gymnasium on
+    the same deterministic envs (Discrete actions)."""
+    from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+    def mk():
+        return [lambda: DiscreteDummyEnv(dict_obs_space=False, n_steps=4), lambda: DiscreteDummyEnv(dict_obs_space=False, n_steps=6)]
+
+    fast = FastSyncVectorEnv(mk())
+    ref = SyncVectorEnv(mk(), autoreset_mode=AutoresetMode.SAME_STEP, copy=True)
+    assert fast._fast_actions
+    fobs, finfo = fast.reset(seed=3)
+    robs, rinfo = ref.reset(seed=3)
+    np.testing.assert_array_equal(fobs, robs)
+    _assert_infos_equal(finfo, rinfo)
+    rng = np.random.RandomState(0)
+    for t in range(15):
+        acts = rng.randint(0, 2, size=(2,))
+        fobs, frew, fterm, ftrunc, finfo = fast.step(acts)
+        robs, rrew, rterm, rtrunc, rinfo = ref.step(acts)
+        np.testing.assert_array_equal(fobs, robs, err_msg=f"step {t} obs")
+        np.testing.assert_array_equal(frew, rrew)
+        np.testing.assert_array_equal(fterm, rterm)
+        np.testing.assert_array_equal(ftrunc, rtrunc)
+        _assert_infos_equal(finfo, rinfo)
+    fast.close()
+    ref.close()

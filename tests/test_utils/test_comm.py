@@ -12,6 +12,7 @@ from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.parallel.comm import get_grad_reduce_dtype, pmean_grads, set_grad_reduce_dtype
 from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.parallel.compat import shard_map
 
 
 @pytest.fixture(autouse=True)
@@ -27,7 +28,7 @@ def _reduce(tree):
         return pmean_grads(t, "dp")
 
     fn = jax.jit(
-        jax.shard_map(body, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+        shard_map(body, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
     )
     return fn(tree)
 
@@ -57,7 +58,7 @@ def test_bf16_reduces_on_the_wire_but_returns_f32():
 
     fabric = Fabric(devices=2)
     lowered = jax.jit(
-        jax.shard_map(body, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+        shard_map(body, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
     ).lower({"g": x})
     hlo = lowered.compile().as_text()
     bf16_converts = [l for l in hlo.splitlines() if "bf16[" in l and "convert" in l]

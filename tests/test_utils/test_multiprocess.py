@@ -27,6 +27,7 @@ maybe_init()  # env-var driven: SHEEPRL_COORDINATOR/NUM_PROCESSES/PROCESS_ID
 
 import jax.numpy as jnp
 import numpy as np
+from sheeprl_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 assert jax.process_count() == 2, jax.process_count()
@@ -49,7 +50,7 @@ fabric.barrier()
 def local_sum(x, w):
     return jax.lax.psum(x * w, "dp")
 
-sharded = jax.shard_map(
+sharded = shard_map(
     local_sum, mesh=fabric.mesh, in_specs=(P("dp"), P()), out_specs=P(), check_vma=False
 )
 host_local = np.full((1,), float(pid + 1), np.float32)  # proc0: [1], proc1: [2]
@@ -78,6 +79,7 @@ maybe_init()
 
 import jax.numpy as jnp
 import numpy as np
+from sheeprl_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 assert jax.process_count() == 2, jax.process_count()
@@ -108,7 +110,7 @@ def gather(x):
     return jax.lax.all_gather(x, "dp", tiled=True)
 
 gathered = jax.jit(
-    jax.shard_map(gather, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+    shard_map(gather, mesh=fabric.mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
 )(global_arr)
 np.testing.assert_allclose(np.asarray(jax.device_get(gathered))[:, 0], np.arange(8, dtype=np.float32))
 
@@ -118,7 +120,7 @@ def local_sum(x, w):
 
 weight = fabric.put_replicated(np.full((2,), 3.0, np.float32))
 total = jax.jit(
-    jax.shard_map(local_sum, mesh=fabric.mesh, in_specs=(P("dp"), P()), out_specs=P(), check_vma=False)
+    shard_map(local_sum, mesh=fabric.mesh, in_specs=(P("dp"), P()), out_specs=P(), check_vma=False)
 )(global_arr, weight)
 np.testing.assert_allclose(np.asarray(total), np.full((1, 2), 3.0 * sum(range(8))))
 
