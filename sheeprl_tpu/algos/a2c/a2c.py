@@ -108,13 +108,13 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int):
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.optim.builders import build_optimizer
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import load_resume_state
 
     rank = fabric.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, rank)

@@ -78,6 +78,7 @@ def make_train_step(
     is_continuous: bool,
     txs: Dict[str, Any],
     ring: Optional[Dict[str, Any]] = None,
+    guard: bool = False,
 ):
     """Build the fully-jitted G-step Dreamer update (see module docstring).
 
@@ -151,6 +152,10 @@ def make_train_step(
 
     def gradient_step(carry, xs):
         params, opts, moments_state, cum = carry
+        # snapshot BEFORE the target-critic EMA below so a guarded skip
+        # undoes the whole step (shallow dict copy: values are replaced,
+        # never mutated, by the updates that follow)
+        old = (params, dict(opts), moments_state) if guard else None
         batch, key = xs  # batch: (T, B_local, ...)
         k_dyn, k_img = jax.random.split(key)
 
@@ -322,6 +327,19 @@ def make_train_step(
             rec_loss, observation_loss, reward_loss, state_loss, continue_loss,
             kl, post_ent, prior_ent, policy_loss, value_loss,
         )
+        if guard:
+            from sheeprl_tpu.ops import finite_guard, guarded_select
+
+            ok = finite_guard((wm_grads, actor_grads, critic_grads, rec_loss, policy_loss, value_loss))
+            # losses are per-device: all-reduce the verdict so every device
+            # takes the same branch and replicated params never desync
+            ok = jax.lax.pmin(ok.astype(jnp.int32), "dp").astype(bool)
+            params, opts, moments_state = guarded_select(ok, (params, opts, moments_state), old)
+            # a skipped step did not happen: EMA/moments cadence keeps phase
+            return (params, opts, moments_state, cum + ok.astype(jnp.int32)), (
+                *metrics,
+                1.0 - ok.astype(jnp.float32),
+            )
         return (params, opts, moments_state, cum + 1), metrics
 
     if ring is None:
@@ -352,14 +370,14 @@ def make_train_step(
 
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.fault import load_resume_state
     from sheeprl_tpu.optim.builders import build_optimizer
-    from sheeprl_tpu.utils.checkpoint import load_state
 
     rank = fabric.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     # These arguments cannot be changed (reference: dreamer_v3.py:369-372)
     cfg.env.frame_stack = -1
@@ -498,6 +516,8 @@ def main(fabric, cfg: Dict[str, Any]):
             f"per_rank_batch_size ({batch_size}) must be divisible by the number of devices ({fabric.world_size})"
         )
     rng = jax.random.PRNGKey(cfg.seed)
+    if state is not None and state.get("rng") is not None:
+        rng = jnp.asarray(state["rng"])  # continue the killed run's stream
     cnn_keys = cfg.algo.cnn_keys.encoder
     mlp_keys = cfg.algo.mlp_keys.encoder
 
@@ -514,6 +534,15 @@ def main(fabric, cfg: Dict[str, Any]):
     # ring owns sampling; without it every pixel transition would be stored
     # twice (HBM ring + host RAM/memmap).
     host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
+
+    # Divergence sentinel on the host-sampled train path (the burst trainer
+    # thread keeps its own metric plumbing; its guard is future work).
+    from sheeprl_tpu.fault import DivergenceSentinel
+
+    sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
+    guard = bool(sentinel_cfg.get("enabled", True)) and not burst_mode
+    sentinel = DivergenceSentinel(sentinel_cfg)
+    ckpt_dir = os.path.join(log_dir, "checkpoint")
 
     if burst_mode:
         from sheeprl_tpu.utils.burst import DREAMER_METRIC_NAMES, HybridPlayerHarness
@@ -557,7 +586,9 @@ def main(fabric, cfg: Dict[str, Any]):
             host_device=hp.host_device,
         )
     else:
-        train_fn = make_train_step(world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs)
+        train_fn = make_train_step(
+            world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs, guard=guard
+        )
     data_sharding = NamedSharding(fabric.mesh, P(None, None, "dp"))
 
     # First observation (reference: dreamer_v3.py:538-551)
@@ -734,6 +765,31 @@ def main(fabric, cfg: Dict[str, Any]):
                                 aggregator.update(name, value)
                 cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step += 1
+                # metrics[-1] is the mean skipped fraction over the G steps
+                if guard and sentinel.observe(float(metrics[-1]) * per_rank_gradient_steps):
+                    def _rollback(good):
+                        nonlocal params, opts, moments_state, rng
+                        params = fabric.put_replicated(
+                            jax.tree.map(
+                                lambda t, s: jnp.asarray(s),
+                                params,
+                                {
+                                    "world_model": good["world_model"],
+                                    "actor": good["actor"],
+                                    "critic": good["critic"],
+                                    "target_critic": good["target_critic"],
+                                },
+                            )
+                        )
+                        cast = lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s
+                        opts = fabric.put_replicated(jax.tree.map(cast, opts, good["optimizers"]))
+                        moments_state = fabric.put_replicated(
+                            jax.tree.map(cast, moments_state, good["moments"])
+                        )
+                        if good.get("rng") is not None:
+                            rng = jnp.asarray(good["rng"])
+
+                    sentinel.recover(ckpt_dir, _rollback)
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
             if aggregator and not aggregator.disabled:
@@ -784,6 +840,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "batch_size": batch_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
+                "rng": rng,
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(

@@ -295,13 +295,13 @@ def make_train_step(world_model, ens_module, actor, critic, cfg, mesh, actions_d
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.optim.builders import build_optimizer
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import load_resume_state
 
     rank = fabric.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     # These arguments cannot be changed (reference: p2e_dv1_exploration.py:300-303)
     cfg.env.screen_size = 64

@@ -52,12 +52,19 @@ from sheeprl_tpu.parallel.compat import axis_size, shard_map
 __all__ = ["main", "make_train_step", "make_local_train"]
 
 
-def make_local_train(agent, tx, cfg, local_batch: int):
+def make_local_train(agent, tx, cfg, local_batch: int, guard: bool = False):
     """Build the per-device epoch/minibatch optimization body (see module
     docstring) — a function ``(params, opt_state, data, key, clip_coef,
     ent_coef) -> (params, opt_state, pg, v, ent)`` that must run inside a
     ``shard_map`` with a ``dp`` axis. :func:`make_train_step` wraps it for
     the host-loop path; ``ppo_anakin`` fuses it after an on-device rollout.
+
+    ``guard=True`` arms the divergence sentinel's in-graph half
+    (:func:`sheeprl_tpu.ops.finite_guard`): a minibatch whose loss or
+    (post-pmean) gradients are non-finite leaves params/optimizer state
+    untouched, and the function returns a sixth output — the number of
+    skipped updates — for the host-side
+    :class:`~sheeprl_tpu.fault.DivergenceSentinel`.
 
     ``buffer.share_data`` (reference ``ppo.py:40-47,362-366``: all_gather +
     DistributedSampler) maps to an in-graph ``lax.all_gather`` over ``dp``
@@ -108,6 +115,18 @@ def make_local_train(agent, tx, cfg, local_batch: int):
 
         (loss, (pg, v, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         grads = pmean_grads(grads, "dp")
+        if guard:
+            from sheeprl_tpu.ops import finite_guard, guarded_select
+
+            ok = jnp.logical_and(finite_guard(grads), finite_guard(loss))
+            # the loss is per-device (grads are pmean'd but losses are not):
+            # all-reduce the verdict so every device takes the same branch
+            # and the replicated params stay bit-identical across the mesh
+            ok = jax.lax.pmin(ok.astype(jnp.int32), "dp").astype(bool)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            params, opt_state = guarded_select(ok, (new_params, new_opt_state), (params, opt_state))
+            return (params, opt_state, clip_coef, ent_coef), (pg, v, ent, 1.0 - ok.astype(jnp.float32))
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state, clip_coef, ent_coef), (pg, v, ent)
@@ -142,22 +161,28 @@ def make_local_train(agent, tx, cfg, local_batch: int):
         carry = (params, opt_state, clip_coef, ent_coef)
         carry, losses = jax.lax.scan(epoch_body, carry, jax.random.split(key, update_epochs))
         params, opt_state, _, _ = carry
+        if guard:
+            pg, v, ent, bad = losses
+            pg, v, ent = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), (pg, v, ent))
+            return params, opt_state, pg, v, ent, bad.sum()
         pg, v, ent = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, opt_state, pg, v, ent
 
     return local_train
 
 
-def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True):
+def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True, guard: bool = False):
     """Wrap :func:`make_local_train` in the jitted ``shard_map`` used by the
-    host-loop path: data batch-sharded on ``dp``, params replicated."""
-    local_train = make_local_train(agent, tx, cfg, local_batch)
+    host-loop path: data batch-sharded on ``dp``, params replicated.
+    ``guard=True`` adds the skipped-update count as a sixth output (see
+    :func:`make_local_train`)."""
+    local_train = make_local_train(agent, tx, cfg, local_batch, guard=guard)
 
     shard_train = shard_map(
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P("dp"), P(), P(), P()),
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()) if guard else (P(), P(), P(), P(), P()),
         check_vma=False,
     )
     # The decoupled topology disables donation: the player thread still reads
@@ -168,7 +193,7 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True)
 
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import DivergenceSentinel, NaNInjector, load_resume_state
 
     initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
     initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
@@ -178,7 +203,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        # corrupt/half-written resume target falls back to the previous
+        # complete manifest entry instead of dying
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, rank)
@@ -284,11 +311,23 @@ def main(fabric, cfg: Dict[str, Any]):
             f"rollout_steps*num_envs ({local_batch_global}) must be divisible by the number of devices "
             f"({fabric.world_size})"
         )
-    train_fn = make_train_step(agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size)
+    sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
+    guard = bool(sentinel_cfg.get("enabled", True))
+    sentinel = DivergenceSentinel(sentinel_cfg)
+    nan_injector = NaNInjector(cfg)
+    ckpt_dir = os.path.join(log_dir, "checkpoint")
+
+    train_fn = make_train_step(
+        agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size, guard=guard
+    )
     gae_fn = jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
 
     rng = jax.random.PRNGKey(cfg.seed)
     rng, _ = jax.random.split(rng)
+    if state is not None and state.get("rng") is not None:
+        # restore the rollout/train RNG so the resumed stream continues
+        # where the killed run left off
+        rng = jnp.asarray(state["rng"])
 
     lr = lr0
     clip_coef = float(cfg.algo.clip_coef)
@@ -379,22 +418,46 @@ def main(fabric, cfg: Dict[str, Any]):
         flat_data = {k: v.reshape(-1, *v.shape[2:]) for k, v in local_data.items()}
         flat_data["returns"] = returns.reshape(-1, *returns.shape[2:])
         flat_data["advantages"] = advantages.reshape(-1, *advantages.shape[2:])
+        if nan_injector:
+            nan_injector.poison(flat_data, "advantages", iter_num)
         flat_data = fabric.shard_data(flat_data)
 
         with timer("Time/train_time", SumMetric):
             rng, train_key = jax.random.split(rng)
-            params, opt_state, pg_l, v_l, ent_l = train_fn(
+            outs = train_fn(
                 params, opt_state, flat_data, train_key,
                 jnp.asarray(clip_coef, dtype=jnp.float32), jnp.asarray(ent_coef, dtype=jnp.float32),
             )
+            params, opt_state, pg_l, v_l, ent_l = outs[:5]
             if aggregator and not aggregator.disabled:
                 aggregator.update("Loss/policy_loss", pg_l)
                 aggregator.update("Loss/value_loss", v_l)
                 aggregator.update("Loss/entropy_loss", ent_l)
         train_step += 1
 
+        if guard and sentinel.observe(outs[5]):
+            def _rollback(good):
+                nonlocal params, opt_state, rng
+                params = fabric.put_replicated(
+                    jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"])
+                )
+                opt_state = fabric.put_replicated(
+                    jax.tree.map(
+                        lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, opt_state, good["optimizer"]
+                    )
+                )
+                if good.get("rng") is not None:
+                    rng = jnp.asarray(good["rng"])
+
+            sentinel.recover(ckpt_dir, _rollback)
+
         if cfg.metric.log_level > 0:
             logger.log_dict({"Info/learning_rate": lr, "Info/clip_coef": clip_coef, "Info/ent_coef": ent_coef}, policy_step)
+            restarts = getattr(envs, "env_restarts", 0)
+            if restarts:
+                logger.log_dict({"Fault/env_restarts": restarts}, policy_step)
+            if guard and sentinel.total_skipped:
+                logger.log_dict({"Fault/skipped_updates": sentinel.total_skipped}, policy_step)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
                 if aggregator and not aggregator.disabled:
                     logger.log_dict(aggregator.compute(), policy_step)
@@ -445,6 +508,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "batch_size": cfg.algo.per_rank_batch_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
+                "rng": rng,
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
             fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)

@@ -69,7 +69,16 @@ __all__ = ["main", "make_anakin_block"]
 
 
 def make_anakin_block(
-    agent, tx, cfg, mesh, benv, local_envs: int, iters_per_block: int, obs_key: str, ferry_episodes: bool = True
+    agent,
+    tx,
+    cfg,
+    mesh,
+    benv,
+    local_envs: int,
+    iters_per_block: int,
+    obs_key: str,
+    ferry_episodes: bool = True,
+    guard: bool = False,
 ):
     """Build the jitted fused block: ``iters_per_block`` × (rollout ``lax.scan``
     → GAE → epoch/minibatch optimization) as ONE ``shard_map`` over ``dp``.
@@ -89,7 +98,10 @@ def make_anakin_block(
     gae_lambda = float(cfg.algo.gae_lambda)
     is_continuous = agent.is_continuous
     n_heads = 1 if is_continuous else len(agent.actions_dim)
-    local_train = make_local_train(agent, tx, cfg, T * local_envs)
+    # guard=True: NaN/Inf minibatches skip their update in graph and the
+    # per-iteration skip count rides out with the block metrics ("bad") —
+    # the only way to sentinel a fused multi-iteration program.
+    local_train = make_local_train(agent, tx, cfg, T * local_envs, guard=guard)
 
     def rollout_step(carry, _):
         params, env_state, obs, ep_ret, ep_len, key = carry
@@ -151,8 +163,11 @@ def make_anakin_block(
             "advantages": advantages,
         }
         data = {k: v.reshape(T * local_envs, *v.shape[2:]) for k, v in data.items()}
-        params, opt_state, pg, v, ent = local_train(params, opt_state, data, train_key, clip_coef, ent_coef)
+        outs = local_train(params, opt_state, data, train_key, clip_coef, ent_coef)
+        params, opt_state, pg, v, ent = outs[:5]
         metrics = {"pg": pg, "v": v, "ent": ent}
+        if guard:
+            metrics["bad"] = outs[5]
         if ferry_episodes:
             metrics.update(ep_done=traj["ep_done"], ep_ret=traj["ep_ret"], ep_len=traj["ep_len"])
         return (params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef), metrics
@@ -167,6 +182,8 @@ def make_anakin_block(
 
     env_sharded = P("dp")
     metric_specs = {"pg": P(), "v": P(), "ent": P()}
+    if guard:
+        metric_specs["bad"] = P()
     if ferry_episodes:
         metric_specs.update(ep_done=P(None, None, "dp"), ep_ret=P(None, None, "dp"), ep_len=P(None, None, "dp"))
     shard_block = shard_map(
@@ -181,7 +198,7 @@ def make_anakin_block(
 
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import DivergenceSentinel, load_resume_state
 
     if jax.process_count() > 1:  # pragma: no cover - single-host subsystem
         raise NotImplementedError(
@@ -195,7 +212,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, rank)
@@ -305,8 +322,15 @@ def main(fabric, cfg: Dict[str, Any]):
         # bound the per-block metric ferry (3 arrays of (iters, T, num_envs))
         iters_per_block = max(1, min(iters_per_block, (1 << 24) // max(1, T * num_envs)))
 
+    sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
+    guard = bool(sentinel_cfg.get("enabled", True))
+    sentinel = DivergenceSentinel(sentinel_cfg)
+    ckpt_dir = os.path.join(log_dir, "checkpoint")
+
     rng = jax.random.PRNGKey(cfg.seed)
     rng, env_reset_key, rollout_root = jax.random.split(rng, 3)
+    if state is not None and state.get("rng") is not None:
+        rng = jnp.asarray(state["rng"])  # continue the killed run's stream
 
     benv = BatchedJaxEnv(jenv, num_envs)
     env_state, first_obs = jax.jit(benv.reset)(env_reset_key)
@@ -323,7 +347,8 @@ def main(fabric, cfg: Dict[str, Any]):
         # one compile per distinct block length (at most two: body + remainder)
         if n_iters not in block_fns:
             block_fns[n_iters] = make_anakin_block(
-                agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key, ferry_episodes=ferry_episodes
+                agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key,
+                ferry_episodes=ferry_episodes, guard=guard,
             )
         return block_fns[n_iters]
 
@@ -351,10 +376,15 @@ def main(fabric, cfg: Dict[str, Any]):
 
         # Host-side bookkeeping for the fused block, iteration by iteration
         # (same counters/cadence the host loop maintains per iteration)
+        tripped = False
         for i in range(block_iters):
             iter_num += 1
             policy_step += policy_steps_per_iter
             train_step += 1
+            if guard:
+                # keep observing past a trip: counters stay accurate and a
+                # streak spanning the whole block still reads as one streak
+                tripped = sentinel.observe(metrics["bad"][i]) or tripped
             if aggregator and not aggregator.disabled:
                 aggregator.update("Loss/policy_loss", metrics["pg"][i])
                 aggregator.update("Loss/value_loss", metrics["v"][i])
@@ -372,8 +402,26 @@ def main(fabric, cfg: Dict[str, Any]):
                             aggregator.update("Game/ep_len_avg", lens[t_i, e_i])
                         print(f"Rank-0: policy_step={policy_step}, reward_env_{e_i}={rets[t_i, e_i]}")
 
+        if tripped:
+            def _rollback(good):
+                nonlocal params, opt_state, rng
+                params = fabric.put_replicated(
+                    jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"])
+                )
+                opt_state = fabric.put_replicated(
+                    jax.tree.map(
+                        lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, opt_state, good["optimizer"]
+                    )
+                )
+                if good.get("rng") is not None:
+                    rng = jnp.asarray(good["rng"])
+
+            sentinel.recover(ckpt_dir, _rollback)
+
         if cfg.metric.log_level > 0:
             logger.log_dict({"Info/learning_rate": lr, "Info/clip_coef": clip_coef, "Info/ent_coef": ent_coef}, policy_step)
+            if guard and sentinel.total_skipped:
+                logger.log_dict({"Fault/skipped_updates": sentinel.total_skipped}, policy_step)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
                 if aggregator and not aggregator.disabled:
                     logger.log_dict(aggregator.compute(), policy_step)
@@ -417,6 +465,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "batch_size": cfg.algo.per_rank_batch_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
+                "rng": rng,
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
             fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)

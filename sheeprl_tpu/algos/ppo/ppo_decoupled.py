@@ -54,7 +54,7 @@ __all__ = ["main"]
 
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import load_resume_state
 
     initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
     initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
@@ -63,7 +63,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, rank)

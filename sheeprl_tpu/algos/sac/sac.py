@@ -48,19 +48,25 @@ from sheeprl_tpu.parallel.compat import shard_map
 __all__ = ["main", "make_train_step"]
 
 
-def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, donate: bool = True):
+def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, donate: bool = True, guard: bool = False):
     """Build the fully-jitted G-gradient-step update (see module docstring).
 
     Inputs at call time: ``data`` pytree shaped ``(G, B, ...)`` with the batch
     axis sharded over ``dp``; ``ema_flag`` a 0/1 scalar (the reference applies
     the EMA inside every minibatch of an iteration when
     ``iter % (target_network_frequency // policy_steps_per_iter + 1) == 0``,
-    ``sac.py:55-57``)."""
+    ``sac.py:55-57``).
+
+    ``guard=True``: a gradient step whose critic/actor/alpha grads are
+    non-finite leaves the whole train state (incl. the target-critic EMA)
+    untouched, and an eighth output counts the skipped steps for the
+    divergence sentinel."""
     gamma = float(cfg.algo.gamma)
     target_entropy = agent.target_entropy
 
     def minibatch_step(carry, xs):
         params, aopt, copt, lopt, ema_flag = carry
+        old = (params, aopt, copt, lopt)
         batch, key = xs
         k_next, k_actor = jax.random.split(key)
         obs = batch["observations"]
@@ -105,6 +111,17 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
         lupd, lopt = alpha_tx.update(lgrads, lopt, params["log_alpha"])
         params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
 
+        if guard:
+            from sheeprl_tpu.ops import finite_guard, guarded_select
+
+            ok = finite_guard((cgrads, agrads, lgrads, qf_loss, actor_loss, alpha_loss))
+            # losses are per-device: all-reduce the verdict so every device
+            # takes the same branch and replicated params never desync
+            ok = jax.lax.pmin(ok.astype(jnp.int32), "dp").astype(bool)
+            params, aopt, copt, lopt = guarded_select(ok, (params, aopt, copt, lopt), old)
+            return (params, aopt, copt, lopt, ema_flag), (
+                qf_loss, actor_loss, alpha_loss, 1.0 - ok.astype(jnp.float32)
+            )
         return (params, aopt, copt, lopt, ema_flag), (qf_loss, actor_loss, alpha_loss)
 
     def local_train(params, aopt, copt, lopt, data, key, ema_flag):
@@ -114,6 +131,10 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
         carry = (params, aopt, copt, lopt, ema_flag)
         carry, losses = jax.lax.scan(minibatch_step, carry, (data, keys))
         params, aopt, copt, lopt, _ = carry
+        if guard:
+            qf, al, ll, bad = losses
+            qf, al, ll = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), (qf, al, ll))
+            return params, aopt, copt, lopt, qf, al, ll, bad.sum()
         qf, al, ll = jax.tree.map(lambda x: jax.lax.pmean(x.mean(), "dp"), losses)
         return params, aopt, copt, lopt, qf, al, ll
 
@@ -121,7 +142,7 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
         local_train,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, "dp"), P(), P()),
-        out_specs=(P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(),) * (8 if guard else 7),
         check_vma=False,
     )
     # See ppo.make_train_step: the decoupled player still reads old snapshots.
@@ -278,14 +299,14 @@ def make_burst_train_step(
 
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.fault import load_resume_state
     from sheeprl_tpu.optim.builders import build_optimizer
-    from sheeprl_tpu.utils.checkpoint import load_state
 
     rank = fabric.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     if len(cfg.algo.cnn_keys.encoder) > 0:
         warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
@@ -429,6 +450,16 @@ def main(fabric, cfg: Dict[str, Any]):
         burst_mode = False
     ema_modulus = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
 
+    # Divergence sentinel: in-graph guard on the plain train path (the burst
+    # path dispatches from a trainer thread and keeps its own valid-mask
+    # no-op machinery; its guard integration is future work).
+    from sheeprl_tpu.fault import DivergenceSentinel
+
+    sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
+    guard = bool(sentinel_cfg.get("enabled", True)) and not burst_mode
+    sentinel = DivergenceSentinel(sentinel_cfg)
+    ckpt_dir = os.path.join(log_dir, "checkpoint")
+
     # Donation would invalidate the params buffers while a host snapshot
     # transfer is still in flight; SAC params are tiny, so keep them.
     train_fn = None
@@ -539,10 +570,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 cumulative_per_rank_gradient_steps += chunk
                 train_step += 1
     else:
-        train_fn = make_train_step(agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=not hp_enabled)
+        train_fn = make_train_step(
+            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=not hp_enabled, guard=guard
+        )
     data_sharding = NamedSharding(fabric.mesh, P(None, "dp"))
 
     rng = jax.random.PRNGKey(cfg.seed)
+    if state is not None and state.get("rng") is not None:
+        rng = jnp.asarray(state["rng"])  # continue the killed run's stream
     if burst_mode:
         # Host-resident key stream (threefry is platform-deterministic, so
         # the values are unchanged): the burst path consumes keys on the
@@ -656,18 +691,36 @@ def main(fabric, cfg: Dict[str, Any]):
                 with timer("Time/train_time", SumMetric):
                     rng, train_key = jax.random.split(rng)
                     ema_flag = jnp.float32(1.0 if iter_num % ema_modulus == 0 else 0.0)
-                    params, aopt, copt, lopt, qf_l, a_l, al_l = train_fn(
-                        params, aopt, copt, lopt, data, train_key, ema_flag
-                    )
+                    outs = train_fn(params, aopt, copt, lopt, data, train_key, ema_flag)
+                    params, aopt, copt, lopt, qf_l, a_l, al_l = outs[:7]
                     if aggregator and not aggregator.disabled:
                         aggregator.update("Loss/value_loss", qf_l)
                         aggregator.update("Loss/policy_loss", a_l)
                         aggregator.update("Loss/alpha_loss", al_l)
                 cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step += 1
+                if guard and sentinel.observe(outs[7]):
+                    def _rollback(good):
+                        nonlocal params, aopt, copt, lopt, rng
+                        params = fabric.put_replicated(
+                            jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"])
+                        )
+                        cast = lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s
+                        aopt = fabric.put_replicated(jax.tree.map(cast, aopt, good["actor_optimizer"]))
+                        copt = fabric.put_replicated(jax.tree.map(cast, copt, good["qf_optimizer"]))
+                        lopt = fabric.put_replicated(jax.tree.map(cast, lopt, good["alpha_optimizer"]))
+                        if good.get("rng") is not None:
+                            rng = jnp.asarray(good["rng"])
+
+                    sentinel.recover(ckpt_dir, _rollback)
 
         # Logging (reference: sac.py:358-392)
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            restarts = getattr(envs, "env_restarts", 0)
+            if restarts:
+                logger.log_dict({"Fault/env_restarts": restarts}, policy_step)
+            if guard and sentinel.total_skipped:
+                logger.log_dict({"Fault/skipped_updates": sentinel.total_skipped}, policy_step)
             if aggregator and not aggregator.disabled:
                 logger.log_dict(aggregator.compute(), policy_step)
                 aggregator.reset()
@@ -714,6 +767,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "batch_size": batch_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
+                "rng": rng,
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
             fabric.call(

@@ -45,13 +45,13 @@ __all__ = ["main"]
 @register_algorithm(decoupled=True)
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.optim.builders import build_optimizer
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import load_resume_state
 
     rank = fabric.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     if len(cfg.algo.cnn_keys.encoder) > 0:
         warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")

@@ -10,6 +10,8 @@ import numpy as np
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
 
+# Fault/* counters are cumulative gauges logged directly (logger.log_dict),
+# not aggregated — keep them out of the aggregator key set.
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",

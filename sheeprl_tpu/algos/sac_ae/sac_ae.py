@@ -186,13 +186,13 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, Any], cfg, mesh):
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.optim.builders import build_optimizer
-    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.fault import load_resume_state
 
     rank = fabric.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
-        state = load_state(cfg.checkpoint.resume_from)
+        state = load_resume_state(cfg.checkpoint.resume_from)
 
     # These arguments cannot be changed (reference: sac_ae.py:137)
     cfg.env.screen_size = 64

@@ -33,6 +33,26 @@ from sheeprl_tpu.utils.registry import (
 __all__ = ["run", "evaluation", "registration", "available_agents", "main", "run_algorithm", "eval_algorithm"]
 
 
+def resolve_resume_latest(cfg: DotDict) -> DotDict:
+    """``checkpoint.resume_from=latest`` → the newest *complete* checkpoint
+    under this experiment's root (``<log_root>/<root_dir>``), discovered via
+    the run manifests; half-written/corrupt saves are skipped."""
+    if str(cfg.checkpoint.resume_from).strip().lower() != "latest":
+        return cfg
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import CheckpointError
+
+    root = pathlib.Path(cfg.get("log_root", "logs/runs")) / str(cfg.root_dir)
+    resolved = find_latest_run_checkpoint(root)
+    if resolved is None:
+        raise CheckpointError(
+            f"checkpoint.resume_from=latest: no complete checkpoint found under {root}", root
+        )
+    print(f"checkpoint.resume_from=latest -> {resolved}")
+    cfg.checkpoint.resume_from = str(resolved)
+    return cfg
+
+
 def resume_from_checkpoint(cfg: DotDict) -> DotDict:
     """Merge the checkpoint run's saved config over the current one
     (reference: ``cli.py:23-56``)."""
@@ -170,7 +190,13 @@ def run_algorithm(cfg: DotDict) -> None:
     for cb_spec in cfg.fabric.get("callbacks") or []:
         target = cb_spec.get("_target_", "") if isinstance(cb_spec, dict) else ""
         if target.endswith("CheckpointCallback"):
-            callbacks.append(CheckpointCallback(keep_last=cb_spec.get("keep_last")))
+            from sheeprl_tpu.fault.manager import CheckpointManager
+
+            manager = CheckpointManager(
+                keep_last=cb_spec.get("keep_last"),
+                async_save=bool(cfg.checkpoint.get("async_save", False)),
+            )
+            callbacks.append(CheckpointCallback(keep_last=cb_spec.get("keep_last"), manager=manager))
     fabric = Fabric.from_config(cfg.fabric, callbacks=callbacks)
 
     def reproducible(func):
@@ -235,6 +261,7 @@ def run(args: Optional[List[str]] = None) -> None:
 
     print_config(cfg)
     if cfg.checkpoint.resume_from:
+        cfg = resolve_resume_latest(cfg)
         cfg = resume_from_checkpoint(cfg)
     check_configs(cfg)
     run_algorithm(cfg)

@@ -302,5 +302,15 @@ def vectorize_env(
     if restart_on_exception:
         thunks = [partial(RestartOnException, t) for t in thunks]
     if cfg.env.sync_env:
-        return FastSyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        # env.restart_attempts/step_timeout: per-worker self-healing (crash
+        # retry with backoff + hang watchdog); the async path keeps
+        # gymnasium's worker processes, where a crash already only kills the
+        # worker.
+        return FastSyncVectorEnv(
+            thunks,
+            autoreset_mode=AutoresetMode.SAME_STEP,
+            restart_attempts=int(cfg.env.get("restart_attempts", 0) or 0),
+            restart_backoff=float(cfg.env.get("restart_backoff", 0.5) or 0.0),
+            step_timeout=cfg.env.get("step_timeout"),
+        )
     return AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)

@@ -48,7 +48,32 @@ class FastSyncVectorEnv(SyncVectorEnv):
         self,
         env_fns: Iterator[Callable[[], Env]] | Sequence[Callable[[], Env]],
         autoreset_mode: AutoresetMode = AutoresetMode.SAME_STEP,
+        restart_attempts: int = 0,
+        restart_backoff: float = 0.5,
+        step_timeout: "float | None" = None,
     ):
+        # Fault tolerance (``env.restart_attempts > 0`` or a watchdog
+        # timeout): each worker is wrapped in a SelfHealingEnv holding its
+        # build thunk — a crash/hang is healed by recreating the env with
+        # bounded retry + exponential backoff and surfaces as a truncation
+        # (info["env_restarted"]) instead of killing the run. The shared
+        # counter feeds the ``Fault/env_restarts`` metric.
+        self._restart_counter = [0]
+        if restart_attempts > 0 or (step_timeout and step_timeout > 0):
+            from sheeprl_tpu.fault.watchdog import SelfHealingEnv
+
+            env_fns = [
+                (
+                    lambda fn=fn: SelfHealingEnv(
+                        fn,
+                        attempts=max(1, int(restart_attempts)),
+                        backoff=restart_backoff,
+                        step_timeout=step_timeout,
+                        restart_counter=self._restart_counter,
+                    )
+                )
+                for fn in env_fns
+            ]
         super().__init__(env_fns, copy=False, autoreset_mode=autoreset_mode)
         self._obs_buffers = [
             create_empty_array(self.single_observation_space, n=self.num_envs, fn=np.zeros) for _ in range(2)
@@ -61,6 +86,11 @@ class FastSyncVectorEnv(SyncVectorEnv):
         # Array-indexable batched action spaces take the fast path; anything
         # exotic (Dict/Tuple actions) falls back to gymnasium's step.
         self._fast_actions = isinstance(self.single_action_space, (Box, Discrete, MultiDiscrete, MultiBinary))
+
+    @property
+    def env_restarts(self) -> int:
+        """Total sub-env recreations performed by the self-healing wrappers."""
+        return self._restart_counter[0]
 
     def _rehome_fallback_batch(self):
         """Copy the per-env observations into the next ping-pong buffer and

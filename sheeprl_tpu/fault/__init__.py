@@ -1,0 +1,38 @@
+"""Fault-tolerant training runtime.
+
+Atomic/async checkpointing with manifest-published auto-resume
+(:mod:`~sheeprl_tpu.fault.manager`), divergence sentinels around the
+jittable finite guard (:mod:`~sheeprl_tpu.fault.sentinel`), self-healing
+vector-env workers (:mod:`~sheeprl_tpu.fault.watchdog`) and the
+deterministic fault-injection harness that keeps all of it tested
+(:mod:`~sheeprl_tpu.fault.inject`). See ``howto/fault_tolerance.md``.
+"""
+
+from sheeprl_tpu.fault.inject import FaultInjected, FlakyEnv, NaNInjector, fault_point
+from sheeprl_tpu.fault.manager import (
+    CheckpointManager,
+    find_latest_run_checkpoint,
+    latest_complete,
+    load_resume_state,
+    read_manifest,
+)
+from sheeprl_tpu.fault.sentinel import DivergenceError, DivergenceSentinel
+from sheeprl_tpu.fault.watchdog import EnvTimeoutError, SelfHealingEnv
+from sheeprl_tpu.utils.checkpoint import CheckpointError
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointManager",
+    "DivergenceError",
+    "DivergenceSentinel",
+    "EnvTimeoutError",
+    "FaultInjected",
+    "FlakyEnv",
+    "NaNInjector",
+    "SelfHealingEnv",
+    "fault_point",
+    "find_latest_run_checkpoint",
+    "latest_complete",
+    "load_resume_state",
+    "read_manifest",
+]

@@ -1,0 +1,194 @@
+"""Deterministic fault injection — the harness that makes recovery TESTED.
+
+Probe points (``fault_point``) are compiled into the IO paths that matter
+(checkpoint staging/publish); tests arm them either in-process (``arm`` →
+raise :class:`FaultInjected`) or across a subprocess boundary via the
+``SHEEPRL_FAULT_KILL`` environment variable (→ ``SIGKILL`` mid-save, the
+preemption model of a TPU spot VM). File corrupters and flaky/hanging env
+builders round out the toolbox:
+
+- ``SHEEPRL_FAULT_KILL="checkpoint.pre_commit:2"`` — SIGKILL the process the
+  2nd time the ``checkpoint.pre_commit`` probe fires (comma-separate to arm
+  several points);
+- ``arm("checkpoint.staged", at=1)`` — raise ``FaultInjected`` in-process;
+- ``truncate_file`` / ``scramble_file`` — simulate torn/corrupted writes;
+- ``NaNInjector`` — poison training data at chosen iterations so the
+  divergence sentinel path is exercised end-to-end;
+- ``FlakyEnv`` — an env wrapper whose ``step``/``reset`` raises or hangs on
+  schedule, driven by a shared fuse so a recreated instance stays healthy.
+
+Everything is process-local and deterministic: counters advance only when a
+probe is armed for that point, so production runs pay one dict lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import gymnasium as gym
+
+__all__ = [
+    "FaultInjected",
+    "fault_point",
+    "arm",
+    "disarm",
+    "reset",
+    "truncate_file",
+    "scramble_file",
+    "NaNInjector",
+    "FlakyEnv",
+]
+
+KILL_ENV_VAR = "SHEEPRL_FAULT_KILL"
+NAN_ENV_VAR = "SHEEPRL_FAULT_NAN_AT"
+
+_counts: Dict[str, int] = {}
+_armed: Dict[str, Tuple[str, int]] = {}  # point -> (action, fire-on-Nth-hit)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an in-process-armed fault point."""
+
+
+def arm(point: str, action: str = "raise", at: int = 1) -> None:
+    """Arm ``point`` to fire on its ``at``-th hit. ``action``: "raise"|"kill"."""
+    if action not in ("raise", "kill"):
+        raise ValueError(f"Unknown fault action '{action}'")
+    _armed[point] = (action, int(at))
+    _counts.pop(point, None)
+
+
+def disarm(point: Optional[str] = None) -> None:
+    if point is None:
+        _armed.clear()
+    else:
+        _armed.pop(point, None)
+
+
+def reset() -> None:
+    """Clear all armed points and hit counters (test isolation)."""
+    _armed.clear()
+    _counts.clear()
+
+
+def _env_spec(point: str) -> Optional[Tuple[str, int]]:
+    raw = os.environ.get(KILL_ENV_VAR, "")
+    if not raw:
+        return None
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        name, _, at = token.partition(":")
+        if name == point:
+            return ("kill", int(at) if at else 1)
+    return None
+
+
+def fault_point(point: str) -> None:
+    """Probe: no-op unless ``point`` is armed (in-process or via env var)."""
+    spec = _armed.get(point) or _env_spec(point)
+    if spec is None:
+        return
+    action, at = spec
+    _counts[point] = _counts.get(point, 0) + 1
+    if _counts[point] != at:
+        return
+    if action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)  # the preemption model: no cleanup
+    raise FaultInjected(f"fault injected at '{point}' (hit {at})")
+
+
+# -- file corrupters ---------------------------------------------------------
+def truncate_file(path: "str | Path", keep_bytes: int = 8) -> None:
+    """Truncate ``path`` to ``keep_bytes`` — a torn write."""
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+
+
+def scramble_file(path: "str | Path", seed: int = 0) -> None:
+    """Overwrite ``path`` with deterministic garbage of the same size."""
+    import numpy as np
+
+    size = max(1, os.path.getsize(path))
+    rng = np.random.default_rng(seed)
+    with open(path, "wb") as f:
+        f.write(rng.integers(0, 256, size=size, dtype=np.uint8).tobytes())
+
+
+# -- NaN injection -----------------------------------------------------------
+class NaNInjector:
+    """Poison a training-data key with NaNs at configured iterations.
+
+    Sources: ``cfg.fault.inject.nan_grads_at`` (list of iteration numbers)
+    and the ``SHEEPRL_FAULT_NAN_AT`` env var ("2,5"). The poisoned key (PPO:
+    ``advantages``) flows into the loss → gradients, reproducing the
+    real-world failure (one bad batch NaN-ing the update) without touching
+    the jitted program."""
+
+    def __init__(self, cfg: Optional[Any] = None, at: Sequence[int] = ()) -> None:
+        iters: List[int] = [int(i) for i in at]
+        if cfg is not None:
+            inject_cfg = (cfg.get("fault") or {}).get("inject") or {}
+            iters += [int(i) for i in (inject_cfg.get("nan_grads_at") or ())]
+        raw = os.environ.get(NAN_ENV_VAR, "")
+        iters += [int(t) for t in raw.split(",") if t.strip()]
+        self.at = frozenset(iters)
+        self.fired = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.at)
+
+    def fires(self, iter_num: int) -> bool:
+        return int(iter_num) in self.at
+
+    def poison(self, data: Dict[str, Any], key: str, iter_num: int) -> Dict[str, Any]:
+        if self.fires(iter_num):
+            import numpy as np
+
+            data[key] = np.full(np.shape(np.asarray(data[key])), np.nan, dtype=np.float32)
+            self.fired += 1
+        return data
+
+
+# -- flaky / hanging envs ----------------------------------------------------
+class FlakyEnv(gym.Wrapper):
+    """Env wrapper whose ``step``/``reset`` raises or hangs on schedule.
+
+    ``fuse`` is a shared mutable list of remaining failures: pass the same
+    list into every instance built by a thunk so a *recreated* env does not
+    re-fail immediately (the recovery path under test). ``mode`` is
+    ``"raise"`` or ``"hang"`` (sleeps ``hang_seconds`` to trip watchdogs)."""
+
+    def __init__(
+        self,
+        env: "gym.Env",
+        fuse: List[int],
+        fail_on: str = "step",
+        mode: str = "raise",
+        hang_seconds: float = 60.0,
+    ) -> None:
+        super().__init__(env)
+        self._fuse = fuse
+        self._fail_on = fail_on
+        self._mode = mode
+        self._hang_seconds = hang_seconds
+
+    def _maybe_fail(self, phase: str) -> None:
+        if phase == self._fail_on and self._fuse and self._fuse[0] > 0:
+            self._fuse[0] -= 1
+            if self._mode == "hang":
+                time.sleep(self._hang_seconds)
+            raise RuntimeError(f"FlakyEnv: injected {phase} failure")
+
+    def step(self, action):
+        self._maybe_fail("step")
+        return self.env.step(action)
+
+    def reset(self, *, seed=None, options=None):
+        self._maybe_fail("reset")
+        return self.env.reset(seed=seed, options=options)

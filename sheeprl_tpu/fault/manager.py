@@ -1,0 +1,357 @@
+"""Checkpoint lifecycle management: manifest, retention, async save, resume.
+
+Builds the preemption-safe training runtime on top of the atomic primitives
+in :mod:`sheeprl_tpu.utils.checkpoint`:
+
+- every successful save is **published** into ``manifest.json`` (step,
+  wall-clock, format version, content digest of the meta pickle) with an
+  atomic tmp+rename update — a checkpoint that is not in the manifest is by
+  definition incomplete and is skipped by discovery and reclaimed by GC;
+- **keep-last-K retention** prunes old steps and sweeps orphaned
+  ``.arrays``/``.rb``/``.tmp``/``.old`` leftovers of killed saves;
+- an optional **async save** stages the device→host pulls on the training
+  thread (non-blocking ``device_put``) and runs serialization + fsync +
+  publish on a single writer thread, overlapping disk IO with the next train
+  block; back-pressure keeps at most one save in flight and write errors
+  re-raise on the next ``save``/``wait``;
+- **auto-resume**: ``checkpoint.resume_from=latest`` walks the run tree for
+  the newest *complete* manifest entry (falling back to scanning bare
+  ``*.ckpt`` files for pre-manifest runs), and :func:`load_resume_state`
+  falls back to the previous manifest entry when the requested checkpoint
+  turns out to be corrupt.
+
+Multi-process note: each JAX process saves its own rank-suffixed file, but
+only the process asked to ``publish`` (global zero, mirroring the existing
+retention behavior) appends to the manifest and runs GC — resume always
+restores from the rank-0 file, matching how mains consume ``resume_from``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.utils.checkpoint import (
+    CheckpointError,
+    finalize_host,
+    load_state,
+    stage_to_host,
+    write_host_checkpoint,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "read_manifest",
+    "latest_complete",
+    "find_latest_run_checkpoint",
+    "load_resume_state",
+]
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+_CKPT_RE = re.compile(r"^ckpt_(\d+)_(\d+)\.ckpt$")
+# GC never reclaims tmp/old/orphan artifacts younger than this: an in-flight
+# save of a sibling process must not be swept mid-stage.
+_ORPHAN_GRACE_SECONDS = 600.0
+
+
+def _parse_step(name: str) -> Optional[int]:
+    m = _CKPT_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def _digest(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# -- manifest ----------------------------------------------------------------
+def read_manifest(ckpt_dir: "str | Path") -> List[Dict[str, Any]]:
+    """Entries of ``<ckpt_dir>/manifest.json`` (oldest first). A missing or
+    corrupted manifest yields ``[]`` — discovery then falls back to scanning."""
+    path = Path(ckpt_dir) / MANIFEST_NAME
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        entries = doc.get("entries", [])
+        return [e for e in entries if isinstance(e, dict) and "file" in e]
+    except FileNotFoundError:
+        return []
+    except (ValueError, OSError, AttributeError) as e:
+        # ValueError covers JSONDecodeError AND UnicodeDecodeError (binary
+        # corruption); either way discovery falls back to scanning
+        warnings.warn(f"Ignoring corrupted checkpoint manifest {path}: {e}")
+        return []
+
+
+def _write_manifest(ckpt_dir: Path, entries: List[Dict[str, Any]]) -> None:
+    path = ckpt_dir / MANIFEST_NAME
+    tmp = ckpt_dir / (MANIFEST_NAME + ".tmp")
+    payload = json.dumps({"version": MANIFEST_VERSION, "entries": entries}, indent=0)
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _verify(path: Path) -> bool:
+    """Cheap completeness probe: meta unpickles and the sidecars it promises
+    exist. (Deep corruption inside the orbax dir surfaces at ``load_state``
+    and is handled by the fallback chain.)"""
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except Exception:
+        return False
+    if not isinstance(payload, dict):
+        return False
+    if payload.get("__sheeprl_tpu_ckpt__") != 2:
+        return True  # legacy single-pickle checkpoint: self-contained
+    if payload.get("array_slots") and not Path(str(path) + ".arrays").is_dir():
+        return False
+    if payload.get("has_rb") and not Path(str(path) + ".rb").exists():
+        return False
+    return True
+
+
+def _complete_entries(ckpt_dir: Path) -> List[Tuple[float, int, Path]]:
+    """(time, step, path) of every complete checkpoint, oldest first.
+
+    Manifest entries are trusted first; bare ``*.ckpt`` files absent from the
+    manifest (pre-manifest runs, foreign ranks) are merged in via mtime."""
+    ckpt_dir = Path(ckpt_dir)
+    out: Dict[Path, Tuple[float, int, Path]] = {}
+    for e in read_manifest(ckpt_dir):
+        p = ckpt_dir / str(e["file"])
+        if not _verify(p):
+            continue
+        expected = e.get("digest")
+        if expected:
+            try:
+                if _digest(p) != expected:  # bit-rot / partial overwrite of the meta
+                    continue
+            except OSError:
+                continue
+        out[p] = (float(e.get("time", 0.0)), int(e.get("step", _parse_step(p.name) or 0)), p)
+    if ckpt_dir.is_dir():
+        for p in ckpt_dir.glob("*.ckpt"):
+            if p not in out and _verify(p):
+                step = _parse_step(p.name)
+                out[p] = (p.stat().st_mtime, step if step is not None else 0, p)
+    return sorted(out.values(), key=lambda t: (t[1], t[0]))
+
+
+def latest_complete(ckpt_dir: "str | Path") -> Optional[Path]:
+    """Newest complete checkpoint in ``ckpt_dir`` (skips half-written dirs)."""
+    entries = _complete_entries(Path(ckpt_dir))
+    return entries[-1][2] if entries else None
+
+
+def find_latest_run_checkpoint(root: "str | Path") -> Optional[Path]:
+    """Newest complete checkpoint under an experiment root
+    (``<log_root>/<algo>/<env>``): scans ``*/version_*/checkpoint`` run dirs
+    plus ``root`` itself when it is already a checkpoint dir."""
+    root = Path(root)
+    if not root.exists():
+        return None
+    candidates: List[Tuple[float, int, Path]] = []
+    dirs = [d for d in root.glob("*/version_*/checkpoint") if d.is_dir()]
+    if root.name == "checkpoint" or list(root.glob("*.ckpt")) or (root / MANIFEST_NAME).exists():
+        dirs.append(root)
+    for d in dirs:
+        entries = _complete_entries(d)
+        if entries:
+            candidates.append(entries[-1])
+    if not candidates:
+        return None
+    return max(candidates, key=lambda t: (t[0], t[1]))[2]
+
+
+def load_resume_state(path: "str | Path") -> Dict[str, Any]:
+    """``load_state`` with manifest fallback: when the requested checkpoint
+    is corrupt/incomplete, walk the same directory's OLDER complete entries
+    (newest first, but never past the requested step — an intentional
+    roll-back-in-time resume must not silently jump forward) and resume
+    from the first one that loads."""
+    path = Path(path)
+    try:
+        return load_state(path)
+    except CheckpointError as primary:
+        requested_step = _parse_step(path.name)
+        for _, step, cand in reversed(_complete_entries(path.parent)):
+            if cand == path or (requested_step is not None and step > requested_step):
+                continue
+            try:
+                state = load_state(cand)
+            except CheckpointError:
+                continue
+            warnings.warn(
+                f"Checkpoint {path} is unusable ({primary}); resuming from older complete entry {cand}."
+            )
+            return state
+        raise
+
+
+class CheckpointManager:
+    """Atomic, manifest-published, optionally-async checkpoint saver.
+
+    One instance per run (held by
+    :class:`~sheeprl_tpu.utils.callback.CheckpointCallback`); the directory
+    is bound per save from the checkpoint path the training loop chose, so
+    the manager composes with the existing ``<log_dir>/checkpoint/...``
+    layout without owning path construction.
+    """
+
+    def __init__(self, keep_last: Optional[int] = None, async_save: bool = False) -> None:
+        self.keep_last = int(keep_last) if keep_last else None
+        self.async_save = bool(async_save)
+        self._inflight: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # -- public API ----------------------------------------------------------
+    def save(
+        self,
+        path: "str | Path",
+        state: Dict[str, Any],
+        step: Optional[int] = None,
+        publish: bool = True,
+    ) -> None:
+        """Save ``state`` (with optional ``state["rb"]``) to ``path``.
+
+        Sync mode blocks until the checkpoint is published. Async mode
+        returns once the device→host pulls are staged and the replay buffer
+        is snapshotted (pickled) — mutation of the live buffer after return
+        is safe — while a writer thread finishes serialization + publish.
+        """
+        self._raise_pending()
+        path = Path(path)
+        if step is None:
+            step = _parse_step(path.name) or 0
+        state = dict(state)
+        rb = state.pop("rb", None)
+        rb_bytes = pickle.dumps(rb, protocol=pickle.HIGHEST_PROTOCOL) if rb is not None else None
+
+        if not self.async_save:
+            self._commit(path, finalize_host(stage_to_host(state)), rb_bytes, int(step), publish)
+            return
+
+        staged = stage_to_host(state)
+        self.wait()  # back-pressure: at most one save in flight
+        self._raise_pending()
+        # Non-daemon so an orderly interpreter exit drains the pending save;
+        # a SIGKILL mid-write is exactly what the atomic publish tolerates.
+        self._inflight = threading.Thread(
+            target=self._commit_async,
+            args=(path, staged, rb_bytes, int(step), publish),
+            name=f"ckpt-save-{step}",
+            daemon=False,
+        )
+        self._inflight.start()
+
+    def wait(self) -> None:
+        """Block until the in-flight async save (if any) completes."""
+        if self._inflight is not None:
+            self._inflight.join()
+            self._inflight = None
+
+    def close(self) -> None:
+        self.wait()
+        self._raise_pending()
+
+    # -- internals -----------------------------------------------------------
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointError(f"Asynchronous checkpoint save failed: {err}") from err
+
+    def _commit_async(self, path: Path, staged: Any, rb_bytes: Optional[bytes], step: int, publish: bool) -> None:
+        try:
+            self._commit(path, finalize_host(staged), rb_bytes, step, publish)
+        except BaseException as e:
+            # warn NOW (the run's final save has no later lifecycle call to
+            # re-raise through) and store for the next save()/close()
+            warnings.warn(f"Asynchronous checkpoint save of {path} FAILED: {type(e).__name__}: {e}")
+            self._error = e
+
+    def _commit(self, path: Path, host_state: Any, rb_bytes: Optional[bytes], step: int, publish: bool) -> None:
+        write_host_checkpoint(path, host_state, rb_bytes)
+        if not publish:
+            return
+        entries = read_manifest(path.parent)
+        entries = [e for e in entries if e.get("file") != path.name]
+        entries.append(
+            {
+                "file": path.name,
+                "step": step,
+                "time": time.time(),
+                "format_version": 2,
+                "digest": _digest(path),
+                "has_rb": rb_bytes is not None,
+            }
+        )
+        entries.sort(key=lambda e: (int(e.get("step", 0)), float(e.get("time", 0.0))))
+        if self.keep_last:
+            keep, drop = entries[-self.keep_last :], entries[: -self.keep_last]
+        else:
+            keep, drop = entries, []
+        _write_manifest(path.parent, keep)
+        self._gc(path.parent, keep, drop)
+
+    def _gc(self, ckpt_dir: Path, keep: List[Dict[str, Any]], drop: List[Dict[str, Any]]) -> None:
+        """Delete pruned entries and sweep orphans of killed saves.
+
+        Concurrent-writer safety (multi-process runs share the checkpoint
+        dir, only global-zero publishes/GCs): retention is applied PER RANK
+        (kept steps cover every rank's file for that step), and the
+        tmp/old/orphan sweep only reclaims artifacts older than
+        ``_ORPHAN_GRACE_SECONDS`` — an in-flight sibling save is never
+        touched, only leftovers of genuinely dead processes."""
+        from sheeprl_tpu.utils.checkpoint import _rm_any
+
+        def _rm_ckpt(base: Path) -> None:
+            for victim in (base, Path(str(base) + ".arrays"), Path(str(base) + ".rb")):
+                _rm_any(victim)
+
+        for e in drop:
+            _rm_ckpt(ckpt_dir / str(e["file"]))
+        if self.keep_last is None:
+            return
+        kept_steps = {int(e.get("step", _parse_step(str(e["file"])) or 0)) for e in keep}
+        by_rank: Dict[str, List[Path]] = {}
+        for p in ckpt_dir.glob("*.ckpt"):
+            m = _CKPT_RE.match(p.name)
+            if m is not None and _verify(p):
+                by_rank.setdefault(m.group(2), []).append(p)
+        for rank_files in by_rank.values():
+            rank_files.sort(key=lambda p: (_parse_step(p.name) or 0, p.stat().st_mtime))
+            for p in rank_files[: -self.keep_last]:
+                if (_parse_step(p.name) or 0) not in kept_steps:
+                    _rm_ckpt(p)
+        now = time.time()
+        for p in ckpt_dir.iterdir():
+            name = p.name
+            try:
+                age = now - p.stat().st_mtime
+            except OSError:  # racing another GC/writer
+                continue
+            if age < _ORPHAN_GRACE_SECONDS:
+                continue
+            if name.endswith(".tmp") or name.endswith(".old"):
+                if name == MANIFEST_NAME + ".tmp":
+                    continue
+                _rm_any(p)
+            elif name.endswith(".arrays") or name.endswith(".rb"):
+                if not (ckpt_dir / name.rsplit(".", 1)[0]).exists():
+                    _rm_any(p)  # sidecar whose meta never committed

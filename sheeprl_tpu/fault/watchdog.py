@@ -1,0 +1,142 @@
+"""Per-env watchdog: bounded retry + replace-on-death for vector workers.
+
+:class:`SelfHealingEnv` wraps one sub-env of a vector env together with the
+thunk that built it. A crash (exception) or hang (``step_timeout`` exceeded)
+is healed by recreating the env from the thunk with exponential backoff; the
+failed ``step`` surfaces as a *truncation* boundary (reward 0, fresh reset
+obs, ``info["env_restarted"]=True``) so rollout loops record a clean episode
+cut instead of crashing the run. Recreation itself is retried ``attempts``
+times; exhausting the budget re-raises the original error — resilience is
+bounded, not unconditional.
+
+The hang watchdog runs the env call on a helper thread and abandons it on
+timeout (a truly wedged C extension cannot be preempted from Python — the
+daemon thread is leaked deliberately and the env object replaced).
+Differs from :class:`~sheeprl_tpu.envs.wrappers.RestartOnException` (time-
+windowed, Dreamer/minedojo semantics with ``done=False``): this wrapper is
+the generic vector-env building block with truncation semantics, timeout
+detection and an externally-shared restart counter for the
+``Fault/env_restarts`` metric.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import gymnasium as gym
+
+__all__ = ["EnvTimeoutError", "SelfHealingEnv"]
+
+
+class EnvTimeoutError(RuntimeError):
+    """An env call exceeded the configured watchdog timeout."""
+
+
+class SelfHealingEnv(gym.Wrapper):
+    def __init__(
+        self,
+        env_fn: Callable[[], gym.Env],
+        attempts: int = 3,
+        backoff: float = 0.5,
+        step_timeout: Optional[float] = None,
+        restart_counter: Optional[List[int]] = None,
+    ) -> None:
+        self._env_fn = env_fn
+        self.attempts = max(1, int(attempts))
+        self.backoff = float(backoff)
+        self.step_timeout = step_timeout if step_timeout and step_timeout > 0 else None
+        self._restart_counter = restart_counter if restart_counter is not None else [0]
+        super().__init__(env_fn())
+
+    @property
+    def restarts(self) -> int:
+        return self._restart_counter[0]
+
+    # -- guarded call ---------------------------------------------------------
+    def _call(self, name: str, *args: Any, **kwargs: Any) -> Any:
+        # step_timeout costs one thread spawn+join per call (~0.1 ms): opt it
+        # in only for envs slow enough to hang (real sims), not µs-step toys
+        fn = getattr(self.env, name)
+        if self.step_timeout is None:
+            return fn(*args, **kwargs)
+        box: dict = {}
+
+        def target() -> None:
+            try:
+                box["value"] = fn(*args, **kwargs)
+            except BaseException as e:  # ferried to the caller thread
+                box["error"] = e
+
+        t = threading.Thread(target=target, name=f"env-watchdog-{name}", daemon=True)
+        t.start()
+        t.join(self.step_timeout)
+        if t.is_alive():
+            # abandon the wedged thread; the env object is replaced by _heal
+            raise EnvTimeoutError(f"env.{name} exceeded {self.step_timeout:g}s watchdog timeout")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def _heal(self, exc: BaseException, phase: str) -> None:
+        """Replace the env via its thunk, with bounded exponential backoff.
+
+        On a TIMEOUT the abandoned watchdog thread may still be executing
+        inside the env — closing it under a live native call can corrupt the
+        process, so the wedged object is deliberately leaked and only
+        cleanly-crashed envs are closed."""
+        if not isinstance(exc, EnvTimeoutError):
+            try:
+                self.env.close()
+            except Exception:  # the dead env owes us nothing
+                pass
+        delay = self.backoff
+        last: BaseException = exc
+        for attempt in range(self.attempts):
+            gym.logger.warn(
+                f"{phase}: env crashed with {type(exc).__name__}: {exc} — "
+                f"recreating (attempt {attempt + 1}/{self.attempts})"
+            )
+            if delay > 0 and attempt > 0:
+                time.sleep(delay)
+                delay *= 2
+            try:
+                self.env = self._env_fn()
+                self._restart_counter[0] += 1
+                return
+            except Exception as rebuild_exc:
+                last = rebuild_exc
+        raise RuntimeError(
+            f"{phase}: env could not be recreated after {self.attempts} attempts"
+        ) from last
+
+    def _reset_healed(self, phase: str, **kwargs: Any):
+        """Reset the freshly recreated env, still under the watchdog: a
+        replacement that hangs/crashes on its first reset is healed again,
+        bounded by the same attempt budget."""
+        for _ in range(self.attempts):
+            try:
+                return self._call("reset", **kwargs)
+            except Exception as exc:
+                self._heal(exc, phase)
+        return self._call("reset", **kwargs)
+
+    # -- gym surface ----------------------------------------------------------
+    def step(self, action):
+        try:
+            return self._call("step", action)
+        except Exception as exc:
+            self._heal(exc, "STEP")
+            obs, info = self._reset_healed("STEP-RESET")
+            # surface the crash as a truncation boundary: the episode the
+            # action belonged to is gone, the returned obs starts a fresh one
+            return obs, 0.0, False, True, {**info, "env_restarted": True}
+
+    def reset(self, *, seed=None, options=None):
+        try:
+            return self._call("reset", seed=seed, options=options)
+        except Exception as exc:
+            self._heal(exc, "RESET")
+            obs, info = self._reset_healed("RESET", seed=seed, options=options)
+            return obs, {**info, "env_restarted": True}

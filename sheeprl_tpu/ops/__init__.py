@@ -6,5 +6,15 @@ from sheeprl_tpu.ops.core import (
     two_hot_decoder,
     two_hot_encoder,
 )
+from sheeprl_tpu.ops.guard import finite_guard, guarded_select
 
-__all__ = ["gae", "lambda_returns", "symlog", "symexp", "two_hot_encoder", "two_hot_decoder"]
+__all__ = [
+    "gae",
+    "lambda_returns",
+    "symlog",
+    "symexp",
+    "two_hot_encoder",
+    "two_hot_decoder",
+    "finite_guard",
+    "guarded_select",
+]

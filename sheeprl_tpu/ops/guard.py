@@ -1,0 +1,37 @@
+"""Jittable NaN/Inf sentinels for loss/gradient pytrees.
+
+``finite_guard`` reduces a pytree to one boolean scalar ("every floating
+leaf is finite") with a tree of cheap ``isfinite().all()`` reductions — no
+host sync, safe inside ``lax.scan``/``shard_map``. ``guarded_select``
+chooses between the updated and the previous train-state pytrees on that
+predicate, turning a poisoned minibatch into an in-graph no-op update whose
+occurrence is ferried out as a counter instead of propagating NaNs into the
+parameters (Podracer-style fused blocks cannot host-check per minibatch —
+the check must ride inside the program).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["finite_guard", "guarded_select"]
+
+
+def finite_guard(tree: Any) -> jnp.ndarray:
+    """Boolean scalar: True iff every floating-point leaf of ``tree`` is
+    finite (no NaN/Inf). Non-float leaves (ints, bools) are ignored."""
+    ok = jnp.bool_(True)
+    for leaf in jax.tree.leaves(tree):
+        x = jnp.asarray(leaf)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.isfinite(x).all())
+    return ok
+
+
+def guarded_select(ok: jnp.ndarray, new: Any, old: Any) -> Any:
+    """Pick ``new`` where ``ok`` else ``old``, leaf-wise over matching
+    pytrees (the skip-update primitive of the divergence sentinel)."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)

@@ -22,8 +22,24 @@ __all__ = ["CheckpointCallback"]
 
 
 class CheckpointCallback:
-    def __init__(self, keep_last: Optional[int] = None) -> None:
+    """``manager`` (a :class:`sheeprl_tpu.fault.CheckpointManager`) upgrades
+    plain atomic saves to manifest-published, retention-managed, optionally
+    asynchronous ones; without it the standalone (still atomic) ``save_state``
+    + mtime-based pruning path is used. The async mode is safe with the
+    buffer truncation patching below because the manager snapshots (pickles)
+    the buffer before returning from ``save``."""
+
+    def __init__(self, keep_last: Optional[int] = None, manager: Optional[Any] = None) -> None:
         self.keep_last = keep_last
+        self.manager = manager
+
+    def _save(self, fabric, ckpt_path: str, state: Dict[str, Any]) -> None:
+        if self.manager is not None:
+            self.manager.save(ckpt_path, state, publish=fabric.is_global_zero)
+        else:
+            save_state(ckpt_path, state)
+            if fabric.is_global_zero and self.keep_last:
+                self._delete_old_checkpoints(pathlib.Path(ckpt_path).parent)
 
     def on_checkpoint_coupled(
         self,
@@ -36,11 +52,9 @@ class CheckpointCallback:
         if replay_buffer is not None:
             rb_state = self._ckpt_rb(replay_buffer)
             state["rb"] = replay_buffer
-        save_state(ckpt_path, state)
+        self._save(fabric, ckpt_path, state)
         if replay_buffer is not None:
             self._experiment_consistent_rb(replay_buffer, rb_state)
-        if fabric.is_global_zero and self.keep_last:
-            self._delete_old_checkpoints(pathlib.Path(ckpt_path).parent)
 
     def on_checkpoint_player(
         self,
@@ -58,14 +72,15 @@ class CheckpointCallback:
             state["rb"] = replay_buffer
         if ratio_state_dict is not None:
             state["ratio"] = ratio_state_dict
-        save_state(ckpt_path, state)
+        self._save(fabric, ckpt_path, state)
         if replay_buffer is not None:
             self._experiment_consistent_rb(replay_buffer, rb_state)
-        if fabric.is_global_zero and self.keep_last:
-            self._delete_old_checkpoints(pathlib.Path(ckpt_path).parent)
 
     def on_checkpoint_trainer(self, fabric, state: Dict[str, Any], ckpt_path: str) -> None:
-        save_state(ckpt_path, state)
+        if self.manager is not None:
+            self.manager.save(ckpt_path, state, publish=fabric.is_global_zero)
+        else:
+            save_state(ckpt_path, state)
 
     # -- buffer truncation patching (reference: callback.py:87-142) ----------
     def _ckpt_rb(self, rb) -> Any:

@@ -17,32 +17,61 @@ blob (VERDICT weak #5). The format is now three-part:
   touches it (buffers are attached under ``state["rb"]`` lazily).
 
 ``load_state`` transparently reads the round-1 single-pickle format too.
+
+Crash safety: every piece is staged on a ``*.tmp`` sibling, fsynced, and
+published with ``rename``/``os.replace`` — sidecars first, the meta pickle
+last. The meta file is the commit point: a SIGKILL at any instant leaves
+either the previous checkpoint fully intact (meta not yet replaced) or the
+new one fully published; the live ``.arrays`` dir is never rmtree'd before
+its replacement exists. :class:`sheeprl_tpu.fault.manager.CheckpointManager`
+builds a manifest + retention + async saving on top of these primitives and
+avoids even the brief old-meta/new-arrays window by giving every step its
+own path.
+
+IO failures surface as :class:`CheckpointError` carrying the offending path,
+so resume logic can fall back to an older manifest entry instead of dying on
+a bare ``FileNotFoundError``/``UnpicklingError``.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
+import shutil
 from pathlib import Path
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state"]
+__all__ = ["CheckpointError", "save_state", "load_state", "write_host_checkpoint"]
 
 _FORMAT_KEY = "__sheeprl_tpu_ckpt__"
+_TOKEN_KEY = "__token__"
 _ARRAYS_SUFFIX = ".arrays"
 _RB_SUFFIX = ".rb"
+_TMP_SUFFIX = ".tmp"
+_OLD_SUFFIX = ".old"
+_TOKEN_LEN = 16
 
 
-def _to_host(tree: Any) -> Any:
-    """Convert any jax arrays in a pytree (incl. inside lists/dicts) to numpy.
+class CheckpointError(RuntimeError):
+    """A checkpoint file/sidecar is missing, truncated or unreadable."""
 
-    The device→host pulls are issued for every leaf up front (``device_put``
-    to the host CPU device is asynchronous) and synchronized once: a remote
-    accelerator charges a full round-trip per *blocking* pull, so pulling a
-    few hundred leaves one-by-one costs minutes where one pipelined batch
-    costs a round-trip plus the transfer bytes."""
+    def __init__(self, message: str, path: "str | Path | None" = None) -> None:
+        super().__init__(message)
+        self.path = Path(path) if path is not None else None
+
+
+def stage_to_host(tree: Any) -> Any:
+    """Enqueue device→host pulls for every jax leaf WITHOUT blocking.
+
+    The pulls are issued up front (``device_put`` to the host CPU device is
+    asynchronous) so a remote accelerator pays one pipelined batch instead of
+    a full round-trip per leaf; :func:`finalize_host` synchronizes. The async
+    checkpoint path calls this on the training thread and finalizes on the
+    writer thread, overlapping the transfer + serialization with the next
+    train block."""
     cpu = jax.devices("cpu")[0]
 
     def pull(x):
@@ -50,7 +79,11 @@ def _to_host(tree: Any) -> Any:
             return jax.device_put(x, cpu)
         return x
 
-    staged = jax.tree.map(pull, tree)
+    return jax.tree.map(pull, tree)
+
+
+def finalize_host(staged: Any) -> Any:
+    """Block on the staged pulls and materialize numpy leaves."""
     jax.block_until_ready([x for x in jax.tree.leaves(staged) if isinstance(x, jax.Array)])
 
     def leaf(x):
@@ -61,64 +94,221 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(leaf, staged)
 
 
+def _to_host(tree: Any) -> Any:
+    """Convert any jax arrays in a pytree (incl. inside lists/dicts) to numpy."""
+    return finalize_host(stage_to_host(tree))
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.PyTreeCheckpointer()
 
 
-def save_state(path: str | Path, state: Dict[str, Any]) -> None:
+def _fsync_path(path: Path) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform-dependent (e.g. dirs on win)
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_bytes_atomic_stage(tmp: Path, payload: bytes) -> None:
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _rm_any(path: Path) -> None:
+    if path.is_dir():
+        shutil.rmtree(path, ignore_errors=True)
+    elif path.exists():
+        try:
+            path.unlink()
+        except OSError:  # pragma: no cover - racing GC
+            pass
+
+
+def write_host_checkpoint(path: "str | Path", host_state: Dict[str, Any], rb_bytes: Optional[bytes] = None) -> None:
+    """Atomically write an already-host-resident state pytree (no jax arrays).
+
+    Stages ``<path>.arrays.tmp`` / ``<path>.rb.tmp`` / ``<path>.tmp``, fsyncs,
+    then publishes sidecars before replacing the meta pickle (the commit
+    point). Same-path overwrites are torn-write-proof beyond the commit
+    ordering: every save mints a random token recorded in the meta AND in the
+    sidecars (an extra ``__token__`` orbax leaf; a 16-byte ``.rb`` header),
+    and the previous sidecars survive as ``.old`` until after the meta
+    commit — so a SIGKILL between sidecar-publish and meta-commit leaves the
+    old meta whose token still resolves against the ``.old`` copies.
+    :func:`load_state` performs that resolution transparently.
+    Fault-injection probes (:func:`sheeprl_tpu.fault.inject.fault_point`)
+    mark the interesting kill windows so recovery is testable."""
+    from sheeprl_tpu.fault.inject import fault_point
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
 
-    state = dict(state)
-    replay_buffer = state.pop("rb", None)
-
-    host_state = _to_host(state)
     leaves, treedef = jax.tree.flatten(host_state)
     array_slots = [i for i, leaf in enumerate(leaves) if isinstance(leaf, np.ndarray)]
     arrays = {str(i): leaves[i] for i in array_slots}
     skeleton = [None if i in set(array_slots) else leaf for i, leaf in enumerate(leaves)]
+    token = os.urandom(16)
+    if arrays:
+        arrays[_TOKEN_KEY] = np.frombuffer(token, dtype=np.uint8)
 
     arrays_dir = Path(str(path) + _ARRAYS_SUFFIX)
+    arrays_tmp = Path(str(arrays_dir) + _TMP_SUFFIX)
+    arrays_old = Path(str(arrays_dir) + _OLD_SUFFIX)
+    rb_path = Path(str(path) + _RB_SUFFIX)
+    rb_tmp = Path(str(rb_path) + _TMP_SUFFIX)
+    rb_old = Path(str(rb_path) + _OLD_SUFFIX)
+    meta_tmp = Path(str(path) + _TMP_SUFFIX)
+
+    # drop stale STAGING leftovers from a previously killed save. The .old
+    # grace copies are NOT touched here: if the previous save died between
+    # sidecar-publish and meta-commit, the committed meta still resolves
+    # against them — they go only at publish/post-commit below.
+    for stale in (arrays_tmp, rb_tmp, meta_tmp):
+        _rm_any(stale)
+
+    # -- stage -------------------------------------------------------------
     if arrays:
-        import shutil
-
-        if arrays_dir.exists():
-            shutil.rmtree(arrays_dir)
-        _checkpointer().save(arrays_dir.absolute(), arrays)
-
+        _checkpointer().save(arrays_tmp.absolute(), arrays)
+    if rb_bytes is not None:
+        _write_bytes_atomic_stage(rb_tmp, token + rb_bytes)
     meta = {
         _FORMAT_KEY: 2,
         "treedef": treedef,
         "skeleton": skeleton,
         "array_slots": array_slots,
-        "has_rb": replay_buffer is not None,
+        "has_rb": rb_bytes is not None,
+        "token": token,
     }
-    with open(path, "wb") as f:
-        pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+    _write_bytes_atomic_stage(meta_tmp, pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL))
+    fault_point("checkpoint.staged")
 
-    if replay_buffer is not None:
-        with open(str(path) + _RB_SUFFIX, "wb") as f:
-            pickle.dump(replay_buffer, f, protocol=pickle.HIGHEST_PROTOCOL)
+    # -- publish: sidecars first (previous ones parked on .old), meta last.
+    # A surviving .old means the PREVIOUS save was torn: the committed meta
+    # matches .old (a completed save would have deleted it), so the current
+    # live sidecar is unreferenced garbage — drop it and keep .old parked
+    # until this save's commit.
+    if arrays:
+        if arrays_old.exists():
+            _rm_any(arrays_dir)
+        if arrays_dir.exists():
+            arrays_dir.rename(arrays_old)
+        arrays_tmp.rename(arrays_dir)
+    if rb_bytes is not None:
+        if rb_old.exists():
+            _rm_any(rb_path)
+        if rb_path.exists():
+            rb_path.rename(rb_old)
+        rb_tmp.rename(rb_path)
+    fault_point("checkpoint.pre_commit")
+    os.replace(meta_tmp, path)  # the commit point
+    _fsync_path(path.parent)
+    fault_point("checkpoint.post_commit")
+
+    # committed: the .old grace copies and any stale sidecars can go
+    for stale in (arrays_old, rb_old):
+        _rm_any(stale)
+    if not arrays and arrays_dir.exists():
+        _rm_any(arrays_dir)
+    if rb_bytes is None and rb_path.exists():
+        _rm_any(rb_path)
 
 
-def load_state(path: str | Path) -> Dict[str, Any]:
+def save_state(path: "str | Path", state: Dict[str, Any]) -> None:
+    state = dict(state)
+    replay_buffer = state.pop("rb", None)
+    rb_bytes = (
+        pickle.dumps(replay_buffer, protocol=pickle.HIGHEST_PROTOCOL) if replay_buffer is not None else None
+    )
+    write_host_checkpoint(path, _to_host(state), rb_bytes)
+
+
+def load_state(path: "str | Path") -> Dict[str, Any]:
     path = Path(path)
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
+    if not path.exists():
+        raise CheckpointError(f"Checkpoint meta file does not exist: {path}", path)
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except Exception as e:
+        raise CheckpointError(f"Unreadable/truncated checkpoint meta {path}: {type(e).__name__}: {e}", path) from e
 
     if not (isinstance(payload, dict) and payload.get(_FORMAT_KEY) == 2):
         return payload  # round-1 single-pickle checkpoints
 
+    token = payload.get("token")
     leaves = list(payload["skeleton"])
     if payload["array_slots"]:
-        arrays = _checkpointer().restore(Path(str(path) + _ARRAYS_SUFFIX).absolute())
+        arrays = _restore_arrays(path, token)
+        arrays_dir = Path(str(path) + _ARRAYS_SUFFIX)
         for i in payload["array_slots"]:
+            if str(i) not in arrays:
+                raise CheckpointError(f"Checkpoint arrays sidecar {arrays_dir} is missing slot {i}", arrays_dir)
             leaves[i] = arrays[str(i)]
     state = jax.tree.unflatten(payload["treedef"], leaves)
 
     if payload.get("has_rb"):
-        with open(str(path) + _RB_SUFFIX, "rb") as f:
-            state["rb"] = pickle.load(f)
+        state["rb"] = _restore_rb(path, token)
     return state
+
+
+def _token_matches(arrays: Dict[str, Any], token: Optional[bytes]) -> bool:
+    if token is None:
+        return True  # checkpoint written before save tokens existed
+    got = arrays.get(_TOKEN_KEY)
+    return got is not None and np.asarray(got, dtype=np.uint8).tobytes() == token
+
+
+def _restore_arrays(path: Path, token: Optional[bytes]) -> Dict[str, Any]:
+    """Restore the arrays sidecar whose save token matches the meta, looking
+    at ``.arrays`` then the ``.arrays.old`` grace copy (present only when a
+    same-path overwrite was killed between sidecar-publish and meta-commit)."""
+    arrays_dir = Path(str(path) + _ARRAYS_SUFFIX)
+    candidates = [arrays_dir, Path(str(arrays_dir) + _OLD_SUFFIX)]
+    last_error: Optional[str] = None
+    for cand in candidates:
+        if not cand.is_dir():
+            if cand is arrays_dir:
+                last_error = f"Checkpoint arrays sidecar is missing: {cand}"
+            continue
+        try:
+            arrays = _checkpointer().restore(cand.absolute())
+        except Exception as e:
+            last_error = f"Corrupted checkpoint arrays sidecar {cand}: {type(e).__name__}: {e}"
+            continue
+        if _token_matches(arrays, token):
+            return arrays
+        last_error = f"Checkpoint arrays sidecar {cand} belongs to a different (torn) save"
+    raise CheckpointError(last_error or f"Checkpoint arrays sidecar is missing: {arrays_dir}", arrays_dir)
+
+
+def _restore_rb(path: Path, token: Optional[bytes]) -> Any:
+    rb_path = Path(str(path) + _RB_SUFFIX)
+    candidates = [rb_path, Path(str(rb_path) + _OLD_SUFFIX)]
+    last_error: Optional[str] = None
+    for cand in candidates:
+        if not cand.exists():
+            if cand is rb_path:
+                last_error = f"Checkpoint replay-buffer sidecar is missing: {cand}"
+            continue
+        try:
+            with open(cand, "rb") as f:
+                if token is not None:
+                    header = f.read(_TOKEN_LEN)
+                    if header != token:
+                        last_error = f"Replay-buffer sidecar {cand} belongs to a different (torn) save"
+                        continue
+                return pickle.load(f)
+        except Exception as e:
+            last_error = f"Unreadable/truncated replay-buffer sidecar {cand}: {type(e).__name__}: {e}"
+    raise CheckpointError(last_error or f"Checkpoint replay-buffer sidecar is missing: {rb_path}", rb_path)

@@ -1,0 +1,28 @@
+"""Shared fixtures for the fault-injection suite."""
+
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.fault import inject
+
+
+@pytest.fixture(autouse=True)
+def _inject_isolation():
+    """Armed fault points and hit counters never leak across tests."""
+    inject.reset()
+    yield
+    inject.reset()
+
+
+@pytest.fixture()
+def tiny_state():
+    """A minimal checkpoint-state builder (arrays + scalars + None)."""
+
+    def build(value: float = 1.0, iter_num: int = 1):
+        return {
+            "agent": {"w": jnp.full((3,), value), "b": jnp.zeros(2)},
+            "scheduler": None,
+            "iter_num": iter_num,
+        }
+
+    return build

@@ -1,0 +1,115 @@
+"""Atomic save semantics: a save killed at ANY stage never destroys the
+previous checkpoint, and load errors are typed + carry the offending path."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.utils.checkpoint import CheckpointError, load_state, save_state
+
+
+@pytest.mark.parametrize("point", ["checkpoint.staged", "checkpoint.pre_commit"])
+def test_save_aborted_mid_write_keeps_previous_checkpoint(tmp_path, tiny_state, point):
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, tiny_state(value=1.0, iter_num=1))
+
+    inject.arm(point, action="raise", at=1)
+    with pytest.raises(inject.FaultInjected):
+        save_state(path, tiny_state(value=9.0, iter_num=2))
+    inject.reset()
+
+    # pre-commit abort == the old checkpoint is fully intact
+    loaded = load_state(path)
+    assert loaded["iter_num"] == 1
+    np.testing.assert_array_equal(np.asarray(loaded["agent"]["w"]), np.ones(3))
+
+    # the next save sweeps the stale staging leftovers and goes through
+    save_state(path, tiny_state(value=5.0, iter_num=3))
+    assert load_state(path)["iter_num"] == 3
+    assert not list(tmp_path.glob("*.tmp")) and not list(tmp_path.glob("*.old"))
+
+
+def test_save_never_rmtrees_live_arrays_before_replacement(tmp_path, tiny_state):
+    """The historical bug: rmtree of the live ``.arrays`` dir before the new
+    one exists. An abort between staging and publish must leave it whole."""
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, tiny_state(value=2.0))
+    arrays_dir = tmp_path / "ckpt_8_0.ckpt.arrays"
+    assert arrays_dir.is_dir()
+
+    inject.arm("checkpoint.staged", action="raise", at=1)
+    with pytest.raises(inject.FaultInjected):
+        save_state(path, tiny_state(value=3.0))
+    assert arrays_dir.is_dir()
+    np.testing.assert_array_equal(np.asarray(load_state(path)["agent"]["w"]), np.full(3, 2.0))
+
+
+def test_two_consecutive_torn_saves_keep_committed_checkpoint_loadable(tmp_path, tiny_state):
+    """A save killed between sidecar-publish and meta-commit leaves the
+    committed meta resolving against the .old grace copy; a FOLLOW-UP save
+    killed mid-staging must not destroy that copy."""
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, tiny_state(value=1.0, iter_num=1))
+
+    inject.arm("checkpoint.pre_commit", action="raise", at=1)
+    with pytest.raises(inject.FaultInjected):
+        save_state(path, tiny_state(value=2.0, iter_num=2))
+    inject.reset()
+    assert load_state(path)["iter_num"] == 1  # resolves via .arrays.old
+
+    inject.arm("checkpoint.staged", action="raise", at=1)
+    with pytest.raises(inject.FaultInjected):
+        save_state(path, tiny_state(value=3.0, iter_num=3))
+    inject.reset()
+    loaded = load_state(path)
+    assert loaded["iter_num"] == 1
+    np.testing.assert_array_equal(np.asarray(loaded["agent"]["w"]), np.ones(3))
+
+    # and a clean save fully recovers the path
+    save_state(path, tiny_state(value=4.0, iter_num=4))
+    assert load_state(path)["iter_num"] == 4
+    assert not list(tmp_path.glob("*.old"))
+
+
+def test_load_missing_meta_raises_checkpoint_error(tmp_path):
+    missing = tmp_path / "nope.ckpt"
+    with pytest.raises(CheckpointError) as exc:
+        load_state(missing)
+    assert exc.value.path == missing
+
+
+def test_load_truncated_meta_raises_checkpoint_error(tmp_path, tiny_state):
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, tiny_state())
+    inject.truncate_file(path, keep_bytes=4)
+    with pytest.raises(CheckpointError, match="truncated"):
+        load_state(path)
+
+
+def test_load_missing_arrays_sidecar_raises_checkpoint_error(tmp_path, tiny_state):
+    import shutil
+
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, tiny_state())
+    shutil.rmtree(tmp_path / "ckpt_8_0.ckpt.arrays")
+    with pytest.raises(CheckpointError, match="arrays sidecar"):
+        load_state(path)
+
+
+def test_load_missing_rb_sidecar_raises_checkpoint_error(tmp_path):
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(4, 1, obs_keys=("state",))
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, {"iter_num": 1, "rb": rb})
+    (tmp_path / "ckpt_8_0.ckpt.rb").unlink()
+    with pytest.raises(CheckpointError, match="replay-buffer sidecar"):
+        load_state(path)
+
+
+def test_scrambled_meta_raises_checkpoint_error(tmp_path, tiny_state):
+    path = tmp_path / "ckpt_8_0.ckpt"
+    save_state(path, tiny_state())
+    inject.scramble_file(path)
+    with pytest.raises(CheckpointError):
+        load_state(path)

@@ -1,0 +1,98 @@
+"""Self-healing vector env: crash retry with thunk recreation, hang
+watchdog, bounded attempts, env_restarts metric."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+from sheeprl_tpu.envs.vector import FastSyncVectorEnv
+from sheeprl_tpu.fault.inject import FlakyEnv
+from sheeprl_tpu.fault.watchdog import SelfHealingEnv
+
+
+def _flaky_thunks(n_envs, fuse, fail_on="step", mode="raise", hang_seconds=60.0):
+    def make(i):
+        def thunk():
+            return FlakyEnv(DiscreteDummyEnv(), fuse, fail_on=fail_on, mode=mode, hang_seconds=hang_seconds)
+
+        return thunk
+
+    return [make(i) for i in range(n_envs)]
+
+
+def test_step_crash_heals_and_surfaces_truncation():
+    fuse = [1]  # exactly one injected failure across all instances
+    envs = FastSyncVectorEnv(_flaky_thunks(2, fuse), restart_attempts=2, restart_backoff=0.0)
+    envs.reset(seed=1)
+    for _ in range(4):
+        obs, rewards, term, trunc, infos = envs.step(np.zeros(2, dtype=np.int64))
+    assert envs.env_restarts == 1
+    assert fuse[0] == 0
+    # training continues: further steps are healthy
+    obs, rewards, term, trunc, infos = envs.step(np.zeros(2, dtype=np.int64))
+    assert obs["state"].shape[0] == 2
+    envs.close()
+
+
+def test_reset_crash_heals():
+    fuse = [1]
+    envs = FastSyncVectorEnv(_flaky_thunks(2, fuse, fail_on="reset"), restart_attempts=2, restart_backoff=0.0)
+    obs, infos = envs.reset(seed=1)
+    assert envs.env_restarts == 1
+    assert obs["state"].shape[0] == 2
+    envs.close()
+
+
+def test_attempt_budget_exhaustion_raises():
+    calls = {"n": 0}
+
+    def dead_thunk():
+        calls["n"] += 1
+        if calls["n"] > 1:  # first build OK, every recreation fails
+            raise RuntimeError("factory down")
+        return DiscreteDummyEnv()
+
+    env = SelfHealingEnv(dead_thunk, attempts=2, backoff=0.0)
+    env.reset(seed=0)
+    env.env.step = lambda a: (_ for _ in ()).throw(RuntimeError("boom"))
+    with pytest.raises(RuntimeError, match="could not be recreated after 2 attempts"):
+        env.step(0)
+
+
+def test_hang_watchdog_times_out_and_heals():
+    fuse = [1]
+    env = SelfHealingEnv(
+        lambda: FlakyEnv(DiscreteDummyEnv(), fuse, fail_on="step", mode="hang", hang_seconds=30.0),
+        attempts=2,
+        backoff=0.0,
+        step_timeout=0.2,
+    )
+    env.reset(seed=0)
+    obs, reward, terminated, truncated, info = env.step(0)
+    assert truncated and info.get("env_restarted")
+    assert env.restarts == 1
+    # healed env steps normally within the timeout
+    obs, reward, terminated, truncated, info = env.step(0)
+    assert not info.get("env_restarted")
+
+
+def test_factory_plumbs_restart_config(tmp_path):
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.envs.factory import vectorize_env
+
+    cfg = compose(
+        [
+            "exp=ppo", "env=dummy", "env.id=discrete_dummy", "env.num_envs=2", "env.sync_env=True",
+            "env.capture_video=False", "env.restart_attempts=3", "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    envs = vectorize_env(cfg, seed=0, rank=0)
+    assert isinstance(envs.envs[0], SelfHealingEnv)
+    assert envs.env_restarts == 0
+    envs.close()
+
+    cfg.env.restart_attempts = 0
+    cfg.env.step_timeout = None
+    envs = vectorize_env(cfg, seed=0, rank=0)
+    assert not isinstance(envs.envs[0], SelfHealingEnv)
+    envs.close()

@@ -1,0 +1,91 @@
+"""Acceptance e2e: a short PPO run is SIGKILLed mid-checkpoint-save in a real
+subprocess, then relaunched with ``checkpoint.resume_from=latest`` — training
+completes with monotonically continuing step counters and no
+corrupted-checkpoint errors."""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+import sheeprl_tpu
+
+pytestmark = pytest.mark.fault
+
+REPO_ROOT = str(Path(sheeprl_tpu.__file__).parents[1])
+
+BASE_ARGS = [
+    "exp=ppo", "env=dummy", "env.id=discrete_dummy", "env.num_envs=2", "env.sync_env=True",
+    "env.capture_video=False", "buffer.memmap=False", "fabric.devices=1", "metric.log_level=0",
+    "algo.rollout_steps=4", "algo.per_rank_batch_size=4", "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]", "algo.total_steps=48", "checkpoint.every=8",
+    "algo.run_test=False", "seed=11", "log_root=logs",
+]
+
+
+def _launch(tmp_path, extra_args=(), extra_env=None):
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("SHEEPRL_FAULT_KILL", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", *BASE_ARGS, *extra_args],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+
+
+def test_sigkill_mid_save_then_resume_from_latest_completes(tmp_path):
+    # -- run 1: SIGKILL inside the 3rd checkpoint save, after the sidecars
+    # are published but before the meta commit (the nastiest window)
+    proc = _launch(tmp_path, extra_env={"SHEEPRL_FAULT_KILL": "checkpoint.pre_commit:3"})
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    ckpt_dirs = glob.glob(str(tmp_path / "logs/ppo/discrete_dummy/*/version_*/checkpoint"))
+    assert len(ckpt_dirs) == 1
+    committed = sorted(glob.glob(os.path.join(ckpt_dirs[0], "*.ckpt")))
+    # two committed checkpoints (steps 8, 16); the third save died mid-publish
+    assert [os.path.basename(p) for p in committed] == ["ckpt_16_0.ckpt", "ckpt_8_0.ckpt"]
+    leftovers = glob.glob(os.path.join(ckpt_dirs[0], "*.tmp")) + glob.glob(os.path.join(ckpt_dirs[0], "*24*"))
+    assert leftovers, "the kill should have left torn artifacts of the 3rd save"
+
+    from sheeprl_tpu.fault.manager import latest_complete, read_manifest
+
+    assert latest_complete(ckpt_dirs[0]).name == "ckpt_16_0.ckpt"
+    assert [e["step"] for e in read_manifest(ckpt_dirs[0])] == [8, 16]
+
+    # -- run 2: auto-resume; must complete without corrupted-checkpoint errors
+    proc2 = _launch(tmp_path, extra_args=["checkpoint.resume_from=latest"])
+    assert proc2.returncode == 0, (proc2.stdout[-2000:], proc2.stderr[-2000:])
+    assert "checkpoint.resume_from=latest ->" in proc2.stdout
+    assert "ckpt_16_0.ckpt" in proc2.stdout
+
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    final = find_latest_run_checkpoint(tmp_path / "logs/ppo/discrete_dummy")
+    state = load_state(final)
+    # counters continued monotonically past the kill point to the end
+    assert state["iter_num"] == 6  # 48 total steps / 8 per iter
+    assert int(os.path.basename(str(final)).split("_")[1]) == 48
+    assert state.get("rng") is not None
+    for leaf in jax.tree.leaves(state["agent"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    # the resumed run's checkpoint steps all land AFTER the resume point
+    run2_dirs = [d for d in glob.glob(str(tmp_path / "logs/ppo/discrete_dummy/*/version_*/checkpoint")) if d != ckpt_dirs[0]]
+    assert len(run2_dirs) == 1
+    run2_steps = [e["step"] for e in read_manifest(run2_dirs[0])]
+    assert run2_steps and run2_steps == sorted(run2_steps) and min(run2_steps) > 16

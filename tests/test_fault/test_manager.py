@@ -1,0 +1,177 @@
+"""CheckpointManager: manifest publication, retention GC, orphan sweep,
+corrupted-entry fallback, latest discovery and async save."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.fault.manager import (
+    CheckpointManager,
+    find_latest_run_checkpoint,
+    latest_complete,
+    load_resume_state,
+    read_manifest,
+)
+from sheeprl_tpu.utils.checkpoint import CheckpointError, load_state, save_state
+
+
+def _save_steps(d, steps, keep_last=None, async_save=False):
+    m = CheckpointManager(keep_last=keep_last, async_save=async_save)
+    for s in steps:
+        m.save(d / f"ckpt_{s}_0.ckpt", {"agent": {"w": jnp.full(3, float(s))}, "iter_num": s}, step=s)
+    m.close()
+    return m
+
+
+def test_manifest_records_completed_saves(tmp_path, tiny_state):
+    _save_steps(tmp_path, [8, 16])
+    entries = read_manifest(tmp_path)
+    assert [e["step"] for e in entries] == [8, 16]
+    for e in entries:
+        assert e["format_version"] == 2 and e["digest"] and e["time"] > 0
+
+
+def test_keep_last_retention_and_orphan_gc(tmp_path):
+    import time as _time
+
+    # stray leftovers of a killed save: sidecar without meta + tmp litter.
+    # Backdated past the orphan grace window — FRESH tmp/old artifacts are
+    # deliberately left alone (they may belong to an in-flight sibling save).
+    (tmp_path / "ckpt_99_0.ckpt.arrays").mkdir(parents=True)
+    (tmp_path / "ckpt_99_0.ckpt.tmp").write_bytes(b"torn")
+    stale = _time.time() - 3600
+    for p in (tmp_path / "ckpt_99_0.ckpt.arrays", tmp_path / "ckpt_99_0.ckpt.tmp"):
+        os.utime(p, (stale, stale))
+    _save_steps(tmp_path, [8, 16, 24, 32, 40], keep_last=2)
+
+    assert [e["step"] for e in read_manifest(tmp_path)] == [32, 40]
+    kept = sorted(p.name for p in tmp_path.glob("*.ckpt"))
+    assert kept == ["ckpt_32_0.ckpt", "ckpt_40_0.ckpt"]
+    assert not (tmp_path / "ckpt_8_0.ckpt.arrays").exists()
+    assert not (tmp_path / "ckpt_99_0.ckpt.arrays").exists()
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_latest_complete_skips_half_written_and_corrupt(tmp_path):
+    _save_steps(tmp_path, [8, 16])
+    # half-written newer step: arrays dir + staged meta, never committed
+    (tmp_path / "ckpt_24_0.ckpt.arrays").mkdir()
+    (tmp_path / "ckpt_24_0.ckpt.tmp").write_bytes(b"torn")
+    assert latest_complete(tmp_path).name == "ckpt_16_0.ckpt"
+
+    # corrupt the newest committed meta: discovery falls back to step 8
+    inject.truncate_file(tmp_path / "ckpt_16_0.ckpt", keep_bytes=4)
+    assert latest_complete(tmp_path).name == "ckpt_8_0.ckpt"
+
+
+def test_corrupted_manifest_falls_back_to_scan(tmp_path):
+    _save_steps(tmp_path, [8, 16])
+    (tmp_path / "manifest.json").write_text("{ not json !")
+    with pytest.warns(UserWarning, match="corrupted checkpoint manifest"):
+        assert read_manifest(tmp_path) == []
+    assert latest_complete(tmp_path).name == "ckpt_16_0.ckpt"
+    # binary (non-UTF8) corruption falls back the same way
+    (tmp_path / "manifest.json").write_bytes(b"\xff\xfe\x00garbage\x9c")
+    with pytest.warns(UserWarning, match="corrupted checkpoint manifest"):
+        assert read_manifest(tmp_path) == []
+    assert latest_complete(tmp_path).name == "ckpt_16_0.ckpt"
+
+
+def test_manifest_digest_mismatch_excludes_entry(tmp_path):
+    _save_steps(tmp_path, [8, 16])
+    # flip the newest entry's recorded digest: discovery must not trust it
+    entries = read_manifest(tmp_path)
+    entries[-1]["digest"] = "0" * 64
+    import json as _json
+
+    (tmp_path / "manifest.json").write_text(_json.dumps({"version": 1, "entries": entries}))
+    # the bare-file scan would still accept it, but only because the file
+    # itself is intact — the manifest-trusted path must reject first;
+    # delete the file's scan eligibility by checking the manifest set only
+    from sheeprl_tpu.fault.manager import _complete_entries
+
+    manifest_paths = {p.name for _, _, p in _complete_entries(tmp_path)}
+    assert "ckpt_16_0.ckpt" in manifest_paths  # rescued by the scan (file is fine)
+    inject.truncate_file(tmp_path / "ckpt_16_0.ckpt", keep_bytes=4)
+    assert latest_complete(tmp_path).name == "ckpt_8_0.ckpt"
+
+
+def test_load_resume_state_falls_back_to_previous_entry(tmp_path):
+    _save_steps(tmp_path, [8, 16, 24])
+    inject.scramble_file(tmp_path / "ckpt_24_0.ckpt")
+    with pytest.warns(UserWarning, match="resuming from older complete entry"):
+        state = load_resume_state(tmp_path / "ckpt_24_0.ckpt")
+    assert state["iter_num"] == 16
+
+
+def test_load_resume_state_never_jumps_forward(tmp_path):
+    """An explicitly requested OLDER checkpoint that is corrupt must fall
+    back further back in time, never silently forward to a newer step."""
+    _save_steps(tmp_path, [8, 16, 24])
+    inject.scramble_file(tmp_path / "ckpt_16_0.ckpt")
+    with pytest.warns(UserWarning, match="resuming from older complete entry"):
+        state = load_resume_state(tmp_path / "ckpt_16_0.ckpt")
+    assert state["iter_num"] == 8  # not 24
+
+
+def test_load_resume_state_raises_when_nothing_complete(tmp_path):
+    save_state(tmp_path / "ckpt_8_0.ckpt", {"iter_num": 1, "agent": {"w": jnp.ones(2)}})
+    inject.scramble_file(tmp_path / "ckpt_8_0.ckpt")
+    with pytest.raises(CheckpointError):
+        load_resume_state(tmp_path / "ckpt_8_0.ckpt")
+
+
+def test_find_latest_run_checkpoint_across_runs(tmp_path):
+    a = tmp_path / "run_a" / "version_0" / "checkpoint"
+    b = tmp_path / "run_b" / "version_0" / "checkpoint"
+    a.mkdir(parents=True)
+    b.mkdir(parents=True)
+    _save_steps(a, [8, 16])
+    _save_steps(b, [8])
+    # run_b's entry is newest by wall-clock → wins even with a smaller step
+    assert find_latest_run_checkpoint(tmp_path) == b / "ckpt_8_0.ckpt"
+    assert find_latest_run_checkpoint(tmp_path / "does_not_exist") is None
+
+
+def test_async_save_round_trip_and_error_surfacing(tmp_path):
+    m = CheckpointManager(keep_last=3, async_save=True)
+    for s in (8, 16):
+        m.save(tmp_path / f"ckpt_{s}_0.ckpt", {"agent": {"w": jnp.full(2, float(s))}, "iter_num": s}, step=s)
+    m.close()
+    assert [e["step"] for e in read_manifest(tmp_path)] == [8, 16]
+    np.testing.assert_array_equal(
+        np.asarray(load_state(tmp_path / "ckpt_16_0.ckpt")["agent"]["w"]), np.full(2, 16.0)
+    )
+
+    # a failing background write surfaces on the next lifecycle call
+    inject.arm("checkpoint.staged", action="raise", at=1)
+    m2 = CheckpointManager(async_save=True)
+    m2.save(tmp_path / "ckpt_24_0.ckpt", {"agent": {"w": jnp.ones(2)}, "iter_num": 24}, step=24)
+    with pytest.raises(CheckpointError, match="Asynchronous checkpoint save failed"):
+        m2.close()
+
+
+def test_replay_buffer_sidecar_through_manager(tmp_path):
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(8, 2, obs_keys=("state",))
+    rb.add(
+        {
+            "state": np.ones((1, 2, 3), np.float32),
+            "terminated": np.zeros((1, 2, 1), np.float32),
+            "truncated": np.zeros((1, 2, 1), np.float32),
+        }
+    )
+    m = CheckpointManager(async_save=True)
+    m.save(tmp_path / "ckpt_8_0.ckpt", {"iter_num": 1, "rb": rb}, step=8)
+    # async contract: the buffer snapshot is taken before save() returns —
+    # post-save mutation must not leak into the checkpoint
+    rb["state"][0] = 7.0
+    m.close()
+    loaded = load_state(tmp_path / "ckpt_8_0.ckpt")
+    np.testing.assert_array_equal(loaded["rb"]["state"][0], np.ones((2, 3), np.float32))
+    assert read_manifest(tmp_path)[0]["has_rb"] is True

@@ -1,0 +1,141 @@
+"""Divergence sentinel: jittable guard semantics + end-to-end NaN injection
+through the real PPO training loop (skip, rollback, abort)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.fault import DivergenceError, DivergenceSentinel
+from sheeprl_tpu.ops import finite_guard, guarded_select
+
+
+def test_finite_guard_basics():
+    assert bool(finite_guard({"a": jnp.ones(3), "ints": jnp.arange(4)}))
+    assert not bool(finite_guard({"a": jnp.array([1.0, np.nan])}))
+    assert not bool(finite_guard((jnp.ones(2), {"x": jnp.array([np.inf])})))
+    # works under jit/scan
+    assert not bool(jax.jit(finite_guard)({"a": jnp.array([np.nan])}))
+
+
+def test_guarded_select_skips_update():
+    new = {"w": jnp.full(2, 9.0)}
+    old = {"w": jnp.zeros(2)}
+    np.testing.assert_array_equal(np.asarray(guarded_select(jnp.bool_(True), new, old)["w"]), 9.0)
+    np.testing.assert_array_equal(np.asarray(guarded_select(jnp.bool_(False), new, old)["w"]), 0.0)
+
+
+def test_sentinel_streak_and_reset():
+    s = DivergenceSentinel({"enabled": True, "max_consecutive": 2, "action": "abort"})
+    with pytest.warns(UserWarning):
+        assert not s.observe(1)
+    assert not s.observe(0)  # streak resets on a good iteration
+    with pytest.warns(UserWarning):
+        assert not s.observe(2)
+    with pytest.warns(UserWarning):
+        assert s.observe(1)  # second consecutive bad -> tripped
+    assert s.total_skipped == 4
+    with pytest.raises(DivergenceError, match="abort"):
+        s.recover("/nonexistent", lambda state: None)
+
+
+def test_sentinel_warn_action_continues():
+    s = DivergenceSentinel({"enabled": True, "max_consecutive": 1, "action": "warn"})
+    with pytest.warns(UserWarning):
+        assert s.observe(3)
+    with pytest.warns(UserWarning):
+        s.recover("/nonexistent", lambda state: None)
+    assert s.consecutive == 0
+
+
+def test_sentinel_rollback_without_checkpoint_aborts(tmp_path):
+    s = DivergenceSentinel({"enabled": True, "max_consecutive": 1, "action": "rollback"})
+    with pytest.warns(UserWarning):
+        assert s.observe(1)
+    with pytest.raises(DivergenceError, match="no complete checkpoint"):
+        s.recover(tmp_path, lambda state: None)
+
+
+def test_sentinel_rollback_restores_from_manager(tmp_path):
+    from sheeprl_tpu.fault.manager import CheckpointManager
+
+    m = CheckpointManager()
+    m.save(tmp_path / "ckpt_8_0.ckpt", {"agent": {"w": jnp.full(3, 42.0)}, "iter_num": 1}, step=8)
+    s = DivergenceSentinel({"enabled": True, "max_consecutive": 1, "action": "rollback"})
+    with pytest.warns(UserWarning):
+        assert s.observe(1)
+    restored = {}
+    s.recover(tmp_path, lambda state: restored.update(state))
+    np.testing.assert_array_equal(np.asarray(restored["agent"]["w"]), np.full(3, 42.0))
+    assert s.rollbacks == 1 and s.consecutive == 0
+
+
+# -- end-to-end through the real PPO loop ------------------------------------
+def _ppo_args(tmp_path, extra=()):
+    return [
+        "exp=ppo", "env=dummy", "env.id=discrete_dummy", "env.num_envs=2", "env.sync_env=True",
+        "env.capture_video=False", "buffer.memmap=False", "fabric.devices=1", "metric.log_level=0",
+        "algo.rollout_steps=4", "algo.per_rank_batch_size=4", "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]", "algo.total_steps=24", "checkpoint.every=8",
+        f"log_root={tmp_path}/logs", "algo.run_test=False", "seed=7",
+        *extra,
+    ]
+
+
+def _final_ckpt_state(tmp_path):
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    path = find_latest_run_checkpoint(os.path.join(str(tmp_path), "logs", "ppo", "discrete_dummy"))
+    assert path is not None
+    return load_state(path)
+
+
+def test_nan_injection_skips_update_and_keeps_params_finite(tmp_path):
+    """Acceptance: NaN gradients trigger the sentinel — the update is
+    skipped, parameters stay finite, training completes."""
+    with pytest.warns(UserWarning, match="optimizer update\\(s\\) skipped"):
+        run(_ppo_args(tmp_path, ["fault.inject.nan_grads_at=[2]", "fault.sentinel.max_consecutive=3"]))
+    state = _final_ckpt_state(tmp_path)
+    assert state["iter_num"] == 3
+    for leaf in jax.tree.leaves(state["agent"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_nan_streak_with_abort_raises_divergence_error(tmp_path):
+    with pytest.raises(DivergenceError, match="diverged"):
+        with pytest.warns(UserWarning, match="optimizer update\\(s\\) skipped"):
+            run(
+                _ppo_args(
+                    tmp_path,
+                    [
+                        "fault.inject.nan_grads_at=[1,2,3]",
+                        "fault.sentinel.max_consecutive=2",
+                        "fault.sentinel.action=abort",
+                    ],
+                )
+            )
+
+
+def test_nan_streak_with_rollback_recovers_and_completes(tmp_path):
+    # iteration 1 checkpoints (every=8 == one iteration), then 2 and 3 are
+    # poisoned: the sentinel rolls back to the iter-1 checkpoint and the run
+    # still finishes with finite parameters
+    with pytest.warns(UserWarning, match="rolling back to last good checkpoint"):
+        run(
+            _ppo_args(
+                tmp_path,
+                [
+                    "fault.inject.nan_grads_at=[2,3]",
+                    "fault.sentinel.max_consecutive=2",
+                    "fault.sentinel.action=rollback",
+                ],
+            )
+        )
+    state = _final_ckpt_state(tmp_path)
+    assert state["iter_num"] == 3
+    for leaf in jax.tree.leaves(state["agent"]):
+        assert np.isfinite(np.asarray(leaf)).all()
