@@ -14,7 +14,11 @@ recorded trajectory stays comparable):
 - ``ondevice`` — ``ppo_cartpole_ondevice_env_steps_per_sec``: the Anakin
   path (``exp=ppo_anakin_benchmarks``, same model/optim/data conditions)
   with the rollout fused in-graph over the pure-JAX CartPole
-  (howto/on_device_rollout.md).
+  (howto/on_device_rollout.md);
+- ``sebulba`` — ``ppo_cartpole_sebulba_env_steps_per_sec``: the decoupled
+  actor/learner pipeline (``exp=ppo_sebulba_benchmarks``, same
+  model/optim/data conditions) with host env stepping, inference and
+  learning overlapped (howto/decoupled_training.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -40,10 +44,17 @@ def main() -> None:
         # cpu`); pin the whole platform so backend discovery never contacts a
         # remote accelerator — the tunneled chip can wedge for minutes and
         # this metric must not hang with it.
-        from sheeprl_tpu.utils.utils import pin_cpu_platform
+        from sheeprl_tpu.utils.utils import machine_keyed_cache_dir, pin_cpu_platform
 
         pin_cpu_platform("cpu")
-        jax.config.update("jax_compilation_cache_dir", os.environ.get("BENCH_XLA_CACHE", "/root/repo/.xla_cache"))
+        # The cache dir is keyed by host CPU features: XLA:CPU AOT entries
+        # compiled on a different machine load with mismatch errors AND run
+        # conservative code (−16% on this metric, BENCH_r04→r05) — a
+        # feature-mismatched host must miss and recompile, not load poison.
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            machine_keyed_cache_dir(os.environ.get("BENCH_XLA_CACHE", "/root/repo/.xla_cache")),
+        )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
@@ -61,8 +72,12 @@ def main() -> None:
         # not framework-bound. 16x the steps keeps the whole-wall convention
         # while the training loop dominates (still well under a minute).
         default_steps = 1048576
+    elif which in ("sebulba", "ppo_cartpole_sebulba_env_steps_per_sec"):
+        metric = "ppo_cartpole_sebulba_env_steps_per_sec"
+        exp = "ppo_sebulba_benchmarks"
+        default_steps = 65536
     else:
-        raise SystemExit(f"Unknown BENCH_METRIC '{which}' (expected 'host' or 'ondevice')")
+        raise SystemExit(f"Unknown BENCH_METRIC '{which}' (expected 'host', 'ondevice' or 'sebulba')")
     total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", default_steps))
     overrides = [
         f"exp={exp}",

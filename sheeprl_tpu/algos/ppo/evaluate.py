@@ -15,11 +15,11 @@ from sheeprl_tpu.utils.registry import register_evaluation
 __all__ = ["evaluate_ppo"]
 
 
-# The decoupled and Anakin mains write the same checkpoint layout (params
-# under "agent"), so all three entry points share one evaluation (reference:
-# ``sheeprl/algos/ppo/evaluate.py:15,58``); the Anakin envs mirror real
-# gymnasium ids, so evaluation runs on the gymnasium counterpart.
-@register_evaluation(algorithms=["ppo", "ppo_decoupled", "ppo_anakin"])
+# The decoupled, Anakin and Sebulba mains write the same checkpoint layout
+# (params under "agent"), so all four entry points share one evaluation
+# (reference: ``sheeprl/algos/ppo/evaluate.py:15,58``); the Anakin envs
+# mirror real gymnasium ids, so evaluation runs on the gymnasium counterpart.
+@register_evaluation(algorithms=["ppo", "ppo_decoupled", "ppo_anakin", "ppo_sebulba"])
 def evaluate_ppo(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, fabric.global_rank)

@@ -1,0 +1,579 @@
+"""PPO — Sebulba-style decoupled actor/learner pipeline for HOST envs.
+
+``ppo_decoupled`` overlaps ONE player thread with the trainer; the Anakin
+path (``ppo_anakin``) removes the host entirely but only works for pure-JAX
+envs. This main is the missing corner of the Podracer story
+(https://arxiv.org/pdf/2104.06272, §Sebulba; the thread-per-role layout
+Sample Factory proved out over processes, https://arxiv.org/pdf/2006.11751):
+REAL gymnasium environments trained at pipeline rates by decoupling the
+three clocks —
+
+- **N actor threads**, each stepping its own :class:`FastSyncVectorEnv`
+  batch through the jitted policy with params committed to a dedicated
+  *actor device slice* (``Fabric.partition``; time-sliced on 1 chip). Each
+  actor finishes a rollout, computes GAE under the SAME params snapshot it
+  acted with, and stages the flattened batch to the learner mesh with one
+  packed ``device_put`` (``DoubleBufferedStager``) — all off the learner's
+  critical path;
+- a **bounded rollout queue** (``RolloutQueue``): back-pressure is the only
+  rate coupling, and both sides' blocked time is exported as ``Pipeline/*``
+  metrics so a starved learner or stalled actor is visible, not inferred;
+- the **learner** (main thread) consuming staged rollouts and running the
+  SAME fused ``shard_map`` epoch/minibatch machinery as host-loop PPO
+  (:func:`~sheeprl_tpu.algos.ppo.ppo.make_train_step`, ``donate=False``
+  because actors hold published params across updates), publishing a
+  versioned params snapshot every ``algo.sebulba.publish_every`` updates
+  through the :class:`ParamServer` (a reference swap — the actor-ward
+  ``device_put`` rides the actor threads).
+
+Staleness semantics: actors pull newest-wins before every rollout, so a
+batch trains on params at most ``staleness_bound(queue_depth, num_actors,
+publish_every)`` publishes old — the same one-ish-iteration policy lag the
+reference decoupled topology has, now with an explicit, instrumented bound.
+
+Fault semantics carry over from the host loop unchanged: CheckpointManager
+saves via ``on_checkpoint_coupled`` (learner-side), ``resume_from=latest``
+restores counters + params + BOTH RNG streams (learner train stream exactly;
+the actor stream restarts from its checkpointed base key — actor sampling is
+already nondeterministic across runs because queue interleaving is), and the
+in-graph divergence sentinel skips/rolls back exactly as in ``ppo``, with a
+forced re-publish after a rollback so actors never keep acting on diverged
+params.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import queue as _queue
+import threading
+import warnings
+from functools import partial
+from typing import Any, Dict, List
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import _dists, build_agent, forward_with_actions
+from sheeprl_tpu.algos.ppo.ppo import make_train_step
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
+from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.ops import gae as gae_op
+from sheeprl_tpu.parallel.pipeline import (
+    DoubleBufferedStager,
+    ParamServer,
+    PipelineStats,
+    RolloutQueue,
+    staleness_bound,
+)
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, build_aggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+__all__ = ["main"]
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.fault import DivergenceSentinel, NaNInjector, load_resume_state
+
+    if jax.process_count() > 1:  # pragma: no cover - single-host subsystem
+        raise NotImplementedError(
+            "ppo_sebulba pipelines actor threads and the learner inside one controller; "
+            "use the host-loop `algo=ppo` for multi-host runs."
+        )
+
+    initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
+    initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
+    rank = fabric.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_resume_state(cfg.checkpoint.resume_from)
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if fabric.is_global_zero:
+        logger.log_hyperparams(cfg)
+    print(f"Log dir: {log_dir}")
+
+    # -- pipeline shape ------------------------------------------------------
+    seb_cfg = cfg.algo.get("sebulba") or {}
+    num_actors = max(1, int(seb_cfg.get("num_actor_threads", 2)))
+    queue_depth = max(1, int(seb_cfg.get("queue_depth", 2)))
+    publish_every = max(1, int(seb_cfg.get("publish_every", 1)))
+    actor_fabric, learner_fabric = fabric.partition(seb_cfg.get("actor_devices", "auto"))
+    actor_devs = list(actor_fabric.devices)
+
+    # -- envs: one vector batch per actor thread -----------------------------
+    # ``env_groups`` amortizes the per-step inference dispatch: each actor
+    # steps ``env.num_envs * env_groups`` envs through ONE jitted call and
+    # slices the finished rollout column-wise into ``env_groups`` independent
+    # items of the configured shape — the learner's per-update batch,
+    # minibatching and update count are IDENTICAL to env_groups=1 (each env
+    # column is a complete (T, num_envs) trajectory); only the params-version
+    # sharing across a group changes, which the staleness bound covers.
+    # Seed offsets keep per-actor sub-env seeds disjoint (vectorize_env seeds
+    # `seed + rank*num_envs + i`); only actor 0 owns the logging env slot.
+    num_envs = int(cfg.env.num_envs)
+    env_groups = max(1, int(seb_cfg.get("env_groups", 1)))
+    batch_envs = num_envs * env_groups
+    env_cfg = copy.deepcopy(cfg)
+    env_cfg.env.num_envs = batch_envs
+    actor_envs = [
+        vectorize_env(
+            env_cfg,
+            cfg.seed + a * batch_envs,
+            rank,
+            log_dir if (rank == 0 and a == 0) else None,
+            prefix="train",
+        )
+        for a in range(num_actors)
+    ]
+    observation_space = actor_envs[0].single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError(
+            "You should specify at least one CNN keys or MLP keys from the cli: "
+            "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
+        )
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    is_continuous = isinstance(actor_envs[0].single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(actor_envs[0].single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        actor_envs[0].single_action_space.shape
+        if is_continuous
+        else (
+            actor_envs[0].single_action_space.nvec.tolist()
+            if is_multidiscrete
+            else [actor_envs[0].single_action_space.n]
+        )
+    )
+
+    # Agent params live replicated on the LEARNER mesh; actors receive
+    # versioned snapshots on their own slice through the ParamServer.
+    agent, params, player = build_agent(
+        learner_fabric, actions_dim, is_continuous, cfg, observation_space,
+        state["agent"] if state is not None else None,
+    )
+
+    from sheeprl_tpu.optim.builders import build_optimizer
+
+    lr0 = float(cfg.algo.optimizer.lr)
+    tx = optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=lr0)
+    opt_state = tx.init(params)
+    if state is not None:
+        opt_state = jax.tree.map(
+            lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, opt_state, state["optimizer"]
+        )
+    opt_state = learner_fabric.put_replicated(opt_state)
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        # actors and the learner tick at their own cadence — no rank sync
+        aggregator = build_aggregator(cfg.metric.aggregator, rank_independent=True)
+
+    # -- counters / schedules (host-loop conventions) ------------------------
+    # (no replay buffer here: rollouts live in the stager's slab ring)
+    start_iter = state["iter_num"] + 1 if state is not None else 1
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    policy_step = (start_iter - 1) * policy_steps_per_iter
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    local_batch_global = cfg.algo.rollout_steps * num_envs
+    if local_batch_global % learner_fabric.world_size != 0:
+        raise ValueError(
+            f"rollout_steps*num_envs ({local_batch_global}) must be divisible by the number of learner "
+            f"devices ({learner_fabric.world_size}); adjust fabric.devices/algo.sebulba.actor_devices"
+        )
+
+    sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
+    guard = bool(sentinel_cfg.get("enabled", True))
+    sentinel = DivergenceSentinel(sentinel_cfg)
+    nan_injector = NaNInjector(cfg)
+    ckpt_dir = os.path.join(log_dir, "checkpoint")
+
+    train_fn = make_train_step(
+        agent, tx, cfg, learner_fabric.mesh,
+        local_batch_global // learner_fabric.world_size, donate=False, guard=guard,
+    )
+    gae_fn = jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
+
+    # -- RNG streams ---------------------------------------------------------
+    rng_train = jax.random.PRNGKey(cfg.seed + 1)
+    actor_rng_base = jax.random.PRNGKey(cfg.seed + 2)
+    if state is not None and state.get("rng") is not None:
+        rng_train = jnp.asarray(state["rng"])  # continue the learner stream exactly
+    if state is not None and state.get("actor_rng") is not None:
+        actor_rng_base = jnp.asarray(state["actor_rng"])
+
+    # -- pipeline plumbing ---------------------------------------------------
+    stats = PipelineStats()
+    rollout_q = RolloutQueue(queue_depth, stats=stats)
+    param_server = ParamServer(params, publish_every=publish_every, stats=stats)
+    param_server.publish(params)  # version 1 = the initial/restored weights
+    stop_event = threading.Event()
+    actor_errors: List[BaseException] = []
+    # in-flight items per actor = env_groups (a rollout slices into that many)
+    bound = staleness_bound(queue_depth, num_actors * env_groups, publish_every)
+
+    T = int(cfg.algo.rollout_steps)
+    act_width = int(np.sum(actions_dim))  # concat one-hot heads / continuous dims
+    n_heads = 1 if is_continuous else len(actions_dim)
+    head_split = np.cumsum(np.asarray(actions_dim[:-1], dtype=np.int64)).tolist()
+
+    # -- actor-side jitted programs ------------------------------------------
+    # The env feedback loop only needs the ACTION each step; logprobs and
+    # values are pure functions of (params, obs, action) and are recomputed
+    # for the WHOLE trajectory in one batched forward at rollout end —
+    # identical math (same snapshot, same normalization as the train
+    # minibatch), ~T× less per-step graph execution than the host player's
+    # fused 5-output step. This is what makes a 1-env actor thread cheap
+    # enough to pipeline.
+    def _act(p, key, obs):
+        # per-step keys are pre-split on the host once per rollout, so the
+        # graph is just forward + sample — no in-graph key carry
+        actor_outs, _ = agent.apply(p, obs)
+        dists = _dists(actor_outs, is_continuous)
+        if is_continuous:
+            return dists[0].sample(key)  # (B, dim): the env action
+        if n_heads == 1:
+            return dists[0].sample(key).argmax(-1)[..., None]  # (B, 1)
+        keys = jax.random.split(key, n_heads)
+        return jnp.stack([d.sample(k).argmax(-1) for d, k in zip(dists, keys)], axis=-1)
+
+    act_fn = jax.jit(_act)
+
+    def _traj_outs(p, obs_flat, actions_flat):
+        # normalization mirrors make_local_train's minibatch_step exactly
+        obs = {k: obs_flat[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
+        obs.update({k: obs_flat[k].astype(jnp.float32) for k in cfg.algo.mlp_keys.encoder})
+        if is_continuous or n_heads == 1:
+            actions = [actions_flat]
+        else:
+            actions = jnp.split(actions_flat, head_split, axis=-1)
+        logprob, _entropy, values = forward_with_actions(agent, p, obs, actions)
+        return logprob, values
+
+    traj_fn = jax.jit(_traj_outs)
+    eye_rows = [np.eye(int(d), dtype=np.float32) for d in actions_dim] if not is_continuous else None
+
+    def actor_fn(aid: int, envs) -> None:
+        try:
+            device = actor_devs[aid % len(actor_devs)]
+            # ring must cover every slab that can be live at once: queued
+            # items (queue_depth) + learner dispatched/executing (2) + the
+            # env_groups slabs this rollout is filling, +1 safety
+            stager = DoubleBufferedStager(
+                learner_fabric.data_sharding, slots=queue_depth + env_groups + 3
+            )
+            # Rollout slabs are written ROW BY ROW in the hot loop (no replay
+            # buffer, no per-step dict churn) and shipped flattened — the
+            # (T, N, ...) slab and its (T*N, ...) view share memory. One slab
+            # per GROUP so every shipped item is contiguous.
+            template: Dict[str, Any] = {
+                "actions": ((T, num_envs, act_width), np.float32),
+                "rewards": ((T, num_envs, 1), np.float32),
+                "dones": ((T, num_envs, 1), np.uint8),
+            }
+            for k in obs_keys:
+                space = observation_space[k]
+                template[k] = ((T, num_envs, *space.shape), space.dtype)
+            rng = jax.random.fold_in(actor_rng_base, aid)
+            next_obs = {k: np.asarray(v) for k, v in envs.reset(seed=cfg.seed + aid * batch_envs)[0].items()}
+            groups = [(g * num_envs, (g + 1) * num_envs) for g in range(env_groups)]
+
+            local_iter = 0
+            while not stop_event.is_set():
+                local_iter += 1
+                version, p_snapshot = param_server.pull(device)
+                slabs = [stager.acquire(template) for _ in range(env_groups)]
+                ep_infos: List[List[Any]] = [[] for _ in range(env_groups)]
+                # ONE host-side split serves the whole rollout: the per-step
+                # graph needs no key carry and no in-graph split
+                _keys = jax.device_get(jax.random.split(rng, T + 1))
+                rng, _step_keys = _keys[0], _keys[1:]
+                for t in range(T):
+                    for g, (lo, hi) in enumerate(groups):
+                        for k in obs_keys:
+                            slabs[g][k][t] = next_obs[k][lo:hi]
+                    jobs = prepare_obs(actor_fabric, next_obs, cnn_keys=cnn_keys, num_envs=batch_envs)
+                    env_actions = act_fn(p_snapshot, _step_keys[t], jobs)
+                    real_actions = np.asarray(env_actions)
+                    for g, (lo, hi) in enumerate(groups):
+                        if is_continuous:
+                            slabs[g]["actions"][t] = real_actions[lo:hi]
+                        else:
+                            # one-hot the index actions into the slab on host —
+                            # cheaper than ferrying a second device output
+                            off = 0
+                            for h, eye in enumerate(eye_rows):
+                                w = eye.shape[0]
+                                slabs[g]["actions"][t, :, off : off + w] = eye[real_actions[lo:hi, h]]
+                                off += w
+
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0 and "final_obs" in info:
+                        real_next_obs = {
+                            k: np.stack(
+                                [np.asarray(info["final_obs"][te][k], dtype=np.float32) for te in truncated_envs]
+                            )
+                            for k in obs_keys
+                        }
+                        jnext = prepare_obs(
+                            actor_fabric, real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs)
+                        )
+                        vals = np.asarray(player.get_values(p_snapshot, jnext))
+                        rewards = rewards.astype(np.float32)
+                        rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
+                    dones_col = np.logical_or(terminated, truncated).reshape(batch_envs, 1)
+                    rew_col = np.asarray(rewards, dtype=np.float32).reshape(batch_envs, 1)
+                    for g, (lo, hi) in enumerate(groups):
+                        slabs[g]["dones"][t] = dones_col[lo:hi]
+                        slabs[g]["rewards"][t] = rew_col[lo:hi]
+                    next_obs = {k: np.asarray(obs[k]) for k in obs_keys}
+
+                    if cfg.metric.log_level > 0 and "final_info" in info:
+                        ep_info = info["final_info"]
+                        if isinstance(ep_info, dict) and "episode" in ep_info:
+                            mask = np.asarray(
+                                ep_info.get(
+                                    "_episode", np.ones_like(np.asarray(ep_info["episode"]["r"]), dtype=bool)
+                                )
+                            ).reshape(-1)
+                            rews = np.asarray(ep_info["episode"]["r"]).reshape(-1)
+                            lens = np.asarray(ep_info["episode"]["l"]).reshape(-1)
+                            for e in np.nonzero(mask)[0]:
+                                ep_infos[int(e) // num_envs].append((float(rews[e]), float(lens[e])))
+
+                # Per group: ONE batched trajectory forward recomputes
+                # logprobs/values for all T*N transitions under the SAME
+                # snapshot the rollout acted with, then GAE — on the actor
+                # device — then one packed, learner-sharded device_put.
+                # All off the learner's hot path.
+                jobs = prepare_obs(actor_fabric, next_obs, cnn_keys=cnn_keys, num_envs=batch_envs)
+                next_values_all = player.get_values(p_snapshot, jobs)
+                for g, (lo, hi) in enumerate(groups):
+                    slab = slabs[g]
+                    flat_data: Dict[str, Any] = {
+                        k: v.reshape(T * num_envs, *v.shape[2:]) for k, v in slab.items()
+                    }
+                    logprobs, values = traj_fn(
+                        p_snapshot, {k: flat_data[k] for k in obs_keys}, flat_data["actions"]
+                    )
+                    returns, advantages = gae_fn(
+                        slab["rewards"], values.reshape(T, num_envs, 1), slab["dones"], next_values_all[lo:hi]
+                    )
+                    flat_data["logprobs"] = logprobs
+                    flat_data["values"] = values
+                    flat_data["returns"] = returns.reshape(T * num_envs, *returns.shape[2:])
+                    flat_data["advantages"] = advantages.reshape(T * num_envs, *advantages.shape[2:])
+                    if nan_injector:
+                        nan_injector.poison(flat_data, "advantages", local_iter)
+                    staged = stager.ship(flat_data)
+                    if not rollout_q.put(
+                        {"actor_id": aid, "data": staged, "ep_infos": ep_infos[g], "version": version},
+                        stop_event=stop_event,
+                    ):
+                        return
+        except BaseException as e:  # surface crashes to the learner
+            actor_errors.append(e)
+        finally:
+            try:
+                envs.close()
+            except Exception:
+                pass
+
+    actor_threads = [
+        threading.Thread(target=actor_fn, args=(a, actor_envs[a]), name=f"sebulba-actor-{a}", daemon=True)
+        for a in range(num_actors)
+    ]
+    for t in actor_threads:
+        t.start()
+
+    # -- learner loop --------------------------------------------------------
+    lr = lr0
+    clip_coef = float(cfg.algo.clip_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+    params_live, opt_live = params, opt_state
+    train_step = 0
+    iter_num = start_iter - 1
+    # Async-dispatch runahead bound: JAX lets the learner dispatch train
+    # steps far ahead of their execution; every pending step pins its input
+    # buffers (which alias stager slabs on the CPU backend). Block on the
+    # PREVIOUS step's loss before dispatching the next — one step of
+    # pipelining, never more — so at most 2 slabs per item are learner-live,
+    # the budget the stager ring is sized for. (With guard=True the sentinel
+    # observe() already syncs harder; this bound covers guard=False too.)
+    pending_sync = None
+
+    def _checkpoint_state(it: int) -> Dict[str, Any]:
+        return {
+            "agent": params_live,
+            "optimizer": opt_live,
+            "scheduler": None,
+            "iter_num": it,
+            "batch_size": cfg.algo.per_rank_batch_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": rng_train,
+            "actor_rng": actor_rng_base,
+        }
+
+    try:
+        while iter_num < total_iters:
+            if actor_errors:  # surface a crashed actor NOW, not at run end
+                raise actor_errors[0]
+            try:
+                item = rollout_q.get(timeout=0.5)
+            except _queue.Empty:
+                if all(not t.is_alive() for t in actor_threads):
+                    raise RuntimeError("All Sebulba actor threads exited before training finished")
+                continue
+            iter_num += 1
+            policy_step += policy_steps_per_iter
+            staleness = param_server.version - item["version"]
+            stats.observe_staleness(staleness)
+
+            rng_train, train_key = jax.random.split(rng_train)
+            if pending_sync is not None:
+                jax.block_until_ready(pending_sync)
+            outs = train_fn(
+                params_live, opt_live, item["data"], train_key,
+                jnp.asarray(clip_coef, dtype=jnp.float32), jnp.asarray(ent_coef, dtype=jnp.float32),
+            )
+            params_live, opt_live, pg_l, v_l, ent_l = outs[:5]
+            pending_sync = pg_l
+            train_step += 1
+            param_server.maybe_publish(train_step, params_live)
+
+            if guard and sentinel.observe(outs[5]):
+                def _rollback(good):
+                    nonlocal params_live, opt_live, rng_train
+                    params_live = learner_fabric.put_replicated(
+                        jax.tree.map(lambda t, s: jnp.asarray(s), params_live, good["agent"])
+                    )
+                    opt_live = learner_fabric.put_replicated(
+                        jax.tree.map(
+                            lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s,
+                            opt_live, good["optimizer"],
+                        )
+                    )
+                    if good.get("rng") is not None:
+                        rng_train = jnp.asarray(good["rng"])
+                    # NOTE: the checkpointed actor_rng only matters on process
+                    # resume — live actor threads folded their stream at start
+                    # and an in-place rollback cannot (and need not) rewind it
+
+                sentinel.recover(ckpt_dir, _rollback)
+                # actors must never keep acting on diverged weights
+                param_server.publish(params_live)
+
+            if aggregator and not aggregator.disabled:
+                aggregator.update("Loss/policy_loss", pg_l)
+                aggregator.update("Loss/value_loss", v_l)
+                aggregator.update("Loss/entropy_loss", ent_l)
+                for ep_rew, ep_len in item["ep_infos"]:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, _ep_len) in enumerate(item["ep_infos"]):
+                    print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+            ):
+                if aggregator and not aggregator.disabled:
+                    logger.log_dict(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                pipe_metrics = stats.snapshot()
+                pipe_metrics["Pipeline/queue_depth"] = rollout_q.qsize()
+                logger.log_dict(pipe_metrics, policy_step)
+                logger.log_dict(
+                    {"Info/learning_rate": lr, "Info/clip_coef": clip_coef, "Info/ent_coef": ent_coef},
+                    policy_step,
+                )
+                if guard and sentinel.total_skipped:
+                    logger.log_dict({"Fault/skipped_updates": sentinel.total_skipped}, policy_step)
+                restarts = sum(getattr(e, "env_restarts", 0) for e in actor_envs)
+                if restarts:
+                    logger.log_dict({"Fault/env_restarts": restarts}, policy_step)
+                last_log = policy_step
+
+            if cfg.algo.anneal_lr:
+                lr = polynomial_decay(iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0)
+                opt_live.hyperparams["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+            if cfg.algo.anneal_clip_coef:
+                clip_coef = polynomial_decay(
+                    iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                ent_coef = polynomial_decay(
+                    iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+                fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_checkpoint_state(iter_num))
+    finally:
+        stop_event.set()
+        rollout_q.drain()
+        for t in actor_threads:
+            t.join(timeout=30.0)
+
+    if actor_errors:
+        raise actor_errors[0]
+    if os.environ.get("SHEEPRL_SEBULBA_DEBUG"):  # pipeline-balance dump for bench tuning
+        print("SEBULBA_STATS", {**stats.snapshot(), "staleness_max": stats.max_staleness_seen})
+    if stats.max_staleness_seen > 2 * bound:  # pragma: no cover - invariant guard
+        # the steady-state bound tolerates transient jitter (see
+        # pipeline.staleness_bound); a 2x breach means the pipeline is
+        # genuinely unbalanced — surface it rather than silently train stale
+        warnings.warn(
+            f"Pipeline params staleness reached {stats.max_staleness_seen} publishes "
+            f"(steady-state bound {bound}): actors cannot keep up with the learner — "
+            "raise algo.sebulba.num_actor_threads/env_groups or publish_every."
+        )
+
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, params_live, fabric, cfg, log_dir, writer=logger)
+
+    if not cfg.model_manager.disabled and fabric.is_global_zero:  # pragma: no cover - mlflow optional
+        from sheeprl_tpu.utils.mlflow import register_model
+
+        from sheeprl_tpu.algos.ppo.utils import log_models
+
+        register_model(fabric, log_models, cfg, {"agent": params_live})
+    logger.close()

@@ -119,10 +119,25 @@ def _load_utils_module(entry: Dict[str, Any]):
 
 def run_algorithm(cfg: DotDict) -> None:
     """(reference: ``cli.py:59-198``)"""
-    from sheeprl_tpu.utils.utils import pin_cpu_platform
+    from sheeprl_tpu.utils.utils import machine_keyed_cache_dir, pin_cpu_platform
 
     os.environ.setdefault("OMP_NUM_THREADS", str(cfg.num_threads))
     pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
+
+    # Opt-in persistent XLA compile cache for CLI runs. The directory is
+    # keyed by host CPU features: XLA:CPU AOT entries compiled on another
+    # machine type load with cpu_aot_loader mismatch errors and execute
+    # conservative code paths (−16% on the PPO driver bench) — mismatched
+    # hosts must recompile, never reuse.
+    cache_base = os.environ.get("SHEEPRL_TPU_XLA_CACHE")
+    if cache_base:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", machine_keyed_cache_dir(cache_base))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception as e:  # pragma: no cover - cache is best-effort
+            warnings.warn(f"Could not enable the persistent XLA cache: {e}")
 
     entry = resolve_algorithm(cfg.algo.name)
     if entry is None:

@@ -86,17 +86,22 @@ class Fabric:
         mesh_axes: Sequence[str] = ("dp",),
         mesh_shape: Optional[Sequence[int]] = None,
         callbacks: Optional[Sequence[Any]] = None,
+        device_list: Optional[Sequence[jax.Device]] = None,
     ) -> None:
         # ``accelerator: cpu`` pins the mesh to host CPU devices — the
         # reference benchmark configs run on CPU (``fabric.accelerator: cpu``
         # in sheeprl/configs/exp/ppo_benchmarks.yaml) and, for tiny models,
         # per-step device round-trips dwarf the compute; anything else defers
         # to JAX's default platform (TPU when present).
-        if str(accelerator).lower() == "cpu":
+        # ``device_list`` pins the mesh to an explicit device subset — the
+        # Sebulba actor/learner slices carved out by :meth:`partition`.
+        if device_list is not None:
+            all_devices = list(device_list)
+        elif str(accelerator).lower() == "cpu":
             all_devices = jax.devices("cpu")
         else:
             all_devices = jax.devices()
-        if devices in ("auto", None, -1):
+        if devices in ("auto", None, -1) or device_list is not None:
             n = len(all_devices)
         else:
             n = int(devices)
@@ -203,6 +208,64 @@ class Fabric:
         rep = self.replicated
         return jax.tree.map(lambda x: jax.device_put(x, rep), tree)
 
+    # -- device-slice partitioning (Sebulba topology) ------------------------
+    def partition(self, actor_devices: int | str = "auto") -> tuple["Fabric", "Fabric"]:
+        """Split this fabric's devices into disjoint ``(actor, learner)``
+        sub-fabrics for a decoupled actor/learner (Sebulba) pipeline.
+
+        ``actor_devices`` is the chip count dedicated to actor-side inference
+        (``"auto"``: 1 when more than one device is visible, else 0). Actors
+        take devices from the TAIL so the learner keeps device 0 (default
+        device, logging, checkpoints). With a single device — or
+        ``actor_devices=0`` — both sides TIME-SLICE the same chip(s): the
+        actor sub-fabric is a 1-device view of the learner's first device,
+        and the overlap is between host env-stepping and device compute
+        rather than between device slices.
+
+        The learner sub-fabric keeps this fabric's callbacks (it is the
+        checkpoint writer); both inherit the precision policy.
+        """
+        n_total = len(self.devices)
+        if isinstance(actor_devices, str):
+            if actor_devices.lower() != "auto":
+                raise ValueError(f"actor_devices must be an int or 'auto', got {actor_devices!r}")
+            n_act = 1 if n_total > 1 else 0
+        else:
+            n_act = int(actor_devices)
+        if n_act < 0 or n_act >= n_total:
+            raise ValueError(
+                f"actor_devices ({n_act}) must leave at least one learner device "
+                f"(fabric has {n_total}); use 0 (or 'auto' on one chip) to time-slice."
+            )
+
+        def _sub(devs, callbacks):
+            f = Fabric(
+                accelerator=self.accelerator,
+                precision="32-true",
+                strategy=self.strategy,
+                mesh_axes=("dp",),
+                callbacks=callbacks,
+                device_list=devs,
+            )
+            f.precision = self.precision
+            return f
+
+        if n_act == 0:
+            learner = _sub(list(self.devices), self.callbacks)
+            actor = _sub([self.devices[0]], [])
+        else:
+            learner = _sub(list(self.devices[: n_total - n_act]), self.callbacks)
+            actor = _sub(list(self.devices[n_total - n_act :]), [])
+        if getattr(self, "_grad_reduce_auto", False):
+            # the gradient collective runs on the LEARNER mesh: re-resolve the
+            # auto wire dtype against it (from_config resolved against the
+            # full fabric — a 1-device learner carved from a 2-device fabric
+            # must not round gradients over a wire that no longer exists)
+            from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
+
+            set_grad_reduce_dtype("bfloat16" if learner.world_size > 1 else "float32")
+        return actor, learner
+
     # -- launch --------------------------------------------------------------
     def launch(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
         """Run ``fn(self, *args)``.
@@ -248,11 +311,7 @@ class Fabric:
     def from_config(cls, fabric_cfg: Mapping[str, Any], callbacks: Optional[Sequence[Any]] = None) -> "Fabric":
         from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
 
-        # Process-wide gradient-collective wire dtype; must land before any
-        # train step traces. from_config is the run boundary, so previous
-        # runs' traces don't trip the mid-run-flip warning (parallel/comm.py).
-        set_grad_reduce_dtype(fabric_cfg.get("grad_reduce_dtype", "float32"), fresh_run=True)
-        return cls(
+        fabric = cls(
             devices=fabric_cfg.get("devices", "auto"),
             accelerator=fabric_cfg.get("accelerator", "auto"),
             precision=str(fabric_cfg.get("precision", "32-true")),
@@ -261,6 +320,19 @@ class Fabric:
             mesh_shape=fabric_cfg.get("mesh_shape"),
             callbacks=callbacks,
         )
+        # Process-wide gradient-collective wire dtype; must land before any
+        # train step traces. from_config is the run boundary, so previous
+        # runs' traces don't trip the mid-run-flip warning (parallel/comm.py).
+        # ``auto`` (the default) reduces in bf16 whenever there is an actual
+        # wire — i.e. the mesh spans more than one device; a single-device
+        # "collective" is a no-op, where the cast would round gradients for
+        # nothing. ``float32`` is the exactness escape hatch.
+        wire = fabric_cfg.get("grad_reduce_dtype", "auto")
+        fabric._grad_reduce_auto = wire is None or str(wire).lower() == "auto"
+        if fabric._grad_reduce_auto:
+            wire = "bfloat16" if fabric.world_size > 1 else "float32"
+        set_grad_reduce_dtype(wire, fresh_run=True)
+        return fabric
 
 
 def get_single_device_fabric(fabric: Fabric) -> Fabric:

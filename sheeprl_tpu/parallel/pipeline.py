@@ -1,0 +1,329 @@
+"""Sebulba pipeline primitives: bounded rollout staging + versioned params.
+
+The Podracer *Sebulba* topology (https://arxiv.org/pdf/2104.06272; the same
+actor/learner split Sample Factory runs over processes,
+https://arxiv.org/pdf/2006.11751) decouples host-env training into
+
+- **actor threads** stepping real (gymnasium) envs through a jitted policy
+  on a dedicated device slice, and
+- a **learner** consuming finished rollouts from a bounded queue and running
+  the fused minibatch machinery on the remaining devices,
+
+with parameters flowing the other way as *versioned snapshots*. This module
+holds the three moving parts every such main needs; they are deliberately
+algorithm-agnostic (the Dreamer line will reuse them):
+
+:class:`RolloutQueue`
+    A bounded handoff. ``put`` blocks when the learner is behind —
+    back-pressure is the *only* rate coupling between the two sides — and
+    both directions record how long they were blocked, surfacing the
+    pipeline's balance as metrics (``Pipeline/*``) instead of guesswork.
+
+:class:`ParamServer`
+    Versioned params pub-sub. The learner publishes every ``publish_every``
+    updates (a reference swap — nothing is copied on the hot path); actors
+    pull *newest-wins* right before each rollout and place the snapshot on
+    their own device slice (the cross-slice copy rides the actor thread, off
+    the learner's critical path). Per-device caching means N actors on one
+    device share one transfer per version.
+
+:class:`DoubleBufferedStager`
+    Host→device staging through a ring of preallocated (pinned, on TPU
+    runtimes that pin ``device_put`` sources) slabs: each rollout is packed
+    into one slab and shipped with a SINGLE sharded ``device_put`` (the PR-1
+    blob trick). The ring exists for correctness, not just reuse: on the CPU
+    backend ``device_put`` of an aligned numpy array can be ZERO-COPY, so a
+    staged rollout may alias its slab while the queue/learner/XLA still read
+    it — a slab is only recycled after ``queue_depth + 3`` later rollouts
+    (queue + learner-dispatched + XLA-executing + actor-filling).
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "PipelineStats",
+    "RolloutQueue",
+    "ParamServer",
+    "DoubleBufferedStager",
+    "staleness_bound",
+]
+
+
+def staleness_bound(queue_depth: int, in_flight: int, publish_every: int) -> int:
+    """Steady-state params staleness, in *published versions*, of a rollout
+    at the moment the learner trains on it.
+
+    The learner advances one update per consumed item and publishes every
+    ``publish_every`` updates. An item collected under version ``v`` waits
+    behind at most ``queue_depth`` queued items plus ``in_flight``
+    being-collected items (one per actor thread × rollout slices per pull)
+    plus the learner's current one, so in steady state (production rate =
+    consumption rate, which back-pressure enforces) the published version
+    advances by at most ``ceil((queue_depth + in_flight + 1) /
+    publish_every)`` before the item trains. With ONE producer this is a hard
+    bound (FIFO admits nothing past an unqueued item); with several, rollout
+    duration jitter can transiently exceed it — the ``Pipeline/*`` gauges
+    report the observed value, and the single-producer case is asserted
+    exactly by ``tests/test_utils/test_pipeline.py``.
+    """
+    return math.ceil((queue_depth + in_flight + 1) / max(1, publish_every))
+
+
+class PipelineStats:
+    """Thread-safe counters for the actor↔learner handoff."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.rollouts_produced = 0
+        self.rollouts_consumed = 0
+        self.actor_stall_s = 0.0  # time actors spent blocked on a full queue
+        self.learner_starved_s = 0.0  # time the learner waited on an empty queue
+        self.publishes = 0
+        self.pulls = 0
+        self.max_depth_seen = 0
+        self.max_staleness_seen = 0
+        self.last_staleness = 0
+
+    def add(self, field: str, value: float) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + value)
+
+    def observe_depth(self, depth: int) -> None:
+        with self._lock:
+            self.max_depth_seen = max(self.max_depth_seen, depth)
+
+    def observe_staleness(self, staleness: int) -> None:
+        with self._lock:
+            self.last_staleness = staleness
+            self.max_staleness_seen = max(self.max_staleness_seen, staleness)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Metric dict (``Pipeline/*``) for ``logger.log_dict``."""
+        with self._lock:
+            return {
+                "Pipeline/rollouts_produced": self.rollouts_produced,
+                "Pipeline/rollouts_consumed": self.rollouts_consumed,
+                "Pipeline/actor_stall_s": round(self.actor_stall_s, 4),
+                "Pipeline/learner_starved_s": round(self.learner_starved_s, 4),
+                "Pipeline/publishes": self.publishes,
+                "Pipeline/param_staleness": self.last_staleness,
+                "Pipeline/max_queue_depth": self.max_depth_seen,
+            }
+
+
+class RolloutQueue:
+    """Bounded FIFO between actor threads and the learner.
+
+    ``put`` applies back-pressure (blocks while ``depth`` rollouts are
+    pending) but stays interruptible: it polls ``stop_event`` so shutdown
+    never deadlocks an actor against a learner that already exited. Both
+    ``put`` and ``get`` account their blocked time into :class:`PipelineStats`.
+    """
+
+    def __init__(self, depth: int, stats: Optional[PipelineStats] = None) -> None:
+        if depth < 1:
+            raise ValueError(f"queue depth must be >= 1, got {depth}")
+        self.depth = depth
+        self.stats = stats or PipelineStats()
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def put(self, item: Any, stop_event: Optional[threading.Event] = None, poll_s: float = 0.05) -> bool:
+        """Enqueue; returns False (item dropped) if ``stop_event`` fires while
+        blocked on a full queue."""
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            # genuine back-pressure: charge the whole blocked wait
+            start = time.perf_counter()
+            while True:
+                if stop_event is not None and stop_event.is_set():
+                    self.stats.add("actor_stall_s", time.perf_counter() - start)
+                    return False
+                try:
+                    self._q.put(item, timeout=poll_s)
+                    break
+                except queue.Full:
+                    continue
+            self.stats.add("actor_stall_s", time.perf_counter() - start)
+        self.stats.add("rollouts_produced", 1)
+        self.stats.observe_depth(self._q.qsize())
+        return True
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Dequeue; raises ``queue.Empty`` on timeout. Starvation (any wait at
+        all) is charged to ``learner_starved_s``."""
+        start = time.perf_counter()
+        item = self._q.get(timeout=timeout)
+        waited = time.perf_counter() - start
+        if waited > 1e-4:
+            self.stats.add("learner_starved_s", waited)
+        self.stats.add("rollouts_consumed", 1)
+        return item
+
+    def drain(self) -> int:
+        """Discard everything pending (shutdown path); returns the count."""
+        n = 0
+        while True:
+            try:
+                self._q.get_nowait()
+                n += 1
+            except queue.Empty:
+                return n
+
+
+class ParamServer:
+    """Versioned parameter pub-sub between the learner and the actors.
+
+    The learner side is wait-free: :meth:`publish` swaps a reference under a
+    lock and returns — no device transfer, no blocking on actors. Actors call
+    :meth:`pull` with their device; the newest version is ``device_put`` onto
+    that device *by the actor thread* (and cached per device, so co-located
+    actors share one copy per version). Donation hazard: the learner must run
+    its train step with ``donate=False`` for the published pytree — actors
+    hold references across updates (same rule as ``ppo_decoupled``).
+    """
+
+    def __init__(self, params: Any, publish_every: int = 1, stats: Optional[PipelineStats] = None) -> None:
+        if publish_every < 1:
+            raise ValueError(f"publish_every must be >= 1, got {publish_every}")
+        self.publish_every = publish_every
+        self.stats = stats or PipelineStats()
+        self._lock = threading.Lock()
+        self._params = params
+        self._version = 0
+        self._device_cache: Dict[Any, Any] = {}  # device -> (version, placed params)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, params: Any) -> int:
+        """Swap in fresh params unconditionally; returns the new version."""
+        with self._lock:
+            self._params = params
+            self._version += 1
+            v = self._version
+        self.stats.add("publishes", 1)
+        return v
+
+    def maybe_publish(self, update_idx: int, params: Any) -> bool:
+        """Publish iff ``update_idx`` hits the ``publish_every`` cadence
+        (update indices are 1-based: ``K, 2K, ...`` publish)."""
+        if update_idx % self.publish_every == 0:
+            self.publish(params)
+            return True
+        return False
+
+    def pull(self, device: Any = None):
+        """Newest-wins snapshot for an actor. Returns ``(version, params)``;
+        with ``device`` set the snapshot is placed (and cached) there."""
+        with self._lock:
+            version, params = self._version, self._params
+        self.stats.add("pulls", 1)
+        if device is None:
+            return version, params
+        with self._lock:
+            cached = self._device_cache.get(device)
+            if cached is not None and cached[0] >= version:
+                return cached
+        placed = jax.device_put(params, device)
+        with self._lock:
+            cached = self._device_cache.get(device)
+            if cached is None or cached[0] < version:
+                self._device_cache[device] = (version, placed)
+                return version, placed
+            return cached
+
+
+class DoubleBufferedStager:
+    """Ring-buffered host→device staging: one packed ``device_put`` per
+    rollout (see module docstring for why the ring must outlive the queue).
+
+    Numpy leaves are ``np.copyto``'d into the current slab (so the caller's
+    arrays — typically replay-buffer *views* — are immediately reusable);
+    already-on-device leaves (e.g. GAE outputs living on the actor device)
+    pass straight through and let ``device_put`` do the cross-device copy.
+    """
+
+    def __init__(self, sharding: Any, slots: int = 2) -> None:
+        if slots < 2:
+            raise ValueError(f"stager needs at least 2 slots, got {slots}")
+        self.sharding = sharding
+        self.slots = slots
+        self._ring: list = []
+        self._idx = 0
+        self._mode: Optional[str] = None  # "stage" | "acquire"; mixing desyncs the ring
+
+    def _enter_mode(self, mode: str) -> None:
+        if self._mode is None:
+            self._mode = mode
+        elif self._mode != mode:
+            raise RuntimeError(
+                f"DoubleBufferedStager used in '{self._mode}' mode cannot switch to '{mode}': "
+                "stage() and acquire() share one slab ring with different layouts — use one "
+                "stager instance per mode."
+            )
+
+    def _alloc(self, tree: Dict[str, Any]) -> None:
+        for _ in range(self.slots):
+            self._ring.append(
+                {
+                    k: np.empty(v.shape, dtype=v.dtype)
+                    for k, v in tree.items()
+                    if isinstance(v, np.ndarray)
+                }
+            )
+
+    def stage(self, tree: Dict[str, Any]) -> Dict[str, Any]:
+        """Pack ``tree`` into the next slab and ship it as ONE sharded
+        ``device_put`` of the whole dict."""
+        self._enter_mode("stage")
+        if not self._ring:
+            self._alloc(tree)
+        slab = self._ring[self._idx]
+        self._idx = (self._idx + 1) % self.slots
+        staged: Dict[str, Any] = {}
+        for k, v in tree.items():
+            if isinstance(v, np.ndarray):
+                dst = slab.get(k)
+                if dst is None or dst.shape != v.shape or dst.dtype != v.dtype:
+                    dst = slab[k] = np.empty(v.shape, dtype=v.dtype)
+                np.copyto(dst, v)
+                staged[k] = dst
+            else:
+                staged[k] = v
+        return jax.device_put(staged, self.sharding)
+
+    def acquire(self, template: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Hand out the next slab for DIRECT writes — the zero-copy variant of
+        :meth:`stage` for hot loops that assemble a rollout row by row (the
+        Sebulba actors): the caller fills the slab arrays in place and then
+        :meth:`ship`\\ s them, skipping the intermediate copy entirely.
+        ``template`` maps key -> ``(shape, dtype)``."""
+        self._enter_mode("acquire")
+        if not self._ring:
+            for _ in range(self.slots):
+                self._ring.append(
+                    {k: np.empty(shape, dtype=dtype) for k, (shape, dtype) in template.items()}
+                )
+        slab = self._ring[self._idx]
+        self._idx = (self._idx + 1) % self.slots
+        return slab
+
+    def ship(self, tree: Dict[str, Any]) -> Dict[str, Any]:
+        """ONE packed sharded ``device_put`` of an :meth:`acquire`-filled slab
+        (plus any already-on-device leaves, e.g. GAE outputs)."""
+        return jax.device_put(tree, self.sharding)

@@ -20,6 +20,7 @@ _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo.ppo",
     "sheeprl_tpu.algos.ppo.ppo_anakin",
     "sheeprl_tpu.algos.ppo.ppo_decoupled",
+    "sheeprl_tpu.algos.ppo.ppo_sebulba",
     "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
     "sheeprl_tpu.algos.sac.sac",
     "sheeprl_tpu.algos.sac.sac_decoupled",

@@ -19,7 +19,12 @@ pin_cpu_platform("cpu")
 
 # Persistent XLA compilation cache: the dreamer/p2e train steps take tens of
 # seconds to compile; caching them across test runs keeps the suite usable.
-_CACHE_DIR = os.environ.get("SHEEPRL_TPU_TEST_CACHE", "/tmp/sheeprl_tpu_xla_cache")
+# Keyed by host CPU features — AOT entries from a feature-mismatched machine
+# (e.g. a CI cache restored on a different runner generation) load with
+# cpu_aot_loader errors and run slower code (utils.machine_keyed_cache_dir).
+from sheeprl_tpu.utils.utils import machine_keyed_cache_dir  # noqa: E402
+
+_CACHE_DIR = machine_keyed_cache_dir(os.environ.get("SHEEPRL_TPU_TEST_CACHE", "/tmp/sheeprl_tpu_xla_cache"))
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
@@ -41,10 +46,15 @@ def tmp_logdir(tmp_path):
 
 @pytest.fixture(autouse=True)
 def _reset_metric_state():
-    """Timers/aggregator flags are class-level; isolate tests."""
+    """Timers/aggregator flags are class-level; isolate tests. The gradient
+    wire dtype is process-wide and now DEFAULTS to bf16 for any multi-device
+    `Fabric.from_config` run — reset it so an e2e CLI test can't leak bf16
+    reduction into a later unit test's (f32-calibrated) numerics."""
+    from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
     from sheeprl_tpu.utils.metric import MetricAggregator
     from sheeprl_tpu.utils.timer import timer
 
+    set_grad_reduce_dtype("float32", fresh_run=True)
     yield
     timer.timers.clear()
     timer.disabled = False

@@ -1,0 +1,194 @@
+"""ppo_sebulba end-to-end: dry runs through the real CLI on host (dummy)
+envs, checkpoint → resume round trip, a real multi-rollout run exercising the
+bounded queue under several actors, and (slow lane) return parity vs the
+host-loop PPO on CartPole."""
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+SEBULBA_FAST = [
+    "exp=ppo_sebulba",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def _ckpts(root):
+    return sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_ppo_sebulba_dry_run(tmp_path, devices):
+    """devices=1 time-slices one chip between the actor and learner sides;
+    devices=2 splits them into disjoint single-device slices."""
+    run(
+        SEBULBA_FAST
+        + [
+            "dry_run=True",
+            "checkpoint.save_last=False",
+            f"fabric.devices={devices}",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+
+
+def test_ppo_sebulba_continuous(tmp_path):
+    run(
+        SEBULBA_FAST
+        + [
+            "dry_run=True",
+            "checkpoint.save_last=False",
+            "fabric.devices=1",
+            "env.id=continuous_dummy",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+
+
+def test_ppo_sebulba_many_actors_small_queue(tmp_path):
+    """More actors than queue slots for several learner iterations: the
+    bounded queue must back-pressure (not drop/deadlock) and the run must
+    consume exactly total_steps."""
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=1",
+            "algo.total_steps=128",
+            "algo.sebulba.num_actor_threads=3",
+            "algo.sebulba.queue_depth=1",
+            "algo.sebulba.publish_every=2",
+            "checkpoint.save_last=False",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+
+
+def test_ppo_sebulba_env_groups_amortized_inference(tmp_path):
+    """env_groups > 1: one inference dispatch drives several rollout columns
+    that are sliced into independent learner items — the learner's per-update
+    batch stays rollout_steps * env.num_envs, so the run must consume exactly
+    total_steps at the configured item shape."""
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=1",
+            "algo.total_steps=128",
+            "algo.sebulba.num_actor_threads=1",
+            "algo.sebulba.env_groups=3",
+            "checkpoint.save_last=False",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+
+
+def test_ppo_sebulba_checkpoint_resume_round_trip(tmp_path):
+    """Train with a mid-run checkpoint, resume from it, finish: counters
+    fast-forward and the final-step checkpoint appears (the same contract as
+    the host-loop round trip, learner-side saves + RNG-stream restore)."""
+    run(
+        SEBULBA_FAST
+        + [
+            f"log_root={tmp_path}/first",
+            "fabric.devices=1",
+            "algo.total_steps=64",
+            "checkpoint.every=32",
+            "checkpoint.save_last=False",
+        ]
+    )
+    first_ckpts = _ckpts(f"{tmp_path}/first")
+    assert first_ckpts, "no periodic checkpoint was written"
+
+    run(
+        SEBULBA_FAST
+        + [
+            f"log_root={tmp_path}/resumed",
+            "fabric.devices=1",
+            f"checkpoint.resume_from={first_ckpts[0]}",
+            "checkpoint.save_last=True",
+        ]
+    )
+    resumed = _ckpts(f"{tmp_path}/resumed")
+    assert resumed, "the resumed run saved no checkpoint"
+    assert any("ckpt_64" in c for c in resumed)  # old run's total_steps governs
+
+
+def test_ppo_sebulba_evaluation_from_checkpoint(tmp_path):
+    """The sebulba checkpoint shares the PPO layout: `evaluation()` loads it
+    through the shared ppo evaluate entrypoint."""
+    from sheeprl_tpu.cli import evaluation
+
+    run(
+        SEBULBA_FAST
+        + [
+            f"log_root={tmp_path}/logs",
+            "fabric.devices=1",
+            "algo.total_steps=32",
+            "checkpoint.save_last=True",
+        ]
+    )
+    ckpt = _ckpts(f"{tmp_path}/logs")[-1]
+    evaluation([f"checkpoint_path={ckpt}", "env.capture_video=False", "fabric.accelerator=cpu"])
+
+
+@pytest.mark.slow
+def test_ppo_sebulba_return_parity_with_host_loop_on_cartpole(tmp_path):
+    """Same recipe, same budget on real CartPole: the pipelined run's returns
+    must match the host loop's (the decoupling adds bounded staleness, not a
+    different algorithm). Asserted on the best trailing-window mean — both
+    runs must clear an absolute floor no non-learning agent reaches, and
+    sebulba must be within 40% of host-loop PPO."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from benchmarks.learning_bench import capture_returns
+
+    budget = 24576
+    common = [
+        "env=gym",
+        "env.id=CartPole-v1",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        "metric.log_every=70000",
+        "algo.run_test=False",
+        f"algo.total_steps={budget}",
+        "algo.rollout_steps=128",
+        "algo.per_rank_batch_size=64",
+        "algo.max_grad_norm=0.5",
+        "algo.vf_coef=0.5",
+        "algo.normalize_advantages=True",
+        "algo.optimizer.lr=3e-4",
+        "algo.mlp_keys.encoder=[state]",
+        "checkpoint.save_last=False",
+        "seed=7",
+    ]
+
+    def best_window(returns, w=10):
+        if len(returns) < w:
+            return 0.0
+        return max(sum(returns[i : i + w]) / w for i in range(len(returns) - w + 1))
+
+    host = capture_returns(["exp=ppo", f"log_root={tmp_path}/host"] + common)
+    seb = capture_returns(["exp=ppo_sebulba", f"log_root={tmp_path}/sebulba"] + common)
+    host_best, seb_best = best_window(host), best_window(seb)
+    assert host_best >= 100, f"host-loop PPO failed to learn CartPole: best10={host_best} n={len(host)}"
+    assert seb_best >= 100, f"ppo_sebulba failed to learn CartPole: best10={seb_best} n={len(seb)}"
+    assert seb_best >= 0.6 * host_best, (
+        f"ppo_sebulba returns not at parity: best10={seb_best} vs host {host_best}"
+    )

@@ -112,6 +112,42 @@ def test_ppo_trains_with_bf16_reduction(tmp_path):
     )
 
 
+def test_from_config_auto_defaults_bf16_on_multi_device_mesh():
+    """Round-4 backlog: bf16 is the DEFAULT wire dtype wherever there is an
+    actual wire (mesh > 1 device); `fabric.grad_reduce_dtype=float32` is the
+    exactness escape hatch."""
+    Fabric.from_config({"devices": 2, "accelerator": "cpu"})
+    assert get_grad_reduce_dtype() == jnp.bfloat16
+
+
+def test_from_config_auto_stays_f32_on_single_device():
+    """A 1-device 'collective' is a no-op: auto must not round gradients to
+    bf16 for nothing."""
+    Fabric.from_config({"devices": 1, "accelerator": "cpu"})
+    assert get_grad_reduce_dtype() is None
+
+
+def test_from_config_escape_hatch_forces_f32():
+    Fabric.from_config({"devices": 2, "accelerator": "cpu", "grad_reduce_dtype": "float32"})
+    assert get_grad_reduce_dtype() is None
+
+
+def test_auto_default_retrace_guard():
+    """The bf16 default must obey the same retrace guard as an explicit
+    setting: once a train step traced under the auto-resolved bf16 wire, a
+    mid-run flip warns about stale compiled steps."""
+    import warnings as _w
+
+    Fabric.from_config({"devices": 2, "accelerator": "cpu"})  # auto -> bf16, fresh run
+    _reduce({"g": jnp.ones((2, 4), jnp.float32)})  # traces under bf16
+    with pytest.warns(UserWarning, match="grad_reduce_dtype changed"):
+        set_grad_reduce_dtype("float32")  # mid-run flip: warns
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        # a NEW run boundary (from_config) must stay silent again
+        Fabric.from_config({"devices": 2, "accelerator": "cpu"})
+
+
 def test_run_boundary_does_not_false_warn(recwarn):
     """Back-to-back runs with different wire dtypes in one process (the
     dryrun harness pattern) must NOT trip the mid-run-flip warning —

@@ -1,0 +1,256 @@
+"""Sebulba pipeline primitives (parallel/pipeline.py): bounded-queue
+back-pressure, versioned param pub-sub with the documented staleness bound,
+ring-buffered staging, and Fabric device-slice partitioning."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.parallel.pipeline import (
+    DoubleBufferedStager,
+    ParamServer,
+    PipelineStats,
+    RolloutQueue,
+    staleness_bound,
+)
+
+
+# ---------------------------------------------------------------------------
+# RolloutQueue
+# ---------------------------------------------------------------------------
+
+
+def test_queue_backpressure_bounds_depth_under_slow_learner():
+    """A deliberately slow consumer must bound the queue at its depth and the
+    producer's blocked time must be charged to actor_stall_s."""
+    stats = PipelineStats()
+    q = RolloutQueue(depth=2, stats=stats)
+    stop = threading.Event()
+    produced = []
+
+    def producer():
+        for i in range(10):
+            if not q.put(i, stop_event=stop):
+                return
+            produced.append(i)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    consumed = []
+    for _ in range(10):
+        time.sleep(0.02)  # slow learner
+        consumed.append(q.get(timeout=5.0))
+    t.join(timeout=5.0)
+    assert consumed == list(range(10))  # FIFO, nothing lost
+    assert stats.max_depth_seen <= 2
+    assert stats.actor_stall_s > 0.0  # the producer was genuinely back-pressured
+    assert stats.rollouts_produced == 10 and stats.rollouts_consumed == 10
+
+
+def test_queue_put_unblocks_on_stop_event():
+    q = RolloutQueue(depth=1)
+    stop = threading.Event()
+    assert q.put("a", stop_event=stop)
+    result = {}
+
+    def blocked_put():
+        result["ok"] = q.put("b", stop_event=stop)
+
+    t = threading.Thread(target=blocked_put)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()  # blocked on the full queue
+    stop.set()
+    t.join(timeout=5.0)
+    assert result["ok"] is False  # dropped, not deadlocked
+
+
+def test_queue_get_records_starvation():
+    stats = PipelineStats()
+    q = RolloutQueue(depth=1, stats=stats)
+
+    def late_put():
+        time.sleep(0.05)
+        q.put("x")
+
+    t = threading.Thread(target=late_put)
+    t.start()
+    assert q.get(timeout=5.0) == "x"
+    t.join()
+    assert stats.learner_starved_s > 0.0
+
+
+# ---------------------------------------------------------------------------
+# ParamServer
+# ---------------------------------------------------------------------------
+
+
+def test_param_server_newest_wins_and_cadence():
+    ps = ParamServer({"w": 0}, publish_every=2)
+    assert ps.version == 0
+    assert not ps.maybe_publish(1, {"w": 1})  # update 1 of 2: no publish
+    assert ps.maybe_publish(2, {"w": 2})
+    assert ps.version == 1
+    v, p = ps.pull()
+    assert (v, p["w"]) == (1, 2)  # newest wins, intermediate never visible
+
+
+def test_param_server_caches_per_device():
+    dev = jax.devices("cpu")[0]
+    ps = ParamServer({"w": jnp.ones((4,))})
+    ps.publish({"w": jnp.full((4,), 2.0)})
+    v1, p1 = ps.pull(dev)
+    v2, p2 = ps.pull(dev)
+    assert v1 == v2 == 1
+    assert p1 is p2  # second pull of the same version is the cached placement
+    np.testing.assert_allclose(np.asarray(p1["w"]), 2.0)
+
+
+def test_staleness_bound_holds_under_slow_learner():
+    """Single fast actor against a deliberately slow learner publishing every
+    K updates: the version gap between the learner's live params and the
+    params a consumed rollout was collected under must respect
+    staleness_bound(). With one actor the bound is exact (FIFO: only items
+    enqueued before ours — at most queue_depth + 1 in flight — can train
+    ahead of it); with several actors it is the steady-state bound, racy to
+    assert under arbitrary thread scheduling."""
+    depth, K = 2, 2
+    bound = staleness_bound(depth, 1, K)
+    stats = PipelineStats()
+    q = RolloutQueue(depth, stats=stats)
+    ps = ParamServer({"step": 0}, publish_every=K, stats=stats)
+    ps.publish({"step": 0})
+    stop = threading.Event()
+
+    def actor():
+        while not stop.is_set():
+            v, _p = ps.pull()  # newest-wins: staleness 0 at rollout start
+            if not q.put({"version": v}, stop_event=stop):
+                return
+
+    t = threading.Thread(target=actor)
+    t.start()
+    max_staleness = 0
+    for update in range(1, 40):
+        item = q.get(timeout=5.0)
+        time.sleep(0.005)  # deliberately slow learner
+        ps.maybe_publish(update, {"step": update})
+        staleness = ps.version - item["version"]
+        max_staleness = max(max_staleness, staleness)
+    stop.set()
+    q.drain()
+    t.join(timeout=5.0)
+    assert max_staleness <= bound, f"staleness {max_staleness} exceeded bound {bound}"
+    assert max_staleness > 0  # the pipeline actually ran ahead of the actor
+
+
+def test_staleness_bound_formula():
+    assert staleness_bound(2, 2, 1) == 5
+    assert staleness_bound(2, 3, 2) == 3
+    assert staleness_bound(1, 1, 4) == 1
+
+
+# ---------------------------------------------------------------------------
+# DoubleBufferedStager
+# ---------------------------------------------------------------------------
+
+
+def test_stager_source_arrays_immediately_reusable():
+    """The caller's arrays (replay-buffer views) may be overwritten right
+    after stage(); the staged device values must not change."""
+    fabric = Fabric(devices=1, accelerator="cpu")
+    stager = DoubleBufferedStager(fabric.data_sharding, slots=3)
+    src = {"a": np.arange(8, dtype=np.float32), "b": np.ones((8, 2), np.float32)}
+    staged = stager.stage(src)
+    src["a"][:] = -1.0  # scribble over the source, as the next rollout would
+    src["b"][:] = -1.0
+    np.testing.assert_allclose(np.asarray(staged["a"]), np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(staged["b"]), 1.0)
+
+
+def test_stager_ring_keeps_in_flight_rollouts_intact():
+    """Holding as many staged rollouts as the ring has slots must be safe —
+    the slab behind each is only recycled after `slots` later stagings."""
+    fabric = Fabric(devices=1, accelerator="cpu")
+    slots = 4
+    stager = DoubleBufferedStager(fabric.data_sharding, slots=slots)
+    held = []
+    for i in range(slots):
+        held.append(stager.stage({"x": np.full((4,), float(i), np.float32)}))
+    for i, staged in enumerate(held):
+        np.testing.assert_allclose(np.asarray(staged["x"]), float(i))
+
+
+def test_stager_passes_device_leaves_through():
+    """Already-on-device leaves (GAE outputs on the actor device) skip the
+    slab copy and still land under the target sharding."""
+    fabric = Fabric(devices=1, accelerator="cpu")
+    stager = DoubleBufferedStager(fabric.data_sharding, slots=2)
+    dev_leaf = jnp.arange(6, dtype=jnp.float32)
+    staged = stager.stage({"host": np.zeros((6,), np.float32), "dev": dev_leaf})
+    np.testing.assert_allclose(np.asarray(staged["dev"]), np.arange(6))
+    assert staged["dev"].sharding.is_equivalent_to(fabric.data_sharding, ndim=1)
+
+
+# ---------------------------------------------------------------------------
+# Fabric.partition (device-slice split)
+# ---------------------------------------------------------------------------
+
+
+def test_partition_disjoint_slices():
+    fabric = Fabric(devices=4, accelerator="cpu")
+    actor, learner = fabric.partition(1)
+    assert len(actor.devices) == 1 and len(learner.devices) == 3
+    assert set(actor.devices).isdisjoint(learner.devices)
+    assert learner.devices[0] is fabric.devices[0]  # learner keeps device 0
+    assert learner.mesh.axis_names == ("dp",)
+    assert learner.callbacks == fabric.callbacks and actor.callbacks == []
+
+
+def test_partition_auto_single_device_time_slices():
+    fabric = Fabric(devices=1, accelerator="cpu")
+    actor, learner = fabric.partition("auto")
+    assert len(learner.devices) == 1 and len(actor.devices) == 1
+    assert actor.devices[0] is learner.devices[0]  # shared chip
+
+
+def test_partition_auto_multi_device_dedicates_one_actor_chip():
+    fabric = Fabric(devices=2, accelerator="cpu")
+    actor, learner = fabric.partition("auto")
+    assert len(actor.devices) == 1 and len(learner.devices) == 1
+    assert actor.devices[0] is not learner.devices[0]
+
+
+def test_partition_rejects_consuming_all_devices():
+    fabric = Fabric(devices=2, accelerator="cpu")
+    with pytest.raises(ValueError, match="learner device"):
+        fabric.partition(2)
+
+
+def test_partition_reresolves_auto_wire_dtype():
+    """The gradient collective runs on the LEARNER mesh: an auto-resolved
+    bf16 wire (full fabric had 2 devices) must drop back to f32 when the
+    carved learner mesh is a single device (no wire), and stay bf16 when the
+    learner keeps several."""
+    from sheeprl_tpu.parallel.comm import get_grad_reduce_dtype
+
+    f = Fabric.from_config({"devices": 2, "accelerator": "cpu"})
+    assert get_grad_reduce_dtype() == jnp.bfloat16
+    f.partition("auto")  # learner = 1 device
+    assert get_grad_reduce_dtype() is None
+
+    f8 = Fabric.from_config({"devices": 8, "accelerator": "cpu"})
+    f8.partition(1)  # learner = 7 devices: the wire is real
+    assert get_grad_reduce_dtype() == jnp.bfloat16
+
+
+def test_partition_inherits_precision():
+    fabric = Fabric(devices=2, accelerator="cpu", precision="bf16-mixed")
+    actor, learner = fabric.partition(1)
+    assert actor.precision == fabric.precision
+    assert learner.precision == fabric.precision
