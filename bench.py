@@ -18,7 +18,14 @@ recorded trajectory stays comparable):
 - ``sebulba`` — ``ppo_cartpole_sebulba_env_steps_per_sec``: the decoupled
   actor/learner pipeline (``exp=ppo_sebulba_benchmarks``, same
   model/optim/data conditions) with host env stepping, inference and
-  learning overlapped (howto/decoupled_training.md).
+  learning overlapped (howto/decoupled_training.md);
+- ``replay`` — ``sac_pendulum_replay_grad_steps_per_sec``: SAC
+  gradient-steps/s through the replay data path
+  (``exp=sac_replay_benchmarks``, replay-ratio-4 so sampling dominates).
+  ``BENCH_REPLAY_MODE=device`` (default) runs the device-resident ring
+  (``buffer.device_resident=true``, howto/device_replay.md);
+  ``BENCH_REPLAY_MODE=host`` runs the host-sampling path — the paired
+  driver compares the two on the same topology.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -76,8 +83,14 @@ def main() -> None:
         metric = "ppo_cartpole_sebulba_env_steps_per_sec"
         exp = "ppo_sebulba_benchmarks"
         default_steps = 65536
+    elif which in ("replay", "sac_pendulum_replay_grad_steps_per_sec"):
+        metric = "sac_pendulum_replay_grad_steps_per_sec"
+        exp = "sac_replay_benchmarks"
+        default_steps = 8192
     else:
-        raise SystemExit(f"Unknown BENCH_METRIC '{which}' (expected 'host', 'ondevice' or 'sebulba')")
+        raise SystemExit(
+            f"Unknown BENCH_METRIC '{which}' (expected 'host', 'ondevice', 'sebulba' or 'replay')"
+        )
     total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", default_steps))
     overrides = [
         f"exp={exp}",
@@ -88,11 +101,58 @@ def main() -> None:
         "metric.log_level=0",
         "metric.disable_timer=True",
     ]
+    replay_mode = None
+    if metric == "sac_pendulum_replay_grad_steps_per_sec":
+        replay_mode = os.environ.get("BENCH_REPLAY_MODE", "device").strip().lower()
+        if replay_mode not in ("device", "host"):
+            raise SystemExit(f"Unknown BENCH_REPLAY_MODE '{replay_mode}' (expected 'device' or 'host')")
+        overrides.append(f"buffer.device_resident={'true' if replay_mode == 'device' else 'false'}")
+        # keep the Time/replay_path_time instrumentation alive: with
+        # log_level=0 nothing ever resets it, so the accumulated sum is
+        # readable after the run
+        overrides.remove("metric.disable_timer=True")
+        overrides.append("metric.disable_timer=False")
     from sheeprl_tpu.cli import run
 
     start = time.perf_counter()
     run(overrides)
     elapsed = time.perf_counter() - start
+    if metric == "sac_pendulum_replay_grad_steps_per_sec":
+        # Both modes execute the identical grant schedule (same Ratio, same
+        # seeds), so per-mode throughput is directly comparable. Two views:
+        # - end-to-end grad-steps/s (whole wall): on a CPU-only host the two
+        #   modes tie — the gradient math dominates and there is no device
+        #   boundary to cross;
+        # - grad-steps per second of REPLAY-PATH time: the serialized
+        #   host-side sample+stage segment each gradient step waits on —
+        #   numpy sampling + device staging for the host tier vs one packed
+        #   blob for the resident tier. This is exactly the host-in-the-loop
+        #   cost the subsystem removes (and what a tunneled TPU multiplies
+        #   by the wire latency), so it is the headline `value`.
+        from sheeprl_tpu.config import compose
+        from sheeprl_tpu.utils.timer import timer as _timer
+
+        cfg = compose([f"exp={exp}", f"algo.total_steps={total_steps}"])
+        grad_steps = max(1, int(cfg.algo.replay_ratio * (total_steps - cfg.algo.learning_starts)))
+        replay_path_s = _timer.compute().get("Time/replay_path_time", 0.0)
+        value = grad_steps / replay_path_s if replay_path_s > 0 else 0.0
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": round(value, 2),
+                    "unit": "grad-steps per replay-path second",
+                    "mode": replay_mode,
+                    "grad_steps": grad_steps,
+                    "replay_path_s": round(replay_path_s, 3),
+                    "end_to_end_grad_steps_per_sec": round(grad_steps / elapsed, 2),
+                    "elapsed_s": round(elapsed, 2),
+                    # no vs_baseline: the PPO reference bar is env-steps/s —
+                    # dividing grad-steps/s by it would be a unit mismatch
+                }
+            )
+        )
+        return
     steps_per_sec = total_steps / elapsed
     print(
         json.dumps(

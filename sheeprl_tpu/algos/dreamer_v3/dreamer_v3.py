@@ -46,7 +46,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     prepare_obs,
     test,
 )
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer, put_packed
 from sheeprl_tpu.data.ring import build_burst_train_step, ring_append_rows, ring_sample_windows
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
@@ -470,11 +470,16 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
+    resident_restore = None  # a DeviceReplayState checkpointed by the resident path
     if state is not None and cfg.buffer.checkpoint:
+        from sheeprl_tpu.replay import DeviceReplayState
+
         if isinstance(state["rb"], list):
             rb = state["rb"][0]
         elif isinstance(state["rb"], EnvIndependentReplayBuffer):
             rb = state["rb"]
+        elif isinstance(state["rb"], DeviceReplayState):
+            resident_restore = state["rb"]
         else:
             raise RuntimeError(f"Cannot restore the replay buffer from {type(state['rb'])}")
 
@@ -530,17 +535,57 @@ def main(fabric, cfg: Dict[str, Any]):
     # pixels is ~12.6 MB per gradient step).
     hp_cfg = cfg.algo.get("hybrid_player") or {}
     burst_mode = resolve_hybrid_player(hp_cfg, fabric.mesh)
+
+    # Device-resident replay on the coupled topology (howto/device_replay.md):
+    # the sequence ring lives in HBM (pixels stay uint8), windows are sampled
+    # in-graph, and every env step dispatches ONE fused append+train program.
+    # The hybrid burst path is already device-resident (and asynchronous), so
+    # it takes precedence; capacities beyond the HBM budget spill back to the
+    # host (memmap-capable) buffer below.
+    resident_mode = False
+    resident_driver = None
+    if not burst_mode:
+        from sheeprl_tpu.replay import resolve_device_resident
+        from sheeprl_tpu.utils.burst import dreamer_ring_keys
+
+        resident_ring_keys = dreamer_ring_keys(
+            observation_space, cfg.algo.cnn_keys.encoder, cfg.algo.mlp_keys.encoder,
+            actions_dim, with_is_first=True,
+        )
+        resident_mode, _, resident_reason = resolve_device_resident(
+            cfg.buffer.get("device_resident", False),
+            resident_ring_keys,
+            buffer_size,
+            int(cfg.env.num_envs),
+            fabric.world_size,
+            float(cfg.buffer.get("hbm_budget_gb", 4.0)),
+            allow_shard=False,  # the sequence-ring burst program is replicated
+        )
+        if cfg.metric.log_level > 0 and cfg.buffer.get("device_resident", False):
+            print(f"Replay: device_resident={resident_mode} ({resident_reason})")
+    if resident_restore is not None and not resident_mode:
+        # resident checkpoint resumed onto a non-resident path (knob flipped
+        # off, spillover, or hybrid-burst precedence): fill the host per-env
+        # buffers so the collected experience survives the crossover
+        from sheeprl_tpu.replay import restore_host_env_buffer
+
+        restore_host_env_buffer(
+            resident_restore, rb, fill_missing={"truncated": ((1,), np.float32)}
+        )
+
     # The host replay mirror only matters for checkpoints once the device
     # ring owns sampling; without it every pixel transition would be stored
-    # twice (HBM ring + host RAM/memmap).
-    host_mirror = (not burst_mode) or bool(cfg.buffer.checkpoint)
+    # twice (HBM ring + host RAM/memmap). The resident ring checkpoints
+    # itself (DeviceReplayState), so it never needs the mirror.
+    host_mirror = (not burst_mode and not resident_mode) or (burst_mode and bool(cfg.buffer.checkpoint))
 
     # Divergence sentinel on the host-sampled train path (the burst trainer
-    # thread keeps its own metric plumbing; its guard is future work).
+    # thread keeps its own metric plumbing; its guard is future work, and the
+    # resident burst program shares that in-graph machinery).
     from sheeprl_tpu.fault import DivergenceSentinel
 
     sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
-    guard = bool(sentinel_cfg.get("enabled", True)) and not burst_mode
+    guard = bool(sentinel_cfg.get("enabled", True)) and not burst_mode and not resident_mode
     sentinel = DivergenceSentinel(sentinel_cfg)
     ckpt_dir = os.path.join(log_dir, "checkpoint")
 
@@ -585,6 +630,29 @@ def main(fabric, cfg: Dict[str, Any]):
             discrete_size=int(wm_cfg_.discrete_size),
             host_device=hp.host_device,
         )
+    elif resident_mode:
+        from sheeprl_tpu.replay import SequenceRingDriver
+
+        resident_chunk = max(1, int(np.ceil(cfg.algo.replay_ratio * policy_steps_per_iter)))
+        resident_driver = SequenceRingDriver(
+            fabric,
+            resident_ring_keys,
+            capacity=buffer_size,
+            n_envs=int(cfg.env.num_envs),
+            seq_len=seq_len,
+            batch_size=batch_size,
+            grad_chunk=resident_chunk,
+            make_burst_fn=lambda ring: make_train_step(
+                world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs, ring=ring
+            ),
+            seed=cfg.seed + 31,
+            # resume: prefer the exact ring snapshot; fall back to mirroring
+            # a host-buffer checkpoint into HBM
+            restore=resident_restore
+            if resident_restore is not None
+            else (rb if (state is not None and cfg.buffer.checkpoint) else None),
+        )
+        resident_carry = (params, opts, moments_state, jnp.int32(0))
     else:
         train_fn = make_train_step(
             world_model, actor, critic, cfg, fabric.mesh, actions_dim, is_continuous, txs, guard=guard
@@ -647,6 +715,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             if burst_mode:
                 hp.stage_step(step_data)
+            elif resident_mode:
+                resident_driver.stage_step(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -674,6 +744,8 @@ def main(fabric, cfg: Dict[str, Any]):
                         # Same truncation patch on the row still in staging
                         # (truncated isn't stored in the device ring).
                         hp.patch_last(i, {"terminated": 0.0, "is_first": 0.0})
+                    elif resident_mode:
+                        resident_driver.patch_last(i, {"terminated": 0.0, "is_first": 0.0})
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             ep_info = infos["final_info"]
@@ -720,6 +792,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             if burst_mode:
                 hp.stage_reset(reset_data, dones_idxes)
+            elif resident_mode:
+                resident_driver.stage_reset(reset_data, dones_idxes)
 
             # Reset already-inserted step data (reference: dreamer_v3.py:652-658)
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
@@ -737,6 +811,22 @@ def main(fabric, cfg: Dict[str, Any]):
                 hp.grant(ratio(policy_step - prefill_steps * policy_steps_per_iter))
             hp.pump()
             cumulative_per_rank_gradient_steps, train_step = hp.gradient_steps, hp.train_steps
+        elif resident_mode:
+            if iter_num >= learning_starts:
+                resident_driver.grant(ratio(policy_step - prefill_steps * policy_steps_per_iter))
+            # ONE fused append+sample+train dispatch per env step (plus
+            # append-free drains while a full grant chunk is backlogged)
+            with timer("Time/train_time", SumMetric):
+                resident_carry, resident_metrics = resident_driver.pump(resident_carry)
+            params, opts, moments_state = resident_carry[:3]
+            if resident_metrics is not None and aggregator and not aggregator.disabled:
+                from sheeprl_tpu.utils.burst import DREAMER_METRIC_NAMES
+
+                for name, value in zip(DREAMER_METRIC_NAMES, resident_metrics):
+                    if name in aggregator:
+                        aggregator.update(name, value)
+            cumulative_per_rank_gradient_steps = resident_driver.gradient_steps
+            train_step = resident_driver.train_steps
         elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps * policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
@@ -745,9 +835,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     sequence_length=seq_len,
                     n_samples=per_rank_gradient_steps,
                 )  # (G, T, B, ...)
-                data = {
-                    k: jax.device_put(np.asarray(v, dtype=np.float32), data_sharding) for k, v in sample.items()
-                }
+                # ONE packed sharded transfer for the whole sample dict (the
+                # PR-3 stager trick) instead of K per-key device_put dispatches
+                data = put_packed(sample, data_sharding, dtype=np.float32)
                 with timer("Time/train_time", SumMetric):
                     rng, train_key = jax.random.split(rng)
                     params, opts, moments_state, metrics = train_fn(
@@ -792,6 +882,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     sentinel.recover(ckpt_dir, _rollback)
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if resident_mode:
+                logger.log_dict(resident_driver.metrics(), policy_step)
             if aggregator and not aggregator.disabled:
                 logger.log_dict(aggregator.compute(), policy_step)
                 aggregator.reset()
@@ -843,11 +935,16 @@ def main(fabric, cfg: Dict[str, Any]):
                 "rng": rng,
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            replay_ckpt = None
+            if cfg.buffer.checkpoint:
+                # resident mode checkpoints the device ring itself (pulled to
+                # host as a DeviceReplayState), per-env heads included
+                replay_ckpt = resident_driver.state_dict() if resident_mode else rb
             fabric.call(
                 "on_checkpoint_coupled",
                 ckpt_path=ckpt_path,
                 state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
+                replay_buffer=replay_ckpt,
             )
 
     if burst_mode:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import copy
 import os
+import time
 import warnings
 from typing import Any, Dict
 
@@ -34,7 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sheeprl_tpu.algos.sac.agent import SACAgent, build_agent
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.buffers import ReplayBuffer, put_packed
 from sheeprl_tpu.data.ring import pack_burst_blob
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.parallel.comm import pmean_grads
@@ -45,7 +46,7 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
 from sheeprl_tpu.parallel.compat import shard_map
 
-__all__ = ["main", "make_train_step"]
+__all__ = ["main", "make_train_step", "make_resident_train_step"]
 
 
 def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, donate: bool = True, guard: bool = False):
@@ -297,6 +298,291 @@ def make_burst_train_step(
     return jax.jit(packed_train, donate_argnums=(4,)), layout
 
 
+def make_resident_train_step(
+    agent: SACAgent,
+    actor_tx,
+    critic_tx,
+    alpha_tx,
+    cfg,
+    mesh,
+    drb,
+    grad_max: int,
+    guard: bool = False,
+    donate: bool = True,
+):
+    """Fused append + in-graph sample + G-step update against a
+    :class:`~sheeprl_tpu.replay.DeviceReplayBuffer` (the ``buffer.
+    device_resident`` path; see ``howto/device_replay.md``).
+
+    One dispatch per env step does ALL of: append the staged transition row
+    into the HBM ring (donated in-place scatter), draw every granted
+    minibatch with device RNG — uniform over the valid ``(position, env)``
+    grid, or proportional via the in-graph sum-tree when
+    ``buffer.priority.enabled`` — and run the critic/EMA/actor/alpha updates
+    as one scan. The write head, train-key stream, and PER tree live on
+    device inside the replay state, so nothing round-trips to the host.
+
+    Signature of the returned jitted fn::
+
+        fn(params, aopt, copt, lopt, rb_state, blob)
+            -> (params, aopt, copt, lopt, rb_state, qf, actor, alpha, skipped)
+
+    ``blob`` is the packed flush from ``drb.make_job`` carrying the staged
+    row, the per-step EMA flags, the granted-step valid mask, and the PER
+    beta; ``skipped`` counts guard-rejected steps (0 when ``guard=False``).
+    """
+    from sheeprl_tpu.data.ring import unpack_burst_blob
+    from sheeprl_tpu.replay import sumtree as st
+
+    gamma = float(cfg.algo.gamma)
+    target_entropy = agent.target_entropy
+    n_dev = mesh.devices.size
+    capacity = drb.capacity
+    n_envs = drb.n_envs
+    e_local = drb.local_envs
+    prioritized = drb.prioritized
+    per_alpha = drb.per_alpha
+    per_eps = drb.per_eps
+    B = int(cfg.algo.per_rank_batch_size) // n_dev
+    layout = drb.layout
+
+    def minibatch_step(carry, xs, storage, vld, beta):
+        # Padding steps beyond the granted chunk skip EVERYTHING via
+        # lax.cond — sampling, losses, optimizer updates, and (crucially)
+        # any params/opts select traffic (an unconditional jnp.where over
+        # the train state costs ~1 ms/step of pure memory traffic on CPU).
+        key, ema_flag, valid = xs
+
+        def _run(carry):
+            return _train_minibatch(carry, key, ema_flag, storage, vld, beta)
+
+        def _skip(carry):
+            zeros = jnp.float32(0.0)
+            return carry, (zeros, zeros, zeros, zeros)
+
+        return jax.lax.cond(valid > 0, _run, _skip, carry)
+
+    def _train_minibatch(carry, key, ema_flag, storage, vld, beta, batch=None):
+        params, aopt, copt, lopt, tree, max_p = carry
+        old = (params, aopt, copt, lopt, tree, max_p)
+
+        if batch is None:
+            # -- in-graph sample (replay/indices semantics: uniform over the
+            # valid grid — next-obs is stored explicitly, so no head
+            # exclusion, exactly like the host buffer with
+            # sample_next_obs=False)
+            k_a, k_b, k_next, k_actor = jax.random.split(key, 4)
+            if prioritized:
+                u = jax.random.uniform(k_a, (B,))
+                leaf = st.sample(tree, u)
+                pos_idx = leaf // n_envs
+                env_idx = leaf % n_envs
+                w = st.importance_weights(tree, leaf, vld * n_envs, beta)
+                w = w / jnp.maximum(jax.lax.pmax(w.max(), "dp"), 1e-12)
+            else:
+                pos_idx = jax.random.randint(k_a, (B,), 0, jnp.maximum(vld, 1))
+                env_idx = jax.random.randint(k_b, (B,), 0, e_local)
+                w = jnp.ones((B,), jnp.float32)
+            batch = {
+                k: storage[k][pos_idx, env_idx]
+                for k in ("observations", "next_observations", "actions", "rewards", "terminated")
+            }
+        else:
+            # pre-gathered variant: the batch arrives through the scan xs
+            k_next, k_actor = jax.random.split(key)
+            w = jnp.ones((jax.tree.leaves(batch)[0].shape[0],), jnp.float32)
+
+        td_target = agent.next_target_q(
+            params, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k_next
+        )
+        td_target = jax.lax.stop_gradient(td_target)
+
+        def c_loss(cp):
+            q = agent.q_values(cp, batch["observations"], batch["actions"])
+            err2 = (q - td_target) ** 2
+            # IS-weighted per-sample MSE (reduces to loss.critic_loss at w=1)
+            return jnp.sum(jnp.mean(w[:, None] * err2, axis=0)), q
+
+        (qf_loss, q_vals), cgrads = jax.value_and_grad(c_loss, has_aux=True)(params["critic"])
+        cgrads = pmean_grads(cgrads, "dp")
+        cupd, copt = critic_tx.update(cgrads, copt, params["critic"])
+        params = {**params, "critic": optax.apply_updates(params["critic"], cupd)}
+        params = {**params, "target_critic": agent.ema(params["critic"], params["target_critic"], ema_flag)}
+
+        alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+
+        def a_loss(ap):
+            actions, logp = agent.sample_action(ap, batch["observations"], k_actor)
+            q = agent.q_values(params["critic"], batch["observations"], actions)
+            return policy_loss(alpha, logp, jnp.min(q, axis=-1, keepdims=True)), logp
+
+        (actor_loss, logp), agrads = jax.value_and_grad(a_loss, has_aux=True)(params["actor"])
+        agrads = pmean_grads(agrads, "dp")
+        aupd, aopt = actor_tx.update(agrads, aopt, params["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], aupd)}
+
+        def l_loss(la):
+            return entropy_loss(la, jax.lax.stop_gradient(logp), target_entropy)
+
+        alpha_loss, lgrads = jax.value_and_grad(l_loss)(params["log_alpha"])
+        lgrads = pmean_grads(lgrads, "dp")
+        lupd, lopt = alpha_tx.update(lgrads, lopt, params["log_alpha"])
+        params = {**params, "log_alpha": optax.apply_updates(params["log_alpha"], lupd)}
+
+        if prioritized:
+            # |TD| → new priorities; the tree is replicated, so every device
+            # applies the SAME update: all-gather the per-device leaf/prio
+            # shards before the set+rebuild
+            td_abs = jnp.mean(jnp.abs(jax.lax.stop_gradient(q_vals) - td_target), axis=-1)
+            new_prio = jnp.power(td_abs + per_eps, per_alpha)
+            leaf_all = jax.lax.all_gather(leaf, "dp").reshape(-1)
+            prio_all = jax.lax.all_gather(new_prio, "dp").reshape(-1)
+            tree = st.update(tree, leaf_all, prio_all)
+            max_p = jnp.maximum(max_p, jax.lax.pmax(new_prio.max(), "dp"))
+
+        skipped = jnp.float32(0.0)
+        if guard:
+            from sheeprl_tpu.ops import finite_guard, guarded_select
+
+            ok = finite_guard((cgrads, agrads, lgrads, qf_loss, actor_loss, alpha_loss))
+            ok = jax.lax.pmin(ok.astype(jnp.int32), "dp").astype(bool)
+            params, aopt, copt, lopt, tree, max_p = guarded_select(
+                ok, (params, aopt, copt, lopt, tree, max_p), old
+            )
+            skipped = 1.0 - ok.astype(jnp.float32)
+
+        return (params, aopt, copt, lopt, tree, max_p), (qf_loss, actor_loss, alpha_loss, skipped)
+
+    if not prioritized and not drb.shard_envs:
+        # Pre-gathered variant (replicated storage + uniform sampling — the
+        # common case): uniform draws are carry-independent, so ALL (G, B)
+        # indices are drawn and gathered ONCE in the outer jit. The ring
+        # never crosses the shard_map boundary (whose replicated outputs
+        # cost a full-storage copy per dispatch), donation aliases it in
+        # place, and the sharded scan consumes the exact (G, B)-sharded
+        # data layout the host path's train step uses.
+        def pre_step(carry, xs):
+            batch, key, ema_flag, valid = xs
+
+            def _run(c):
+                return _train_minibatch(c, key, ema_flag, None, None, None, batch=batch)
+
+            def _skip(c):
+                zeros = jnp.float32(0.0)
+                return c, (zeros, zeros, zeros, zeros)
+
+            return jax.lax.cond(valid > 0, _run, _skip, carry)
+
+        def pre_local_train(params, aopt, copt, lopt, data, key, flags, valid):
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            keys = jax.random.split(key, grad_max)
+            carry = (params, aopt, copt, lopt, jnp.zeros((2,), jnp.float32), jnp.ones((), jnp.float32))
+            carry, outs = jax.lax.scan(pre_step, carry, (data, keys, flags, valid))
+            params, aopt, copt, lopt = carry[:4]
+            qf, al, ll, skipped = outs
+            denom = jnp.maximum(valid.sum(), 1.0)
+            qf, al, ll = jax.tree.map(
+                lambda x: jax.lax.pmean((x * valid).sum() / denom, "dp"), (qf, al, ll)
+            )
+            return params, aopt, copt, lopt, qf, al, ll, skipped.sum()
+
+        pre_shard = shard_map(
+            pre_local_train,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(None, "dp"), P(), P(), P()),
+            out_specs=(P(),) * 8,
+            check_vma=False,
+        )
+
+        def packed_pre(params, aopt, copt, lopt, rb_state, blob):
+            u = unpack_burst_blob(blob, layout)
+            staged = {k: u[k] for k in drb.specs}
+            storage = rb_state["storage"]
+            count = u["__count__"]
+            # append: one in-place scatter; count==0 targets row `capacity`
+            # and is dropped (backlog-drain dispatch)
+            idx = jnp.where(count > 0, rb_state["pos"], capacity)
+            storage = {k: storage[k].at[idx].set(staged[k][0], mode="drop") for k in storage}
+            new_pos = (rb_state["pos"] + count) % capacity
+            new_vld = jnp.minimum(rb_state["valid"] + count, capacity)
+            state_key, sub = jax.random.split(rb_state["key"])
+            k_pos, k_env, k_scan = jax.random.split(sub, 3)
+            shape = (grad_max, B * n_dev)
+            pos_idx = jax.random.randint(k_pos, shape, 0, jnp.maximum(new_vld, 1))
+            env_idx = jax.random.randint(k_env, shape, 0, n_envs)
+            data = {
+                k: storage[k][pos_idx, env_idx]
+                for k in ("observations", "next_observations", "actions", "rewards", "terminated")
+            }
+            params, aopt, copt, lopt, qf, al, ll, skipped = pre_shard(
+                params, aopt, copt, lopt, data, k_scan, u["__flags__"], u["__valid__"]
+            )
+            new_state = {"storage": storage, "pos": new_pos, "valid": new_vld, "key": state_key}
+            return params, aopt, copt, lopt, new_state, qf, al, ll, skipped
+
+        return jax.jit(packed_pre, donate_argnums=(0, 1, 2, 3, 4) if donate else (4,))
+
+    def local_train(params, aopt, copt, lopt, storage, pos, vld, state_key, tree, max_p,
+                    staged, count, flags, valid, beta):
+        # -- append: one in-place scatter; count==0 (backlog-drain dispatch)
+        # targets row `capacity` and is dropped
+        idx = jnp.where(count > 0, pos, capacity)
+        storage = {k: storage[k].at[idx].set(staged[k][0], mode="drop") for k in storage}
+        new_pos = (pos + count) % capacity
+        new_vld = jnp.minimum(vld + count, capacity)
+        if prioritized:
+            # fresh transitions enter at the running max priority
+            leaves = pos * n_envs + jnp.arange(n_envs, dtype=jnp.int32)
+            prio = jnp.where(count > 0, max_p, st.get(tree, leaves))
+            tree = st.update(tree, leaves, prio)
+
+        state_key, sub = jax.random.split(state_key)
+        step_keys = jax.random.split(jax.random.fold_in(sub, jax.lax.axis_index("dp")), grad_max)
+        carry = (params, aopt, copt, lopt, tree, max_p)
+        carry, outs = jax.lax.scan(
+            lambda c, xs: minibatch_step(c, xs, storage, new_vld, beta),
+            carry,
+            (step_keys, flags, valid),
+        )
+        params, aopt, copt, lopt, tree, max_p = carry
+        qf, al, ll, skipped = outs
+        denom = jnp.maximum(valid.sum(), 1.0)
+        qf, al, ll = jax.tree.map(
+            lambda x: jax.lax.pmean((x * valid).sum() / denom, "dp"), (qf, al, ll)
+        )
+        return (params, aopt, copt, lopt, storage, new_pos, new_vld, state_key, tree, max_p,
+                qf, al, ll, skipped.sum())
+
+    storage_spec = P(None, "dp") if drb.shard_envs else P()
+    shard_train = shard_map(
+        local_train,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), storage_spec, P(), P(), P(), P(), P(),
+                  storage_spec, P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), storage_spec, P(), P(), P(), P(), P(), P(), P(), P(), P()),
+        check_vma=False,
+    )
+
+    def packed(params, aopt, copt, lopt, rb_state, blob):
+        u = unpack_burst_blob(blob, layout)
+        staged = {k: u[k] for k in drb.specs}
+        tree = rb_state.get("tree", jnp.zeros((2,), jnp.float32))
+        max_p = rb_state.get("max_p", jnp.ones((), jnp.float32))
+        (params, aopt, copt, lopt, storage, pos, vld, key, tree, max_p, qf, al, ll, skipped
+         ) = shard_train(
+            params, aopt, copt, lopt,
+            rb_state["storage"], rb_state["pos"], rb_state["valid"], rb_state["key"], tree, max_p,
+            staged, u["__count__"], u["__flags__"], u["__valid__"], u["__beta__"],
+        )
+        new_state = {"storage": storage, "pos": pos, "valid": vld, "key": key}
+        if prioritized:
+            new_state["tree"] = tree
+            new_state["max_p"] = max_p
+        return params, aopt, copt, lopt, new_state, qf, al, ll, skipped
+
+    return jax.jit(packed, donate_argnums=(0, 1, 2, 3, 4) if donate else (4,))
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.fault import load_resume_state
@@ -368,11 +654,21 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         obs_keys=("observations",),
     )
+    resident_restore = None  # a DeviceReplayState checkpointed by the resident path
     if state is not None and cfg.buffer.checkpoint:
+        from sheeprl_tpu.replay import DeviceReplayState
+
         if isinstance(state["rb"], list):
             rb = state["rb"][0]
         elif isinstance(state["rb"], ReplayBuffer):
             rb = state["rb"]
+        elif isinstance(state["rb"], DeviceReplayState):
+            resident_restore = state["rb"]
+            # fill the host buffer too, so a resume that lands on the host
+            # path (spillover, knob flipped off, hybrid burst) keeps the data
+            from sheeprl_tpu.replay.device_buffer import restore_host_buffer
+
+            restore_host_buffer(resident_restore, rb, fill_missing={"truncated": ((1,), np.uint8)})
         else:
             raise RuntimeError(f"Cannot restore the replay buffer from {type(state['rb'])}")
 
@@ -464,8 +760,46 @@ def main(fabric, cfg: Dict[str, Any]):
     # transfer is still in flight; SAC params are tiny, so keep them.
     train_fn = None
     burst_fn = None
+    resident_fn = None
     obs_dim = int(sum(np.prod(observation_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
     act_dim = int(np.prod(action_space.shape))
+
+    # Device-resident replay (howto/device_replay.md): the HBM ring +
+    # in-graph sampling makes sample+train ONE dispatch per env step. The
+    # hybrid burst path is already device-resident (and asynchronous), so
+    # the knob targets the standard coupled topology only; capacities beyond
+    # the HBM budget spill over to the host buffer path below.
+    resident_mode = False
+    drb = None
+    resident_specs = {
+        "observations": ((obs_dim,), jnp.float32),
+        "next_observations": ((obs_dim,), jnp.float32),
+        "actions": ((act_dim,), jnp.float32),
+        "rewards": ((1,), jnp.float32),
+        "terminated": ((1,), jnp.float32),
+    }
+    per_cfg = cfg.buffer.get("priority") or {}
+    prioritized = bool(per_cfg.get("enabled", False))
+    if not burst_mode:
+        from sheeprl_tpu.replay import resolve_device_resident
+
+        resident_mode, shard_envs, resident_reason = resolve_device_resident(
+            cfg.buffer.get("device_resident", False),
+            resident_specs,
+            buffer_size,
+            int(cfg.env.num_envs),
+            fabric.world_size,
+            float(cfg.buffer.get("hbm_budget_gb", 4.0)),
+            prioritized,
+        )
+        if resident_mode and cfg.buffer.sample_next_obs:
+            warnings.warn(
+                "buffer.sample_next_obs stores no explicit next observation; the device-resident "
+                "ring needs one — falling back to the host buffer path."
+            )
+            resident_mode = False
+        if cfg.metric.log_level > 0 and cfg.buffer.get("device_resident", False):
+            print(f"Replay: device_resident={resident_mode} ({resident_reason})")
     if burst_mode:
         grad_chunk = max(1, int(round(cfg.algo.replay_ratio * policy_steps_per_iter * train_every)))
         # Sized from the CONFIGURED warmup, not the resume-shifted
@@ -569,6 +903,37 @@ def main(fabric, cfg: Dict[str, Any]):
             if chunk > 0:
                 cumulative_per_rank_gradient_steps += chunk
                 train_step += 1
+    elif resident_mode:
+        from sheeprl_tpu.replay import DeviceReplayBuffer
+
+        grad_max = max(1, int(np.ceil(cfg.algo.replay_ratio * policy_steps_per_iter)))
+        drb = DeviceReplayBuffer(
+            fabric,
+            resident_specs,
+            buffer_size,
+            int(cfg.env.num_envs),
+            prioritized=prioritized,
+            per_alpha=float(per_cfg.get("alpha", 0.6)),
+            per_eps=float(per_cfg.get("eps", 1e-6)),
+            shard_envs=shard_envs,
+            extra_spec=[
+                ("__flags__", (grad_max,), np.float32),
+                ("__valid__", (grad_max,), np.float32),
+                ("__beta__", (), np.float32),
+            ],
+            seed=cfg.seed + 29,
+        )
+        if resident_restore is not None:
+            drb.load_state_dict(resident_restore)
+        elif state is not None and cfg.buffer.checkpoint and not rb.empty:
+            # resumed from a host-buffer checkpoint: mirror it into HBM
+            drb.load_host_buffer(rb)
+        resident_fn = make_resident_train_step(
+            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, drb, grad_max,
+            guard=guard, donate=not hp_enabled,
+        )
+        ema_backlog = []
+        per_beta0 = float(per_cfg.get("beta", 0.4))
     else:
         train_fn = make_train_step(
             agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=not hp_enabled, guard=guard
@@ -658,7 +1023,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 [np.asarray(real_next_obs[k], dtype=np.float32) for k in mlp_keys], axis=-1
             ).reshape(1, cfg.env.num_envs, -1)
         step_data["rewards"] = rewards[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if resident_mode:
+            # the HBM ring is the only storage tier — no host duplicate; it
+            # is checkpointed directly (DeviceReplayState) below
+            drb.add(step_data)
+        else:
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
         obs = next_obs
 
@@ -677,17 +1047,80 @@ def main(fabric, cfg: Dict[str, Any]):
                 _flush_burst()
                 if len(ema_backlog) < grad_chunk:
                     break
+        elif resident_mode:
+            if iter_num >= learning_starts:
+                granted = ratio(policy_step - prefill_steps + policy_steps_per_iter)
+                ema_backlog.extend([1.0 if iter_num % ema_modulus == 0 else 0.0] * granted)
+            # ONE dispatch per env step: append the staged row + run up to
+            # grad_max granted steps sampled in-graph; extra append-free
+            # dispatches drain any backlog a big first grant left behind.
+            while True:
+                chunk = min(grad_max, len(ema_backlog))
+                flags = np.zeros((grad_max,), np.float32)
+                valid_mask = np.zeros((grad_max,), np.float32)
+                flags[:chunk] = ema_backlog[:chunk]
+                valid_mask[:chunk] = 1.0
+                if prioritized:
+                    frac = min(1.0, policy_step / max(1, int(cfg.algo.total_steps)))
+                    beta = per_beta0 + (1.0 - per_beta0) * frac  # anneal beta → 1
+                else:
+                    beta = 0.0
+                # Device-resident replay path: ONE packed blob per step is
+                # all the host ever does — sampling itself rides inside the
+                # train dispatch (the host-side counterpart of the host
+                # tier's sample+stage segment, for apples-to-apples timing).
+                with timer("Time/replay_path_time", SumMetric):
+                    blob = drb.make_job(
+                        {"__flags__": flags, "__valid__": valid_mask, "__beta__": np.float32(beta)}
+                    )
+                with timer("Time/train_time", SumMetric):
+                    t0 = time.perf_counter()
+                    outs = resident_fn(params, aopt, copt, lopt, drb.state, blob)
+                    params, aopt, copt, lopt, drb.state = outs[:5]
+                    drb.note_dispatch_latency(time.perf_counter() - t0)
+                del ema_backlog[:chunk]
+                if chunk > 0:
+                    qf_l, a_l, al_l = outs[5:8]
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Loss/value_loss", qf_l)
+                        aggregator.update("Loss/policy_loss", a_l)
+                        aggregator.update("Loss/alpha_loss", al_l)
+                    cumulative_per_rank_gradient_steps += chunk
+                    train_step += 1
+                    if guard and sentinel.observe(outs[8]):
+                        def _rollback_res(good):
+                            nonlocal params, aopt, copt, lopt, rng
+                            params = fabric.put_replicated(
+                                jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"])
+                            )
+                            cast = lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s
+                            aopt = fabric.put_replicated(jax.tree.map(cast, aopt, good["actor_optimizer"]))
+                            copt = fabric.put_replicated(jax.tree.map(cast, copt, good["qf_optimizer"]))
+                            lopt = fabric.put_replicated(jax.tree.map(cast, lopt, good["alpha_optimizer"]))
+                            if good.get("rng") is not None:
+                                rng = jnp.asarray(good["rng"])
+
+                        sentinel.recover(ckpt_dir, _rollback_res)
+                if len(ema_backlog) < grad_max:
+                    break
         elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps + policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
-                sample = rb.sample(
-                    batch_size=batch_size,
-                    n_samples=per_rank_gradient_steps,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )  # (G, B, ...)
-                data = {
-                    k: jax.device_put(np.asarray(v, dtype=np.float32), data_sharding) for k, v in sample.items()
-                }
+                # Host-side replay path: numpy sampling + staging to device.
+                # Timed separately (Time/replay_path_time) because it is the
+                # serialized host-in-the-loop segment the device-resident
+                # buffer eliminates — BENCH_METRIC=replay reports throughput
+                # against exactly this time.
+                with timer("Time/replay_path_time", SumMetric):
+                    sample = rb.sample(
+                        batch_size=batch_size,
+                        n_samples=per_rank_gradient_steps,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )  # (G, B, ...)
+                    # ONE packed sharded transfer for the whole sample dict
+                    # (the PR-3 stager trick) instead of K per-key device_put
+                    # dispatches
+                    data = put_packed(sample, data_sharding, dtype=np.float32)
                 with timer("Time/train_time", SumMetric):
                     rng, train_key = jax.random.split(rng)
                     ema_flag = jnp.float32(1.0 if iter_num % ema_modulus == 0 else 0.0)
@@ -721,6 +1154,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 logger.log_dict({"Fault/env_restarts": restarts}, policy_step)
             if guard and sentinel.total_skipped:
                 logger.log_dict({"Fault/skipped_updates": sentinel.total_skipped}, policy_step)
+            if resident_mode:
+                logger.log_dict(drb.metrics(), policy_step)
             if aggregator and not aggregator.disabled:
                 logger.log_dict(aggregator.compute(), policy_step)
                 aggregator.reset()
@@ -770,11 +1205,16 @@ def main(fabric, cfg: Dict[str, Any]):
                 "rng": rng,
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            replay_ckpt = None
+            if cfg.buffer.checkpoint:
+                # resident mode checkpoints the device ring itself (pulled to
+                # host as a DeviceReplayState), tree and key stream included
+                replay_ckpt = drb.state_dict() if resident_mode else rb
             fabric.call(
                 "on_checkpoint_coupled",
                 ckpt_path=ckpt_path,
                 state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
+                replay_buffer=replay_ckpt,
             )
 
     if burst_mode:

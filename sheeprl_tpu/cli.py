@@ -184,7 +184,11 @@ def run_algorithm(cfg: DotDict) -> None:
                 UserWarning,
             )
             predefined = set()
-        timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+        # disable_timer is tri-state: null → auto (timers off iff nothing
+        # logs them), an explicit true/false always wins — the replay bench
+        # sets false to read Time/replay_path_time at log_level 0
+        _dt = cfg.metric.disable_timer
+        timer.disabled = (cfg.metric.log_level == 0) if _dt is None else bool(_dt)
         metrics_cfg = cfg.metric.aggregator.get("metrics") or {}
         for k in set(metrics_cfg.keys()) - set(predefined):
             metrics_cfg.pop(k, None)

@@ -5,10 +5,11 @@ Capability parity with the reference's data layer
 ``(buffer_size, n_envs, ...)``, sequential-window sampling, per-env
 independent buffers, and an episode store — all living in host RAM (or
 memmapped to disk) as in the reference, because env interaction is a host
-concern. The TPU-specific piece is ``sample_tensors``: instead of
-``torch.as_tensor`` it stacks samples and ships them to device in one
-``jax.device_put`` per key (optionally with a batch sharding), so a jitted
-train step consumes them without further host hops.
+concern. The TPU-specific pieces: :func:`put_packed` ships a whole sample
+dict to device as ONE pipelined sharded transfer (the algo hot-path entry,
+replacing torch conversion), with :func:`to_device` as its single-array
+basis; fully device-resident replay — storage in HBM, sampling in-graph —
+lives in :mod:`sheeprl_tpu.replay`.
 
 All add/sample index semantics (wrap-around, write-head exclusion, next-obs
 shifting, sequence validity, episode eviction, prioritize_ends) deliberately
@@ -29,28 +30,57 @@ import numpy as np
 
 from sheeprl_tpu.data.memmap import MemmapArray
 
-__all__ = ["ReplayBuffer", "SequentialReplayBuffer", "EnvIndependentReplayBuffer", "EpisodeBuffer", "to_device"]
+__all__ = [
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "EnvIndependentReplayBuffer",
+    "EpisodeBuffer",
+    "to_device",
+    "put_packed",
+]
 
 _MEMMAP_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
 
 
-def to_device(array: np.ndarray | MemmapArray, dtype: Any = None, sharding: Any = None, clone: bool = False):
-    """Move a host array onto the accelerator (replaces ``get_tensor``,
-    reference: ``buffers.py:1158-1180``)."""
-    import jax
-    import jax.numpy as jnp
-
+def _normalize_host(array: np.ndarray | MemmapArray, dtype: Any = None, clone: bool = False) -> np.ndarray:
+    """The host-side placement rules shared by :func:`to_device` and
+    :func:`put_packed`: memmap unwrap, optional cast, float64 downcast."""
     if isinstance(array, MemmapArray):
         array = array.array
     if clone:
         array = np.array(array)
     if dtype is not None:
         array = np.asarray(array, dtype=dtype)
+    array = np.asarray(array)
     if array.dtype == np.float64:
         array = array.astype(np.float32)
+    return array
+
+
+def to_device(array: np.ndarray | MemmapArray, dtype: Any = None, sharding: Any = None, clone: bool = False):
+    """Move ONE host array onto the accelerator (replaces ``get_tensor``,
+    reference: ``buffers.py:1158-1180``). Algo hot paths ship whole sample
+    dicts with :func:`put_packed` instead — one pipelined transfer, not one
+    dispatch per key."""
+    import jax
+    import jax.numpy as jnp
+
+    array = _normalize_host(array, dtype=dtype, clone=clone)
     if sharding is not None:
         return jax.device_put(array, sharding)
     return jnp.asarray(array)
+
+
+def put_packed(samples: Dict[str, Any], sharding: Any = None, dtype: Any = None) -> Dict[str, Any]:
+    """Ship a whole sample dict in ONE ``jax.device_put`` (the PR-3 stager
+    trick, ``parallel/pipeline.py``): every key is normalized with
+    :func:`to_device`'s host-side rules, then the dict goes up as a single
+    pipelined sharded transfer instead of K per-key dispatches — on a
+    tunneled accelerator each of those pays full per-transfer latency."""
+    import jax
+
+    host = {k: _normalize_host(v, dtype=dtype) for k, v in samples.items()}
+    return jax.device_put(host, sharding)
 
 
 class ReplayBuffer:

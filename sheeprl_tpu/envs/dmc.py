@@ -19,9 +19,21 @@ if not _IS_DMC_AVAILABLE:
         "dm_control is not installed; install it to use the DMC environments"
     )
 
-# Headless pixel rendering needs a GL backend chosen before mujoco loads;
-# EGL is the one that works on GPU-less/TPU hosts.
-os.environ.setdefault("MUJOCO_GL", "egl")
+# Headless pixel rendering needs a GL backend chosen before mujoco loads.
+# EGL is the one that works on GPU-less/TPU hosts — but only when libEGL is
+# actually present: forcing MUJOCO_GL=egl on a host without it makes EVERY
+# env construction crash inside PyOpenGL, including state-only (no-render)
+# tasks that would otherwise work fine under the glfw default. Probe for a
+# headless-capable library and only claim one that exists; with neither,
+# leave mujoco's default (glfw), which serves physics-only tasks and fails
+# with a clear error iff rendering is actually requested.
+if "MUJOCO_GL" not in os.environ:
+    import ctypes.util
+
+    for _backend, _lib in (("egl", "EGL"), ("osmesa", "OSMesa")):
+        if ctypes.util.find_library(_lib):
+            os.environ["MUJOCO_GL"] = _backend
+            break
 
 from typing import Any, Dict, Optional, Tuple
 

@@ -1,0 +1,109 @@
+"""Bit-compatible in-graph index semantics for the device-resident replay
+subsystem.
+
+The host buffers (:mod:`sheeprl_tpu.data.buffers`) sample in two stages:
+
+1. draw a raw integer from ``rng.integers(0, n_eligible)`` (numpy PCG64);
+2. map that draw through *eligible-row arithmetic* — wrap-around, write-head
+   exclusion, next-obs shifting — to a storage row.
+
+Stage 2 is pure arithmetic, and this module reimplements it in ``jnp`` so a
+jitted train step can fuse it. Stage 1 is an RNG choice: the fused paths draw
+with ``jax.random`` (same uniform law, different bit stream), while the
+parity tests drive BOTH the host buffer and these mappings from the *same*
+seeded ``numpy`` generator and assert the resulting index streams are
+bit-exact (``tests/test_replay/test_indices.py``). That proves the semantics
+— the part sample-efficiency comparisons depend on — are identical; the
+underlying bit stream is an implementation detail of either backend.
+
+Every function here mirrors a specific host code path, cited inline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "uniform_eligible",
+    "map_uniform_draw",
+    "sequence_eligible",
+    "map_sequence_draw",
+    "prioritized_end_starts",
+    "window_rows",
+    "next_rows",
+]
+
+
+def uniform_eligible(pos, full, capacity: int, sample_next_obs: bool):
+    """Number of eligible rows for a uniform draw.
+
+    Mirrors ``ReplayBuffer.sample`` (``data/buffers.py:184-198``): when full,
+    rows are everything except the write head's shifted-pair exclusion zone
+    (``capacity`` rows without next-obs sampling, ``capacity - 1`` with);
+    when not full, rows ``[0, pos)`` (one less with next-obs sampling).
+    """
+    young = pos - (1 if sample_next_obs else 0)
+    old_stop = jnp.where(young >= 0, capacity, capacity + young)
+    n_full = jnp.maximum(young, 0) + old_stop - pos
+    n_partial = young
+    return jnp.where(full > 0, n_full, n_partial)
+
+
+def map_uniform_draw(draw, pos, full, capacity: int, sample_next_obs: bool):
+    """Map a raw draw ``in [0, uniform_eligible)`` to a storage row.
+
+    Mirrors ``eligible_rows[draw]`` with
+    ``eligible_rows = [0, young_stop) ++ [pos, old_stop)``
+    (``data/buffers.py:185-190``) without materializing the row list: draws
+    below ``young_stop`` are identity, the rest shift past the write head.
+    Not-full draws are already storage rows (``buffers.py:198``).
+    """
+    young = pos - (1 if sample_next_obs else 0)
+    mapped = jnp.where(draw < young, draw, pos + (draw - jnp.maximum(young, 0)))
+    return jnp.where(full > 0, mapped, draw)
+
+
+def sequence_eligible(pos, full, capacity: int, seq_len: int):
+    """Number of eligible *window starts* for a sequential draw.
+
+    Mirrors ``SequentialReplayBuffer.sample`` (``data/buffers.py:305-313``):
+    a window must not cross the write head (the oldest→newest boundary once
+    the ring is full), so ``young_stop = pos - seq_len + 1``.
+    """
+    young = pos - seq_len + 1
+    old_stop = jnp.where(young >= 0, capacity, capacity + young)
+    n_full = jnp.maximum(young, 0) + old_stop - pos
+    n_partial = young  # pos - seq_len + 1 rows when not full
+    return jnp.where(full > 0, n_full, n_partial)
+
+
+def map_sequence_draw(draw, pos, full, capacity: int, seq_len: int):
+    """Map a raw draw ``in [0, sequence_eligible)`` to a window START row
+    (same eligible-row arithmetic as :func:`map_uniform_draw`, with the
+    sequential ``young_stop``; ``data/buffers.py:306-315``)."""
+    young = pos - seq_len + 1
+    mapped = jnp.where(draw < young, draw, pos + (draw - jnp.maximum(young, 0)))
+    return jnp.where(full > 0, mapped, draw)
+
+
+def prioritized_end_starts(draw, n_starts, seq_len: int):
+    """The ``prioritize_ends`` draw rule at ring level: the draw domain is
+    widened by ``seq_len`` and overshoots clamp to the newest start, biasing
+    windows toward the most recent data. Mirrors ``EpisodeBuffer.sample``'s
+    ``upper += sequence_length; min(start, ep_len - sequence_length)``
+    (``data/buffers.py:705-709``) applied to the ring's eligible-start space:
+    ``draw in [0, n_starts + seq_len)`` maps to ``min(draw, n_starts - 1)``
+    (then through :func:`map_sequence_draw` as usual)."""
+    del seq_len  # part of the caller's draw-domain contract, not the clamp
+    return jnp.minimum(draw, n_starts - 1)
+
+
+def window_rows(start, seq_len: int, capacity: int):
+    """``(T, B)`` wrapped window rows for ``(B,)`` starts
+    (``data/buffers.py:314-315``)."""
+    return (start[None, :] + jnp.arange(seq_len)[:, None]) % capacity
+
+
+def next_rows(rows, capacity: int):
+    """The shifted next-obs rows (``data/buffers.py:210``)."""
+    return (rows + 1) % capacity

@@ -1,0 +1,266 @@
+"""DeviceReplayBuffer unit tests: allocation/sharding, staged flush packing,
+checkpoint round trips, host-tier crossovers, and spillover resolution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.ring import unpack_burst_blob
+from sheeprl_tpu.parallel import Fabric
+from sheeprl_tpu.replay import (
+    DeviceReplayBuffer,
+    DeviceReplayState,
+    estimate_ring_bytes,
+    resolve_device_resident,
+    restore_host_buffer,
+)
+
+CAP = 8
+N_ENVS = 2
+SPECS = {
+    "observations": ((3,), jnp.float32),
+    "actions": ((2,), jnp.float32),
+    "rewards": ((1,), jnp.float32),
+}
+
+
+def _mk(fabric, **kw):
+    return DeviceReplayBuffer(fabric, SPECS, CAP, N_ENVS, **kw)
+
+
+@pytest.fixture(scope="module")
+def fabric1():
+    return Fabric(devices=1, accelerator="cpu")
+
+
+@pytest.fixture(scope="module")
+def fabric2():
+    return Fabric(devices=2, accelerator="cpu")
+
+
+def _row(t):
+    return {
+        "observations": np.full((1, N_ENVS, 3), t, np.float32),
+        "actions": np.full((1, N_ENVS, 2), t + 0.5, np.float32),
+        "rewards": np.full((1, N_ENVS, 1), -t, np.float32),
+    }
+
+
+def test_flush_packs_one_blob_and_tracks_heads(fabric1):
+    drb = _mk(fabric1)
+    drb.add(_row(0))
+    blob = drb.make_job()
+    assert blob.dtype == np.uint8 and blob.ndim == 1
+    u = jax.jit(lambda b: unpack_burst_blob(b, drb.layout))(jnp.asarray(blob))
+    assert int(u["__count__"]) == 1
+    np.testing.assert_array_equal(np.asarray(u["observations"])[0], _row(0)["observations"][0])
+    assert drb.pos == 1 and not drb.full
+    # count-0 job (backlog drain): heads unmoved
+    drb.make_job()
+    assert drb.pos == 1
+    # wrap: host mirror follows the same rule as the host buffer
+    for t in range(1, CAP):
+        drb.add(_row(t))
+        drb.make_job()
+    assert drb.pos == 0 and drb.full
+
+
+def test_staging_overflow_raises(fabric1):
+    drb = _mk(fabric1)
+    drb.add(_row(0))
+    with pytest.raises(RuntimeError, match="staging area"):
+        drb.add(_row(1))
+
+
+def test_checkpoint_roundtrip_bitexact(fabric1):
+    drb = _mk(fabric1, prioritized=True, seed=3)
+    # write some real data through a tiny jitted append so the DEVICE state
+    # (not just host mirrors) is exercised
+    cap = drb.capacity
+
+    @jax.jit
+    def append(state, staged):
+        idx = state["pos"]
+        storage = {k: state["storage"][k].at[idx].set(staged[k][0]) for k in state["storage"]}
+        return {
+            **state,
+            "storage": storage,
+            "pos": (state["pos"] + 1) % cap,
+            "valid": jnp.minimum(state["valid"] + 1, cap),
+        }
+
+    for t in range(3):
+        drb.state = append(drb.state, {k: jnp.asarray(v) for k, v in _row(t).items()})
+        drb.add(_row(t))
+        drb.make_job()
+
+    snap = drb.state_dict()
+    assert isinstance(snap, DeviceReplayState) and snap.kind == "uniform"
+    # pickle round trip (the checkpoint sidecar pickles state["rb"])
+    import pickle
+
+    snap = pickle.loads(pickle.dumps(snap))
+
+    drb2 = _mk(fabric1, prioritized=True, seed=999)
+    drb2.load_state_dict(snap)
+    for k in SPECS:
+        np.testing.assert_array_equal(
+            np.asarray(drb.state["storage"][k]), np.asarray(drb2.state["storage"][k])
+        )
+    for k in ("pos", "valid", "key", "tree", "max_p"):
+        np.testing.assert_array_equal(np.asarray(drb.state[k]), np.asarray(drb2.state[k]))
+    assert drb2.pos == drb.pos and drb2.full == drb.full
+
+
+def test_checkpoint_with_staged_rows_refuses(fabric1):
+    drb = _mk(fabric1)
+    drb.add(_row(0))
+    with pytest.raises(RuntimeError, match="unflushed"):
+        drb.state_dict()
+
+
+def test_shape_mismatch_refuses(fabric1):
+    drb = _mk(fabric1)
+    snap = drb.state_dict()
+    other = DeviceReplayBuffer(fabric1, SPECS, CAP * 2, N_ENVS)
+    with pytest.raises(ValueError, match="mismatch"):
+        other.load_state_dict(snap)
+
+
+def test_two_device_sharded_storage_and_roundtrip(fabric2):
+    """2-device env-sharded ring: per-device HBM holds only its env shard,
+    and the checkpoint round trip reassembles the global array."""
+    drb = _mk(fabric2, shard_envs=True)
+    assert drb.local_envs == N_ENVS // 2
+    shards = drb.state["storage"]["observations"].addressable_shards
+    assert len(shards) == 2
+    assert shards[0].data.shape == (CAP, 1, 3)
+
+    host = ReplayBuffer(CAP, N_ENVS, obs_keys=("observations",))
+    for t in range(CAP + 3):  # wrapped
+        host.add(
+            {k: v for k, v in _row(t).items()}
+        )
+    drb.load_host_buffer(host)
+    snap = drb.state_dict()
+    np.testing.assert_array_equal(
+        snap.arrays["storage/observations"], np.asarray(host.buffer["observations"])
+    )
+    assert int(snap.arrays["valid"]) == CAP and drb.full
+
+    drb2 = _mk(fabric2, shard_envs=True)
+    drb2.load_state_dict(snap)
+    np.testing.assert_array_equal(
+        np.asarray(drb2.state["storage"]["observations"]), np.asarray(host.buffer["observations"])
+    )
+
+
+def test_prioritized_mirror_gets_uniform_priorities(fabric1):
+    host = ReplayBuffer(CAP, N_ENVS, obs_keys=("observations",))
+    for t in range(3):
+        host.add({k: v for k, v in _row(t).items()})
+    drb = _mk(fabric1, prioritized=True)
+    drb.load_host_buffer(host)
+    tree = np.asarray(drb.state["tree"])
+    P = tree.shape[0] // 2
+    # rows [0, 3) x N_ENVS leaves live, everything else zero
+    assert tree[P : P + 3 * N_ENVS].tolist() == [1.0] * (3 * N_ENVS)
+    assert tree[P + 3 * N_ENVS :].sum() == 0
+    assert float(tree[1]) == 3.0 * N_ENVS
+
+
+def test_restore_host_buffer_crossover(fabric1):
+    """Resident checkpoint resumed on the host tier: the snapshot fills the
+    host ReplayBuffer (plus zero-filled keys the ring never stored)."""
+    drb = _mk(fabric1)
+    for t in range(CAP + 2):  # wrapped ring
+        drb.add(_row(t))
+        drb.make_job()
+    host_pos, host_full = drb.pos, drb.full
+    # give the device state real content via the host mirrors only (the
+    # crossover reads snapshot arrays, which here are the jitted zeros +
+    # heads — enough to verify geometry and key fill)
+    snap = drb.state_dict()
+
+    rb = ReplayBuffer(CAP, N_ENVS, obs_keys=("observations",))
+    restore_host_buffer(snap, rb, fill_missing={"truncated": ((1,), np.uint8)})
+    assert rb._pos == host_pos and rb.full == host_full
+    assert rb.buffer["truncated"].shape == (CAP, N_ENVS, 1)
+    # a later add must find congruent storage (no KeyError / shape clash)
+    rb.add({**_row(0), "truncated": np.zeros((1, N_ENVS, 1), np.uint8)})
+
+
+def test_restore_host_buffer_memmap_backing(fabric1, tmp_path):
+    """The host-tier crossover must honor memmap backing — the spillover
+    tier exists precisely because the data does not fit RAM/HBM."""
+    from sheeprl_tpu.data.memmap import MemmapArray
+
+    drb = _mk(fabric1)
+    drb.add(_row(0))
+    drb.make_job()
+    snap = drb.state_dict()
+    rb = ReplayBuffer(CAP, N_ENVS, obs_keys=("observations",), memmap=True, memmap_dir=tmp_path)
+    restore_host_buffer(snap, rb, fill_missing={"truncated": ((1,), np.uint8)})
+    assert isinstance(rb.buffer["observations"], MemmapArray)
+    assert isinstance(rb.buffer["truncated"], MemmapArray)
+    assert rb._pos == 1
+
+
+def test_restore_host_env_buffer_sequence_crossover(fabric1):
+    """A Dreamer resident (sequence-ring) checkpoint resumed onto the host
+    tier fills the per-env buffers with per-env heads intact."""
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+    from sheeprl_tpu.replay import restore_host_env_buffer
+
+    storage = np.arange(CAP * N_ENVS * 3, dtype=np.float32).reshape(CAP, N_ENVS, 3)
+    snap = DeviceReplayState(
+        "sequence",
+        {
+            "storage/observations": storage,
+            "pos": np.array([3, 0]),
+            "valid": np.array([3, CAP]),
+            "key": np.zeros(2, np.uint32),
+        },
+        {"capacity": CAP, "n_envs": N_ENVS, "seq_len": 2},
+    )
+    rb = EnvIndependentReplayBuffer(
+        CAP, n_envs=N_ENVS, obs_keys=("observations",), buffer_cls=SequentialReplayBuffer
+    )
+    restore_host_env_buffer(snap, rb, fill_missing={"truncated": ((1,), np.float32)})
+    subs = rb.buffer
+    assert subs[0]._pos == 3 and not subs[0].full
+    assert subs[1]._pos == 0 and subs[1].full
+    np.testing.assert_array_equal(np.asarray(subs[0].buffer["observations"])[:, 0], storage[:, 0])
+    np.testing.assert_array_equal(np.asarray(subs[1].buffer["observations"])[:, 0], storage[:, 1])
+    # per-env sequential sampling works immediately after the crossover
+    rb.seed(0)
+    out = rb.sample(batch_size=4, sequence_length=2)
+    assert out["observations"].shape[1] == 2  # (n_samples, T, B, ...)
+    # wrong-kind snapshots are rejected loudly
+    with pytest.raises(ValueError, match="sequence"):
+        restore_host_buffer(snap, ReplayBuffer(CAP, N_ENVS))
+
+
+def test_spillover_resolution():
+    small = {"observations": ((4,), jnp.float32)}
+    ok, shard, _ = resolve_device_resident("auto", small, 100, 2, 1, 1.0)
+    assert ok and not shard
+    ok, shard, reason = resolve_device_resident("auto", small, 10**9, 2, 1, 0.5)
+    assert not ok and "spilling" in reason
+    with pytest.warns(UserWarning, match="device_resident=true"):
+        ok, _, _ = resolve_device_resident(True, small, 10**9, 2, 1, 0.5)
+    assert not ok
+    ok, _, _ = resolve_device_resident(False, small, 10, 2, 1, 1.0)
+    assert not ok
+    with pytest.raises(ValueError):
+        resolve_device_resident("bogus", small, 10, 2, 1, 1.0)
+    # sharding halves the per-device footprint; PER forces replication
+    est_rep = estimate_ring_bytes(small, 1000, 4, 2, shard_envs=False)
+    est_shard = estimate_ring_bytes(small, 1000, 4, 2, shard_envs=True)
+    assert est_shard * 2 == est_rep
+    _, shard, _ = resolve_device_resident("auto", small, 100, 4, 2, 1.0, prioritized=True)
+    assert not shard
+    _, shard, _ = resolve_device_resident("auto", small, 100, 4, 2, 1.0)
+    assert shard

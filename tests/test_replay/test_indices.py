@@ -1,0 +1,158 @@
+"""Bit-exact sampling-parity tests: the device-resident index mappings
+(:mod:`sheeprl_tpu.replay.indices`) against the host buffers under a SHARED
+seed.
+
+Method: both sides consume the SAME numpy PCG64 draw stream — the host
+buffer through its normal ``sample`` path, the device side by issuing the
+identical ``rng.integers`` calls and pushing the raw draws through the
+in-graph eligible-row arithmetic. Identical draws + identical arithmetic
+must yield identical index streams (and therefore identical sampled values),
+covering wrap-around, write-head exclusion, and the next-obs shift.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.replay import indices
+
+CAP = 8
+N_ENVS = 3
+
+
+def _filled_uniform(n_rows: int, n_envs: int = N_ENVS):
+    """Host buffer whose cell values uniquely encode (global step, env)."""
+    rb = ReplayBuffer(CAP, n_envs, obs_keys=("observations",))
+    for t in range(n_rows):
+        row = np.full((1, n_envs, 1), t * 100, np.float32) + np.arange(n_envs).reshape(1, -1, 1)
+        rb.add({"observations": row})
+    return rb
+
+
+def _device_uniform_stream(rb, seed, batch, sample_next_obs):
+    """Replicate ReplayBuffer.sample's draw calls, map in-graph, gather."""
+    rng = np.random.default_rng(seed)
+    pos, full = rb._pos, rb._full
+    n_elig = int(indices.uniform_eligible(jnp.int32(pos), jnp.int32(full), CAP, sample_next_obs))
+    if full:
+        draws = rng.integers(0, n_elig, size=(batch,), dtype=np.intp)
+        rows = np.asarray(
+            indices.map_uniform_draw(jnp.asarray(draws), jnp.int32(pos), jnp.int32(1), CAP, sample_next_obs)
+        )
+    else:
+        rows = rng.integers(0, n_elig, size=(batch,), dtype=np.intp)
+    env = rng.integers(0, rb.n_envs, size=(batch,), dtype=np.intp)
+    storage = jnp.asarray(np.asarray(rb.buffer["observations"]))
+    out = {"observations": np.asarray(storage[rows, env])}
+    if sample_next_obs:
+        nxt = np.asarray(indices.next_rows(jnp.asarray(rows), CAP))
+        out["next_observations"] = np.asarray(storage[nxt, env])
+    return out
+
+
+@pytest.mark.parametrize("n_rows", [5, CAP, CAP + 3])  # partial, exactly-full, wrapped
+@pytest.mark.parametrize("sample_next_obs", [False, True])
+def test_uniform_parity_bit_exact(n_rows, sample_next_obs):
+    seed, batch = 1234, 64
+    rb = _filled_uniform(n_rows)
+    rb.seed(seed)
+    host = rb.sample(batch_size=batch, sample_next_obs=sample_next_obs)
+    dev = _device_uniform_stream(rb, seed, batch, sample_next_obs)
+    np.testing.assert_array_equal(host["observations"].reshape(batch, 1), dev["observations"])
+    if sample_next_obs:
+        np.testing.assert_array_equal(
+            host["next_observations"].reshape(batch, 1), dev["next_observations"]
+        )
+
+
+def test_uniform_parity_write_head_wrap_edge():
+    """pos == 0 on a full ring with next-obs sampling: the host builds its
+    eligible rows from a NEGATIVE young_stop; the mapping must agree."""
+    seed, batch = 7, 128
+    rb = _filled_uniform(2 * CAP)  # wraps exactly back to pos == 0
+    assert rb._pos == 0 and rb.full
+    rb.seed(seed)
+    host = rb.sample(batch_size=batch, sample_next_obs=True)
+    dev = _device_uniform_stream(rb, seed, batch, True)
+    np.testing.assert_array_equal(host["observations"].reshape(batch, 1), dev["observations"])
+    np.testing.assert_array_equal(host["next_observations"].reshape(batch, 1), dev["next_observations"])
+
+
+def test_uniform_excludes_write_head_when_full():
+    """Semantics (not just parity): with next-obs pairing on a full ring the
+    newest row (whose shifted pair would cross the head) is never drawn."""
+    rb = _filled_uniform(CAP + 3)
+    rb.seed(0)
+    rng = np.random.default_rng(0)
+    n_elig = int(indices.uniform_eligible(jnp.int32(rb._pos), jnp.int32(1), CAP, True))
+    draws = rng.integers(0, n_elig, size=(4096,), dtype=np.intp)
+    rows = np.asarray(indices.map_uniform_draw(jnp.asarray(draws), jnp.int32(rb._pos), jnp.int32(1), CAP, True))
+    excluded = (rb._pos - 1) % CAP
+    assert excluded not in set(rows.tolist())
+    assert set(rows.tolist()) <= set(range(CAP)) - {excluded}
+
+
+def _filled_seq(n_rows: int, n_envs: int):
+    rb = SequentialReplayBuffer(CAP, n_envs, obs_keys=("observations",))
+    for t in range(n_rows):
+        row = np.full((1, n_envs, 1), t * 100, np.float32) + np.arange(n_envs).reshape(1, -1, 1)
+        rb.add({"observations": row})
+    return rb
+
+
+@pytest.mark.parametrize("n_rows", [6, CAP, CAP + 5])
+@pytest.mark.parametrize("n_envs", [1, N_ENVS])
+def test_sequential_parity_bit_exact(n_rows, n_envs):
+    seed, batch, seq_len = 99, 32, 3
+    rb = _filled_seq(n_rows, n_envs)
+    rb.seed(seed)
+    host = rb.sample(batch_size=batch, sequence_length=seq_len)  # (1, T, B, 1)
+
+    rng = np.random.default_rng(seed)
+    pos, full = rb._pos, rb._full
+    n_elig = int(indices.sequence_eligible(jnp.int32(pos), jnp.int32(full), CAP, seq_len))
+    draws = rng.integers(0, n_elig, size=(batch,), dtype=np.intp)
+    if full:
+        starts = np.asarray(
+            indices.map_sequence_draw(jnp.asarray(draws), jnp.int32(pos), jnp.int32(1), CAP, seq_len)
+        )
+    else:
+        starts = draws
+    if n_envs == 1:
+        env = np.zeros((batch,), np.intp)
+    else:
+        env = rng.integers(0, n_envs, size=(batch,), dtype=np.intp)
+    rows = np.asarray(indices.window_rows(jnp.asarray(starts), seq_len, CAP))  # (T, B)
+    storage = np.asarray(rb.buffer["observations"])
+    dev = storage[rows, env[None, :]]  # (T, B, 1)
+    np.testing.assert_array_equal(host["observations"][0], dev)
+
+
+def test_sequential_windows_never_cross_write_head():
+    rb = _filled_seq(CAP + 5, 1)
+    seq_len = 3
+    pos = rb._pos
+    n_elig = int(indices.sequence_eligible(jnp.int32(pos), jnp.int32(1), CAP, seq_len))
+    draws = jnp.arange(n_elig)
+    starts = np.asarray(indices.map_sequence_draw(draws, jnp.int32(pos), jnp.int32(1), CAP, seq_len))
+    rows = np.asarray(indices.window_rows(jnp.asarray(starts), seq_len, CAP))  # (T, n_elig)
+    # a window crosses the head iff it contains the transition (pos-1) -> pos
+    for b in range(rows.shape[1]):
+        w = rows[:, b].tolist()
+        for a, c in zip(w[:-1], w[1:]):
+            assert not (a == (pos - 1) % CAP and c == pos % CAP)
+
+
+def test_prioritize_ends_clamp_matches_host_rule():
+    """The widened-domain draw with overshoot clamp, vs the EpisodeBuffer
+    arithmetic (`upper += seq_len; min(start, ep_len - seq_len)`) on the
+    same draw stream."""
+    seq_len, n_starts = 4, 10
+    rng = np.random.default_rng(3)
+    draws = rng.integers(0, n_starts + seq_len, size=(512,))
+    ours = np.asarray(indices.prioritized_end_starts(jnp.asarray(draws), jnp.int32(n_starts), seq_len))
+    oracle = np.minimum(draws, n_starts - 1)  # == min(start, ep_len - seq_len) at ring level
+    np.testing.assert_array_equal(ours, oracle)
+    # ends get extra mass: the clamp maps seq_len + 1 draw values onto the newest start
+    assert (ours == n_starts - 1).sum() > (ours == 0).sum()

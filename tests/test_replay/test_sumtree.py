@@ -1,0 +1,117 @@
+"""In-graph sum-tree correctness against a plain numpy oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.replay import sumtree
+
+
+class NumpyTree:
+    """Oracle: flat priority array + cumsum search."""
+
+    def __init__(self, n):
+        self.p = np.zeros(n, np.float64)
+
+    def update(self, idx, prio):
+        self.p[np.asarray(idx)] = np.asarray(prio)  # fancy-assign: last wins
+
+    def total(self):
+        return self.p.sum()
+
+    def sample(self, u):
+        cs = np.cumsum(self.p)
+        mass = np.minimum(np.asarray(u), 1.0 - 1e-7) * cs[-1]
+        return np.searchsorted(cs, mass, side="right")
+
+
+def test_leaf_count_pow2():
+    assert sumtree.leaf_count(1) == 1
+    assert sumtree.leaf_count(5) == 8
+    assert sumtree.leaf_count(8) == 8
+    assert sumtree.leaf_count(9) == 16
+    with pytest.raises(ValueError):
+        sumtree.leaf_count(0)
+
+
+def test_total_and_internal_consistency():
+    rng = np.random.default_rng(0)
+    n = 21
+    tree = sumtree.init(n)
+    idx = jnp.arange(n)
+    prios = rng.random(n).astype(np.float32)
+    tree = sumtree.update(tree, idx, jnp.asarray(prios))
+    assert np.isclose(float(sumtree.total(tree)), prios.sum(), rtol=1e-6)
+    # every internal node equals the sum of its children
+    t = np.asarray(tree)
+    P = t.shape[0] // 2
+    for i in range(1, P):
+        assert np.isclose(t[i], t[2 * i] + t[2 * i + 1], rtol=1e-5)
+
+
+def test_duplicate_updates_last_wins():
+    tree = sumtree.init(8)
+    tree = sumtree.update(tree, jnp.array([3, 3, 3]), jnp.array([1.0, 2.0, 7.0]))
+    assert float(sumtree.get(tree, jnp.array([3]))[0]) == 7.0
+    assert float(sumtree.total(tree)) == 7.0
+
+
+@pytest.mark.parametrize("n", [4, 13, 64])
+def test_sample_matches_oracle(n):
+    rng = np.random.default_rng(n)
+    prios = (rng.random(n) + 0.01).astype(np.float32)
+    # zero out a few leaves — they must never be sampled
+    prios[:: max(2, n // 4)] = 0.0
+    tree = sumtree.update(sumtree.init(n), jnp.arange(n), jnp.asarray(prios))
+    oracle = NumpyTree(n)
+    oracle.update(np.arange(n), prios)
+    u = rng.random(4096).astype(np.float32)
+    got = np.asarray(sumtree.sample(tree, jnp.asarray(u)))
+    want = oracle.sample(u)
+    # float32 prefix sums can disagree with float64 exactly at interval
+    # boundaries; allow only boundary-adjacent disagreements (< 0.1%)
+    mismatch = got != want
+    assert mismatch.mean() < 1e-3
+    assert np.all(np.abs(got[mismatch] - want[mismatch]) <= 1) if mismatch.any() else True
+    # never a zero-priority leaf, never out of range
+    assert np.all(prios[got] > 0)
+
+
+def test_sample_respects_proportions():
+    n = 8
+    prios = np.array([1, 0, 0, 0, 0, 0, 0, 3], np.float32)
+    tree = sumtree.update(sumtree.init(n), jnp.arange(n), jnp.asarray(prios))
+    key = jax.random.PRNGKey(0)
+    u = jax.random.uniform(key, (20000,))
+    got = np.asarray(sumtree.sample(tree, u))
+    frac7 = (got == 7).mean()
+    assert set(np.unique(got).tolist()) == {0, 7}
+    assert abs(frac7 - 0.75) < 0.02
+
+
+def test_update_is_jittable_and_incremental():
+    n = 16
+    step = jax.jit(lambda t, i, p: sumtree.update(t, i, p))
+    tree = sumtree.init(n)
+    oracle = NumpyTree(n)
+    rng = np.random.default_rng(5)
+    for _ in range(10):
+        idx = rng.integers(0, n, size=(4,))
+        prios = rng.random(4).astype(np.float32)
+        # in-batch duplicates must resolve identically (last write wins)
+        tree = step(tree, jnp.asarray(idx), jnp.asarray(prios))
+        oracle.update(idx, prios)
+    np.testing.assert_allclose(np.asarray(tree)[n:], oracle.p, rtol=1e-6)
+    assert np.isclose(float(sumtree.total(tree)), oracle.total(), rtol=1e-6)
+
+
+def test_importance_weights_formula():
+    n = 4
+    prios = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    tree = sumtree.update(sumtree.init(n), jnp.arange(n), jnp.asarray(prios))
+    beta = 0.5
+    idx = jnp.array([0, 3])
+    w = np.asarray(sumtree.importance_weights(tree, idx, jnp.int32(n), jnp.float32(beta)))
+    want = (n * prios[[0, 3]] / prios.sum()) ** (-beta)
+    np.testing.assert_allclose(w, want, rtol=1e-5)
