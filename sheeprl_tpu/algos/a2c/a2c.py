@@ -209,12 +209,17 @@ def main(fabric, cfg: Dict[str, Any]):
     train_fn = make_train_step(agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size)
     gae_fn = jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
 
-    rng = jax.random.PRNGKey(cfg.seed)
+    # committed (replicated) so the rollout program compiles once — an
+    # uncommitted first key gives call 1 its own one-off compiled signature
+    rng = fabric.put_replicated(jax.random.PRNGKey(cfg.seed))
 
+    # filter reset obs to the encoder keys — extra keys would give the first
+    # policy dispatch its own one-off compiled signature
     step_data: Dict[str, np.ndarray] = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    reset_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = {k: np.asarray(reset_obs[k]) for k in obs_keys}
     for k in obs_keys:
-        step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
         for _ in range(0, cfg.algo.rollout_steps):

@@ -449,7 +449,8 @@ class MinedojoActor(Actor):
 
 def _unimix_logits(logits: jax.Array, amount: float) -> jax.Array:
     """Hafner's uniform-mix regularizer on categorical logits."""
-    if amount <= 0.0:
+    # `amount` is cfg.algo.unimix, a trace-time Python float — static branch
+    if amount <= 0.0:  # graft-lint: disable=GL004
         return logits
     probs = jax.nn.softmax(logits, axis=-1)
     uniform = jnp.ones_like(probs) / probs.shape[-1]

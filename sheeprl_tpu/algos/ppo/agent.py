@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.models import MLP, MultiEncoder, NatureCNN, get_activation
 
 __all__ = ["PPOAgent", "CNNEncoder", "MLPEncoder", "build_agent", "PPOPlayer"]
@@ -213,7 +214,12 @@ class PPOPlayer:
                 buf_actions = jnp.concatenate(acts, axis=-1)
             return key, env_actions, buf_actions, logprob, values
 
-        self._rollout_step = jax.jit(_rollout_step)
+        # transfer_guard=False: the obs arrive as HOST arrays by contract —
+        # placement follows the committed params (see utils.prepare_obs), so
+        # the implicit h2d here is deliberate, not a hygiene bug.
+        self._rollout_step = tracecheck.instrument(
+            jax.jit(_rollout_step), name="ppo.rollout_step", transfer_guard=False
+        )
 
     def rollout_step(self, params, key, obs):
         return self._rollout_step(params, key, obs)

@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.ppo.agent import build_agent, forward_with_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
@@ -317,10 +318,18 @@ def main(fabric, cfg: Dict[str, Any]):
     nan_injector = NaNInjector(cfg)
     ckpt_dir = os.path.join(log_dir, "checkpoint")
 
-    train_fn = make_train_step(
-        agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size, guard=guard
+    # Registered hot paths: post-warmup retraces (and, under the trace-
+    # hygiene fixture, implicit transfers) are budget violations.
+    train_fn = tracecheck.instrument(
+        make_train_step(
+            agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size, guard=guard
+        ),
+        name="ppo.train_step",
     )
-    gae_fn = jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
+    gae_fn = tracecheck.instrument(
+        jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda)),
+        name="ppo.gae",
+    )
 
     rng = jax.random.PRNGKey(cfg.seed)
     rng, _ = jax.random.split(rng)
@@ -328,16 +337,27 @@ def main(fabric, cfg: Dict[str, Any]):
         # restore the rollout/train RNG so the resumed stream continues
         # where the killed run left off
         rng = jnp.asarray(state["rng"])
+    # Commit the carried key to the mesh (replicated) BEFORE the first
+    # rollout dispatch: the jitted rollout step returns its carried key
+    # committed, so an uncommitted first key means the entire rollout program
+    # compiles twice — once for call 1, once for every call after it
+    # (caught by analysis.tracecheck on ppo.rollout_step).
+    rng = fabric.put_replicated(rng)
 
     lr = lr0
     clip_coef = float(cfg.algo.clip_coef)
     ent_coef = float(cfg.algo.ent_coef)
 
-    # First observation
+    # First observation — filtered to the encoder keys: feeding the raw
+    # reset dict (which can carry extra keys, e.g. rgb when only state is
+    # encoded) gave the FIRST rollout dispatch a wider signature than every
+    # later one — a whole wasted compile of the policy program plus dead
+    # host->device bytes (caught by analysis.tracecheck on ppo.rollout_step).
     step_data: Dict[str, np.ndarray] = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    reset_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = {k: np.asarray(reset_obs[k]) for k in obs_keys}
     for k in obs_keys:
-        step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        step_data[k] = next_obs[k][np.newaxis]
 
     cnn_keys = cfg.algo.cnn_keys.encoder
 
@@ -402,13 +422,17 @@ def main(fabric, cfg: Dict[str, Any]):
                             aggregator.update("Game/ep_len_avg", ep_len)
                         print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # GAE on device (reference: ppo.py:346-360)
+        # GAE on device (reference: ppo.py:346-360). The three host inputs
+        # are staged with ONE explicit device_put — feeding numpy views
+        # straight into the jitted scan was an implicit per-iteration
+        # host->device transfer (flagged by the tracecheck transfer guard).
         local_data = rb.to_numpy()
         jobs = prepare_obs(fabric, next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
         next_values = player.get_values(params, jobs)
-        returns, advantages = gae_fn(
-            local_data["rewards"], local_data["values"], local_data["dones"], next_values
+        rewards_d, values_d, dones_d = jax.device_put(
+            (local_data["rewards"], local_data["values"], local_data["dones"])
         )
+        returns, advantages = gae_fn(rewards_d, values_d, dones_d, next_values)
 
         # Stage ONCE: flatten (T, N) → batch as host-side views (contiguous
         # reshape, no copy), keep the GAE outputs on device, and ship the

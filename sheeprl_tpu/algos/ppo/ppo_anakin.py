@@ -55,6 +55,7 @@ from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
 from sheeprl_tpu.algos.ppo.ppo import make_local_train
+from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.algos.ppo.utils import test
 from sheeprl_tpu.envs.jax_envs import BatchedJaxEnv, is_jax_env, make_jax_env
 from sheeprl_tpu.ops import gae as gae_op
@@ -344,11 +345,16 @@ def main(fabric, cfg: Dict[str, Any]):
     block_fns: Dict[int, Any] = {}
 
     def get_block_fn(n_iters: int):
-        # one compile per distinct block length (at most two: body + remainder)
+        # one compile per distinct block length (at most two: body + remainder),
+        # each a registered hot path — the fused block must NEVER retrace past
+        # its own first compile
         if n_iters not in block_fns:
-            block_fns[n_iters] = make_anakin_block(
-                agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key,
-                ferry_episodes=ferry_episodes, guard=guard,
+            block_fns[n_iters] = tracecheck.instrument(
+                make_anakin_block(
+                    agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key,
+                    ferry_episodes=ferry_episodes, guard=guard,
+                ),
+                name="ppo_anakin.block",
             )
         return block_fns[n_iters]
 

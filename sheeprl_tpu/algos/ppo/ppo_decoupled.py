@@ -167,11 +167,16 @@ def main(fabric, cfg: Dict[str, Any]):
     def player_fn() -> None:
         policy_step = state["iter_num"] * policy_steps_per_iter if state is not None else 0
         try:
+            # filter reset obs to the encoder keys — extra keys would give
+            # the first policy dispatch its own one-off compiled signature
             step_data: Dict[str, np.ndarray] = {}
-            next_obs = envs.reset(seed=cfg.seed)[0]
+            reset_obs = envs.reset(seed=cfg.seed)[0]
+            next_obs = {k: np.asarray(reset_obs[k]) for k in obs_keys}
             for k in obs_keys:
-                step_data[k] = np.asarray(next_obs[k])[np.newaxis]
-            rng = jax.random.PRNGKey(cfg.seed)
+                step_data[k] = next_obs[k][np.newaxis]
+            # commit the carried key (replicated, like the params snapshot)
+            # so the rollout program compiles once, not once-for-call-1
+            rng = fabric.put_replicated(jax.random.PRNGKey(cfg.seed))
 
             for iter_num in range(start_iter, total_iters + 1):
                 p_snapshot = param_box["params"]

@@ -59,6 +59,7 @@ import optax
 
 from sheeprl_tpu.algos.ppo.agent import _dists, build_agent, forward_with_actions
 from sheeprl_tpu.algos.ppo.ppo import make_train_step
+from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.ops import gae as gae_op
@@ -217,11 +218,22 @@ def main(fabric, cfg: Dict[str, Any]):
     nan_injector = NaNInjector(cfg)
     ckpt_dir = os.path.join(log_dir, "checkpoint")
 
-    train_fn = make_train_step(
-        agent, tx, cfg, learner_fabric.mesh,
-        local_batch_global // learner_fabric.world_size, donate=False, guard=guard,
+    train_fn = tracecheck.instrument(
+        make_train_step(
+            agent, tx, cfg, learner_fabric.mesh,
+            local_batch_global // learner_fabric.world_size, donate=False, guard=guard,
+        ),
+        name="ppo_sebulba.train_step",
     )
-    gae_fn = jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
+    # transfer_guard=False: the actor-side GAE reads rollout slabs in place —
+    # host views by design (the packed learner-sharded device_put happens once
+    # per item in stager.ship, not per intermediate)
+    gae_fn = tracecheck.instrument(
+        jax.jit(partial(gae_op, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda)),
+        name="ppo_sebulba.gae",
+        warmup=num_actors + 1,
+        transfer_guard=False,
+    )
 
     # -- RNG streams ---------------------------------------------------------
     rng_train = jax.random.PRNGKey(cfg.seed + 1)
@@ -266,7 +278,12 @@ def main(fabric, cfg: Dict[str, Any]):
         keys = jax.random.split(key, n_heads)
         return jnp.stack([d.sample(k).argmax(-1) for d, k in zip(dists, keys)], axis=-1)
 
-    act_fn = jax.jit(_act)
+    # actor-side entry points keep host-array inputs by contract (obs via
+    # prepare_obs, host-pre-split keys): transfer_guard=False. Warmup covers
+    # the first call of every concurrently-starting actor thread.
+    act_fn = tracecheck.instrument(
+        jax.jit(_act), name="ppo_sebulba.act", warmup=num_actors + 1, transfer_guard=False
+    )
 
     def _traj_outs(p, obs_flat, actions_flat):
         # normalization mirrors make_local_train's minibatch_step exactly
@@ -279,7 +296,9 @@ def main(fabric, cfg: Dict[str, Any]):
         logprob, _entropy, values = forward_with_actions(agent, p, obs, actions)
         return logprob, values
 
-    traj_fn = jax.jit(_traj_outs)
+    traj_fn = tracecheck.instrument(
+        jax.jit(_traj_outs), name="ppo_sebulba.traj", warmup=num_actors + 1, transfer_guard=False
+    )
     eye_rows = [np.eye(int(d), dtype=np.float32) for d in actions_dim] if not is_continuous else None
 
     def actor_fn(aid: int, envs) -> None:
@@ -304,7 +323,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 space = observation_space[k]
                 template[k] = ((T, num_envs, *space.shape), space.dtype)
             rng = jax.random.fold_in(actor_rng_base, aid)
-            next_obs = {k: np.asarray(v) for k, v in envs.reset(seed=cfg.seed + aid * batch_envs)[0].items()}
+            # filter reset obs to the encoder keys — extra keys would give the
+            # first act_fn dispatch its own one-off compiled signature
+            reset_obs = envs.reset(seed=cfg.seed + aid * batch_envs)[0]
+            next_obs = {k: np.asarray(reset_obs[k]) for k in obs_keys}
             groups = [(g * num_envs, (g + 1) * num_envs) for g in range(env_groups)]
 
             local_iter = 0

@@ -261,10 +261,13 @@ def main(fabric, cfg: Dict[str, Any]):
     ent_coef = float(cfg.algo.ent_coef)
     cnn_keys = cfg.algo.cnn_keys.encoder
 
+    # filter reset obs to the encoder keys — extra keys would give the first
+    # policy dispatch its own one-off compiled signature
     step_data: Dict[str, np.ndarray] = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    reset_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = {k: np.asarray(reset_obs[k]) for k in obs_keys}
     for k in obs_keys:
-        step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        step_data[k] = next_obs[k][np.newaxis]
 
     states = player.reset_states()
     prev_actions = np.zeros((1, cfg.env.num_envs, int(sum(actions_dim))), dtype=np.float32)

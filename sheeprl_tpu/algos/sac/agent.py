@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.models import MLP
 
 __all__ = [
@@ -187,7 +188,13 @@ class SACPlayer:
 
     def __init__(self, agent: SACAgent):
         self.agent = agent
-        self._sample = jax.jit(lambda p, o, k: agent.sample_action(p, o, k)[0])
+        # transfer_guard=False: obs arrive as host arrays by contract —
+        # placement follows the committed params (see utils.prepare_obs)
+        self._sample = tracecheck.instrument(
+            jax.jit(lambda p, o, k: agent.sample_action(p, o, k)[0]),
+            name="sac.rollout_step",
+            transfer_guard=False,
+        )
         self._greedy = jax.jit(agent.greedy_action)
 
     def get_actions(self, params, obs: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:

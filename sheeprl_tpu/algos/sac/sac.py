@@ -34,6 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.sac.agent import SACAgent, build_agent
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer, put_packed
 from sheeprl_tpu.data.ring import pack_burst_blob
@@ -928,15 +929,28 @@ def main(fabric, cfg: Dict[str, Any]):
         elif state is not None and cfg.buffer.checkpoint and not rb.empty:
             # resumed from a host-buffer checkpoint: mirror it into HBM
             drb.load_host_buffer(rb)
-        resident_fn = make_resident_train_step(
-            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, drb, grad_max,
-            guard=guard, donate=not hp_enabled,
+        resident_fn = tracecheck.instrument(
+            make_resident_train_step(
+                agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, drb, grad_max,
+                guard=guard, donate=not hp_enabled,
+            ),
+            name="sac.resident_step",
         )
         ema_backlog = []
         per_beta0 = float(per_cfg.get("beta", 0.4))
     else:
-        train_fn = make_train_step(
-            agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=not hp_enabled, guard=guard
+        # warmup=2: the first post-learning-starts grant replays the prefill
+        # backlog in one oversized (G, B) batch, a legitimate second
+        # signature. budget=2: a fractional replay_ratio alternates between
+        # adjacent grant sizes — a couple of shape variants are the contract,
+        # anything past that is drift.
+        train_fn = tracecheck.instrument(
+            make_train_step(
+                agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, donate=not hp_enabled, guard=guard
+            ),
+            name="sac.train_step",
+            warmup=2,
+            budget=2,
         )
     data_sharding = NamedSharding(fabric.mesh, P(None, "dp"))
 

@@ -1,0 +1,39 @@
+"""graft-lint: JAX/TPU-aware static analysis + runtime trace hygiene.
+
+Two halves, one contract — keep the fused hot paths (Anakin PPO, the Sebulba
+pipeline, the fault-guarded train steps, device-resident replay) free of the
+hazards that silently destroy TPU throughput (and, for RNG misuse,
+correctness):
+
+:mod:`sheeprl_tpu.analysis.lint`
+    AST-based analyzer with JAX-specific rules (GL001-GL007: RNG key reuse,
+    host syncs inside jit, ``np.`` on traced values, Python control flow on
+    tracers, read-after-donate, dict-ordering-sensitive pytrees, PRNGKey in a
+    loop), jit-reachability computed by walking decorators / ``jax.jit`` /
+    ``shard_map`` / ``lax.scan`` call edges, inline ``# graft-lint:
+    disable=GLxxx`` suppressions and a checked-in baseline so pre-existing
+    findings don't block CI. Run it as ``python -m sheeprl_tpu.analysis``.
+
+:mod:`sheeprl_tpu.analysis.tracecheck`
+    Runtime sentinel for what the static pass can't see: registered jit entry
+    points record compilations per (function, abstract signature) and fail
+    when a hot path retraces past its budget after warmup; post-warmup calls
+    can additionally run under ``jax.transfer_guard("disallow")`` so an
+    accidental implicit host->device transfer (a numpy leaf sneaking into a
+    fused step) is an error, not a silent sync. The Podracer line (Sebulba /
+    Anakin, arXiv:2104.06272) attributes its throughput to exactly these
+    invariants holding in the steady state.
+"""
+
+from sheeprl_tpu.analysis.lint import Finding, RULES, analyze_paths, analyze_source
+from sheeprl_tpu.analysis.tracecheck import RetraceError, TraceCheck, tracecheck
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_paths",
+    "analyze_source",
+    "RetraceError",
+    "TraceCheck",
+    "tracecheck",
+]

@@ -1,0 +1,164 @@
+"""``python -m sheeprl_tpu.analysis`` — the graft-lint CLI.
+
+Exit-code contract (CI relies on it):
+
+- ``0`` — no findings after baseline/suppression filtering (clean tree);
+- ``1`` — at least one new finding;
+- ``2`` — usage or internal error (unknown rule, unreadable baseline, ...).
+
+Formats: ``text`` (one finding per line, summary to stderr), ``json``
+(machine-readable report incl. the rule catalog), ``github`` (workflow
+annotations — ``::error file=...,line=...`` — so findings land inline on the
+PR diff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from sheeprl_tpu.analysis.lint import (
+    RULES,
+    Finding,
+    analyze_paths,
+    apply_baseline,
+    fingerprint,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = ".graft-lint-baseline.json"
+
+
+def _parse_rules(spec: Optional[str]) -> Optional[set]:
+    if not spec:
+        return None
+    rules = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    unknown = rules - set(RULES)
+    if unknown:
+        raise SystemExit2(f"unknown rule(s): {', '.join(sorted(unknown))} (known: {', '.join(sorted(RULES))})")
+    return rules
+
+
+class SystemExit2(Exception):
+    pass
+
+
+def _emit_text(findings: List[Finding], out) -> None:
+    for f in findings:
+        print(f.render(), file=out)
+
+
+def _emit_github(findings: List[Finding], out) -> None:
+    for f in findings:
+        # '%' ',' and newlines must be escaped in workflow-command payloads
+        msg = f.message.replace("%", "%25").replace("\r", "").replace("\n", "%0A")
+        print(
+            f"::error file={f.path},line={f.line},col={f.col},title=graft-lint {f.rule}::{msg} [in {f.function}]",
+            file=out,
+        )
+
+
+def _emit_json(findings: List[Finding], baselined: int, out) -> None:
+    payload = {
+        "tool": "graft-lint",
+        "rules": RULES,
+        "baselined": baselined,
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "function": f.function,
+                "fingerprint": fingerprint(f),
+            }
+            for f in findings
+        ],
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis",
+        description="graft-lint: JAX/TPU-aware static analysis (rules GL001-GL007).",
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/dirs to analyze")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help=f"baseline file of exempted pre-existing findings (default: {DEFAULT_BASELINE})",
+    )
+    parser.add_argument("--no-baseline", action="store_true", help="report everything, ignore the baseline")
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings to the baseline file and exit 0",
+    )
+    parser.add_argument("--select", help="comma-separated rules to run (default: all)")
+    parser.add_argument("--ignore", help="comma-separated rules to skip")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    try:
+        select = _parse_rules(args.select)
+        ignore = _parse_rules(args.ignore)
+    except SystemExit2 as e:
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        findings = analyze_paths(args.paths, select=select, ignore=ignore)
+    except Exception as e:  # pragma: no cover - internal error contract
+        print(f"graft-lint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        try:
+            write_baseline(args.baseline, findings)
+        except OSError as e:
+            print(f"graft-lint: cannot write baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"graft-lint: wrote {len(findings)} finding(s) to {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baselined = 0
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = load_baseline(args.baseline)
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            print(f"graft-lint: unreadable baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        before = len(findings)
+        findings = apply_baseline(findings, baseline)
+        baselined = before - len(findings)
+
+    if args.format == "json":
+        _emit_json(findings, baselined, sys.stdout)
+    elif args.format == "github":
+        _emit_github(findings, sys.stdout)
+    else:
+        _emit_text(findings, sys.stdout)
+
+    summary = f"graft-lint: {len(findings)} finding(s)" + (f", {baselined} baselined" if baselined else "")
+    print(summary, file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""Runtime trace hygiene: retrace budgets + steady-state transfer guards.
+
+The static pass (:mod:`sheeprl_tpu.analysis.lint`) can prove a lot, but two
+hazards only show up at runtime:
+
+- **Silent retraces.** A hot jitted entry point that recompiles after warmup
+  (shape drift, a weak-type flip, an accidentally-Python argument) costs
+  seconds per occurrence and usually hides inside an otherwise-working run.
+  :meth:`TraceCheck.instrument` wraps an entry point, counts compilations per
+  (function, abstract signature) — via the jit cache size when the callable
+  exposes it, via signature tracking otherwise — and trips when the count
+  exceeds the entry's budget after its warmup calls.
+
+- **Implicit transfers.** A numpy leaf sneaking into a fused step is an
+  unmetered host->device copy per call. With :attr:`TraceCheck.transfer_guard`
+  enabled, every post-warmup call of an instrumented entry point runs under
+  ``jax.transfer_guard("disallow")``, turning the silent copy into an error
+  while leaving warmup (and all *explicit* ``device_put`` staging) alone.
+
+Modes (``SHEEPRL_TPU_TRACECHECK`` env var, or :meth:`TraceCheck.configure`):
+
+- ``warn`` (default): record everything, ``warnings.warn`` on budget trips —
+  zero behavioral risk in production runs;
+- ``strict``: raise :class:`RetraceError` on a trip (what the test fixture
+  uses);
+- ``off``: instrumented entry points collapse to a plain call.
+
+This module also hosts the generic **trace-event ledger** the PR-3 wire-dtype
+retrace guard now rides (see :mod:`sheeprl_tpu.parallel.comm`): code that
+reads process-wide settings at trace time records ``(tag, value)`` events
+here, so "a cached trace baked in a stale setting" checks live in ONE
+mechanism instead of per-module ad-hoc lists.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["RetraceError", "EntryStats", "TraceCheck", "tracecheck"]
+
+
+class RetraceError(RuntimeError):
+    """A registered hot path exceeded its post-warmup retrace budget."""
+
+
+def _abstract_signature(args: tuple, kwargs: dict) -> tuple:
+    """Hashable abstract signature of a call: array leaves by (shape, dtype,
+    weak_type), python scalars by type (they trace to the same weak aval),
+    other statics by repr. Import of jax is deferred so the module stays
+    importable in docs/CI contexts without jax."""
+    import jax
+
+    def leaf_sig(x: Any) -> Any:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return ("arr", tuple(x.shape), str(x.dtype), bool(getattr(x, "weak_type", False)))
+        if isinstance(x, (bool, int, float, complex)):
+            return ("py", type(x).__name__)
+        if x is None or isinstance(x, (str, bytes)):
+            return ("static", x)
+        return ("static", repr(type(x)))
+
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    return (str(treedef), tuple(leaf_sig(x) for x in leaves))
+
+
+def _cache_size(fn: Callable) -> Optional[int]:
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # pragma: no cover - defensive across jax versions
+        return None
+
+
+@dataclass
+class EntryStats:
+    """Per-instrumented-entry-point counters (one instance per instrument()
+    call; the report merges same-name entries across runs)."""
+
+    name: str
+    warmup: int
+    budget: int
+    transfer_guard: bool = True
+    calls: int = 0
+    compiles: int = 0
+    post_warmup_compiles: int = 0
+    cache_level: int = 0  # high-water mark of the wrapped fn's jit cache
+    signatures: Dict[tuple, int] = field(default_factory=dict)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "calls": self.calls,
+            "compiles": self.compiles,
+            "post_warmup_compiles": self.post_warmup_compiles,
+            "warmup": self.warmup,
+            "budget": self.budget,
+            "distinct_signatures": len(self.signatures),
+        }
+
+
+class TraceCheck:
+    """Process-wide registry of instrumented jit entry points + event ledger.
+
+    Thread-safety: the Sebulba actors call instrumented functions from
+    several threads; counters are guarded by one lock (the guarded section is
+    nanoseconds against a multi-ms jit dispatch).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: List[EntryStats] = []
+        self._events: Dict[str, List[Any]] = {}
+        self.mode: str = os.environ.get("SHEEPRL_TPU_TRACECHECK", "warn").strip().lower() or "warn"
+        if self.mode not in ("off", "warn", "strict"):
+            self.mode = "warn"
+        self.transfer_guard: bool = False
+
+    # -- configuration ------------------------------------------------------ #
+
+    def configure(self, mode: Optional[str] = None, transfer_guard: Optional[bool] = None) -> None:
+        if mode is not None:
+            if mode not in ("off", "warn", "strict"):
+                raise ValueError(f"tracecheck mode must be off|warn|strict, got {mode!r}")
+            self.mode = mode
+        if transfer_guard is not None:
+            self.transfer_guard = bool(transfer_guard)
+
+    def reset(self) -> None:
+        """Drop all entries and events (test fixtures call this per run)."""
+        with self._lock:
+            self._entries.clear()
+            self._events.clear()
+
+    # -- instrumentation ---------------------------------------------------- #
+
+    def instrument(
+        self,
+        fn: Callable,
+        name: str,
+        warmup: int = 1,
+        budget: int = 0,
+        transfer_guard: bool = True,
+    ) -> Callable:
+        """Wrap a jitted callable with retrace accounting.
+
+        ``warmup``: number of initial calls whose compilations are free (the
+        first compile of every hot path, plus any deliberate signature
+        variants, e.g. a final partial batch). ``budget``: compilations
+        tolerated after warmup before the entry *trips* (warn or raise by
+        mode). ``transfer_guard=False`` opts this entry out of the
+        steady-state ``jax.transfer_guard("disallow")`` — for entry points
+        whose *contract* is host-array inputs (the rollout policies: obs
+        placement deliberately follows the committed params, see
+        ``ppo.utils.prepare_obs``). The wrapper is transparent to donation —
+        it holds no argument references past the call.
+        """
+        stats = EntryStats(
+            name=name, warmup=int(warmup), budget=int(budget), transfer_guard=bool(transfer_guard)
+        )
+        initial_level = _cache_size(fn)
+        track_signatures = initial_level is None
+        stats.cache_level = initial_level or 0
+        with self._lock:
+            self._entries.append(stats)
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            if self.mode == "off":
+                return fn(*args, **kwargs)
+            with self._lock:
+                stats.calls += 1
+                calls = stats.calls
+            post_warmup = calls > stats.warmup
+            sig = None
+            new_sig = False
+            if track_signatures:
+                sig = _abstract_signature(args, kwargs)
+                with self._lock:
+                    new_sig = sig not in stats.signatures
+                    stats.signatures[sig] = stats.signatures.get(sig, 0) + 1
+            guard = (
+                _transfer_guard_ctx()
+                if (self.transfer_guard and stats.transfer_guard and post_warmup)
+                else contextlib.nullcontext()
+            )
+            with guard:
+                out = fn(*args, **kwargs)
+            after = _cache_size(fn)
+            if after is None:
+                compiled = new_sig
+            else:
+                # high-water-mark accounting: under concurrent callers (the
+                # Sebulba actor threads) each cache growth is attributed to
+                # exactly ONE call instead of every in-flight one
+                with self._lock:
+                    compiled = after > stats.cache_level
+                    stats.cache_level = max(stats.cache_level, after)
+            if compiled:
+                if sig is None:
+                    # cache-size path: record the signature only for compiles
+                    # (keeps the per-call cost to two attribute reads)
+                    sig = _abstract_signature(args, kwargs)
+                with self._lock:
+                    stats.compiles += 1
+                    stats.signatures[sig] = stats.signatures.get(sig, 0) + (0 if track_signatures else 1)
+                    tripped = False
+                    if post_warmup:
+                        stats.post_warmup_compiles += 1
+                        tripped = stats.post_warmup_compiles > stats.budget
+                if tripped:
+                    self._trip(stats, sig)
+            return out
+
+        wrapped.__wrapped__ = fn
+        wrapped.stats = stats
+        return wrapped
+
+    def _trip(self, stats: EntryStats, sig: tuple) -> None:
+        msg = (
+            f"graft-lint tracecheck: hot path '{stats.name}' retraced after warmup "
+            f"({stats.post_warmup_compiles} post-warmup compile(s) > budget {stats.budget}; "
+            f"{stats.calls} calls, {stats.compiles} compiles total). Offending abstract "
+            f"signature: {sig!r}. A post-warmup retrace usually means shape/dtype/weak-type "
+            "drift in an argument or a Python scalar that should be a jnp array."
+        )
+        if self.mode == "strict":
+            raise RetraceError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    # -- reporting ----------------------------------------------------------- #
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        """Merged per-name counters. Same-name entries (one per run in a
+        multi-run process, e.g. the test suite) sum their call/compile
+        counters; distinct signatures are UNIONED, not summed, so the report
+        never claims signature drift that didn't happen."""
+        out: Dict[str, Dict[str, Any]] = {}
+        sigs: Dict[str, set] = {}
+        with self._lock:
+            entries = list(self._entries)
+        for st in entries:
+            snap = st.snapshot()
+            cur = out.get(st.name)
+            if cur is None:
+                out[st.name] = snap
+                sigs[st.name] = set(st.signatures)
+            else:
+                for k in ("calls", "compiles", "post_warmup_compiles"):
+                    cur[k] += snap[k]
+                sigs[st.name] |= set(st.signatures)
+                cur["distinct_signatures"] = len(sigs[st.name])
+        return out
+
+    def post_warmup_retraces(self) -> Dict[str, int]:
+        """name -> post-warmup compile count, only for entries that have any
+        (empty dict == perfectly quiet steady state)."""
+        return {
+            name: rep["post_warmup_compiles"]
+            for name, rep in self.report().items()
+            if rep["post_warmup_compiles"] > 0
+        }
+
+    # -- trace-event ledger --------------------------------------------------- #
+
+    def record_event(self, tag: str, value: Any) -> None:
+        """Record that a trace observed ``value`` for ``tag`` (e.g. the wire
+        dtype a collective was traced under)."""
+        with self._lock:
+            self._events.setdefault(tag, []).append(value)
+
+    def events(self, tag: str) -> List[Any]:
+        with self._lock:
+            return list(self._events.get(tag, ()))
+
+    def clear_events(self, tag: str) -> None:
+        with self._lock:
+            self._events.pop(tag, None)
+
+
+def _transfer_guard_ctx():
+    import jax
+
+    return jax.transfer_guard("disallow")
+
+
+#: process-wide singleton — algorithms instrument their entry points on it and
+#: the pytest trace-hygiene fixture flips it strict per test.
+tracecheck = TraceCheck()

@@ -22,10 +22,15 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from sheeprl_tpu.analysis.tracecheck import tracecheck
+
 __all__ = ["pmean_grads", "all_gather_wire", "set_grad_reduce_dtype", "get_grad_reduce_dtype"]
 
 _GRAD_REDUCE_DTYPE: Optional[Any] = None  # None = reduce in the gradients' own dtype
-_TRACED_WITH: "list" = []  # dtypes pmean_grads has already been traced under
+# Wire-dtype retrace guard (PR 3) now rides the shared analysis.tracecheck
+# event ledger instead of a module-private list: one trace-staleness
+# mechanism, inspectable alongside the retrace budgets.
+_WIRE_TAG = "comm.grad_reduce_dtype"
 
 
 def set_grad_reduce_dtype(dtype_str: Optional[str], fresh_run: bool = False) -> None:
@@ -42,9 +47,10 @@ def set_grad_reduce_dtype(dtype_str: Optional[str], fresh_run: bool = False) -> 
         new = jnp.bfloat16
     else:
         raise ValueError(f"Unsupported fabric.grad_reduce_dtype: {dtype_str!r} (float32 or bfloat16)")
+    traced_with = tracecheck.events(_WIRE_TAG)
     if fresh_run:
-        _TRACED_WITH.clear()
-    elif _TRACED_WITH and any(t != new for t in _TRACED_WITH):
+        tracecheck.clear_events(_WIRE_TAG)
+    elif traced_with and any(t != new for t in traced_with):
         # The setting is read at TRACE time: already-compiled train steps keep
         # their old wire dtype while new traces pick up this one — warn loudly
         # rather than silently mixing collective precisions in one run.
@@ -54,7 +60,7 @@ def set_grad_reduce_dtype(dtype_str: Optional[str], fresh_run: bool = False) -> 
             "fabric.grad_reduce_dtype changed after a train step was already traced; "
             "cached jitted steps keep the previous wire dtype. Set it once, before launch."
         )
-        _TRACED_WITH.clear()
+        tracecheck.clear_events(_WIRE_TAG)
     _GRAD_REDUCE_DTYPE = new
 
 
@@ -66,7 +72,7 @@ def pmean_grads(tree: Any, axis_name: str = "dp") -> Any:
     """Mean-reduce a gradient pytree across ``axis_name``, optionally casting
     to the configured wire dtype for the collective only."""
     dt = _GRAD_REDUCE_DTYPE
-    _TRACED_WITH.append(dt)
+    tracecheck.record_event(_WIRE_TAG, dt)
     if dt is None:
         return jax.lax.pmean(tree, axis_name)
     return jax.tree.map(lambda g: jax.lax.pmean(g.astype(dt), axis_name).astype(g.dtype), tree)
@@ -78,7 +84,7 @@ def all_gather_wire(x: Any, axis_name: str = "dp") -> Any:
     percentiles tolerate bf16 rounding the same way averaged gradients do).
     Returns the gathered array cast back to the input dtype."""
     dt = _GRAD_REDUCE_DTYPE
-    _TRACED_WITH.append(dt)
+    tracecheck.record_event(_WIRE_TAG, dt)
     if dt is None:
         return jax.lax.all_gather(x, axis_name)
     return jax.lax.all_gather(x.astype(dt), axis_name).astype(x.dtype)

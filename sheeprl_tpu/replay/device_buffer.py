@@ -267,10 +267,14 @@ class DeviceReplayBuffer:
         self._staged.append(row)
         self._metrics["inserts"] += self.n_envs
 
-    def make_job(self, extras: Optional[Dict[str, np.ndarray]] = None) -> np.ndarray:
+    def make_job(self, extras: Optional[Dict[str, np.ndarray]] = None) -> jax.Array:
         """Pack the staged rows (possibly zero — backlog-drain dispatches
         append nothing) plus the caller's extra segments into ONE uint8 blob,
-        and advance the host head mirrors."""
+        stage it on the mesh (replicated) with an EXPLICIT transfer, and
+        advance the host head mirrors. Explicit staging (vs. handing numpy to
+        the fused dispatch) keeps the steady state clean under
+        ``jax.transfer_guard("disallow")`` and lets the copy overlap the rest
+        of the host loop instead of riding the dispatch."""
         t0 = time.perf_counter()
         count = len(self._staged)
         values: Dict[str, np.ndarray] = {}
@@ -283,7 +287,7 @@ class DeviceReplayBuffer:
         for k, v in (extras or {}).items():
             values[k] = v
         self._staged.clear()
-        blob = pack_burst_blob(self.layout, values)
+        blob = self.fabric.put_replicated(pack_burst_blob(self.layout, values))
         # same wrap rule as the host buffer (data/buffers.py:154-156)
         if self._pos + count >= self.capacity:
             self._full = True

@@ -49,11 +49,15 @@ def _reset_metric_state():
     """Timers/aggregator flags are class-level; isolate tests. The gradient
     wire dtype is process-wide and now DEFAULTS to bf16 for any multi-device
     `Fabric.from_config` run — reset it so an e2e CLI test can't leak bf16
-    reduction into a later unit test's (f32-calibrated) numerics."""
+    reduction into a later unit test's (f32-calibrated) numerics. The
+    analysis.tracecheck registry is process-wide too: drop the previous
+    test's instrumented entries/events so report() stays per-test."""
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
     from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
     from sheeprl_tpu.utils.metric import MetricAggregator
     from sheeprl_tpu.utils.timer import timer
 
+    tracecheck.reset()
     set_grad_reduce_dtype("float32", fresh_run=True)
     yield
     timer.timers.clear()

@@ -1,0 +1,31 @@
+"""Trace-hygiene fixture: strict tracecheck + steady-state transfer guard +
+tracer-leak checking around an e2e run."""
+
+import jax
+import pytest
+
+
+@pytest.fixture()
+def trace_hygiene():
+    """Arm the runtime sentinels for one test:
+
+    - tracecheck ``strict``: a post-warmup retrace on any registered hot path
+      raises :class:`~sheeprl_tpu.analysis.tracecheck.RetraceError`;
+    - steady-state ``jax.transfer_guard("disallow")``: an implicit transfer
+      in a guarded entry point raises instead of silently syncing;
+    - ``jax.check_tracer_leaks``: a tracer escaping a trace raises at trace
+      time.
+
+    Yields the tracecheck singleton so tests can assert on
+    ``post_warmup_retraces()`` / ``report()`` afterwards.
+    """
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
+
+    tracecheck.reset()
+    tracecheck.configure(mode="strict", transfer_guard=True)
+    try:
+        with jax.check_tracer_leaks():
+            yield tracecheck
+    finally:
+        tracecheck.configure(mode="warn", transfer_guard=False)
+        tracecheck.reset()

@@ -1,0 +1,146 @@
+"""Tier-1 e2e dry-runs under the trace-hygiene fixture: strict retrace
+budgets + steady-state ``jax.transfer_guard("disallow")`` + tracer-leak
+checking, through the real CLI. The acceptance bar: 0 post-warmup retraces on
+the ppo / ppo_anakin / sac / ppo_sebulba hot paths, and a deliberately
+planted host sync must be CAUGHT (proving the guard actually polices the
+steady state)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def _args(tmp_path, exp, env="dummy", devices=2, extra=()):
+    args = [
+        f"exp={exp}",
+        f"env={env}",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "dry_run=True",
+        "buffer.memmap=False",
+        f"fabric.devices={devices}",
+        "metric.log_level=0",
+        "checkpoint.save_last=False",
+        f"log_root={tmp_path}/logs",
+        "algo.run_test=False",
+    ]
+    args.extend(extra)
+    return args
+
+
+PPO_FAST = [
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+SAC_FAST = [
+    "algo.per_rank_batch_size=8",
+    "algo.mlp_keys.encoder=[state]",
+    "env.id=continuous_dummy",
+]
+
+
+def _assert_quiet(tracecheck, expected_entries):
+    retraces = tracecheck.post_warmup_retraces()
+    assert retraces == {}, f"post-warmup retraces on hot paths: {retraces}"
+    report = tracecheck.report()
+    for name in expected_entries:
+        assert name in report, f"hot path {name!r} was never registered: {sorted(report)}"
+        assert report[name]["calls"] > 0, f"hot path {name!r} was never dispatched"
+
+
+def test_ppo_steady_state_clean(tmp_path, trace_hygiene):
+    """PPO beyond warmup: 2 full iterations (not dry_run), so the train step
+    and the rollout program both run guarded steady-state calls."""
+    run(
+        _args(tmp_path, "ppo", extra=PPO_FAST)[:6]  # keep exp/env/envs/sync/video
+        + [
+            "buffer.memmap=False",
+            "fabric.devices=2",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            f"log_root={tmp_path}/logs",
+            "algo.run_test=False",
+            "algo.total_steps=32",  # 2 iterations of 8 steps x 2 envs
+        ]
+        + PPO_FAST
+    )
+    _assert_quiet(trace_hygiene, ["ppo.train_step", "ppo.gae", "ppo.rollout_step"])
+    # the whole rollout program must have compiled exactly once
+    assert trace_hygiene.report()["ppo.rollout_step"]["compiles"] == 1
+
+
+def test_ppo_anakin_dry_run_clean(tmp_path, trace_hygiene):
+    run(_args(tmp_path, "ppo_anakin", env="gym", extra=PPO_FAST))
+    _assert_quiet(trace_hygiene, ["ppo_anakin.block"])
+
+
+def test_sac_dry_run_clean(tmp_path, trace_hygiene):
+    run(_args(tmp_path, "sac", extra=SAC_FAST))
+    _assert_quiet(trace_hygiene, ["sac.train_step", "sac.rollout_step"])
+
+
+def test_sac_resident_dry_run_clean(tmp_path, trace_hygiene):
+    run(_args(tmp_path, "sac", extra=SAC_FAST + ["buffer.device_resident=True"]))
+    _assert_quiet(trace_hygiene, ["sac.resident_step", "sac.rollout_step"])
+
+
+def test_ppo_sebulba_dry_run_clean(tmp_path, trace_hygiene):
+    run(_args(tmp_path, "ppo_sebulba", extra=PPO_FAST))
+    _assert_quiet(
+        trace_hygiene,
+        ["ppo_sebulba.train_step", "ppo_sebulba.act", "ppo_sebulba.traj", "ppo_sebulba.gae"],
+    )
+
+
+def test_planted_host_sync_is_caught(tmp_path, trace_hygiene, monkeypatch):
+    """Regression-proof the guard itself: break the explicit staging (the
+    exact hazard class the suite polices) and the steady-state transfer guard
+    must fail the run instead of silently eating a per-iteration sync."""
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    # the learner batch now reaches the train step as raw numpy views
+    monkeypatch.setattr(Fabric, "shard_data", lambda self, tree: tree)
+
+    # depending on where placement resolves, the guard reports the planted
+    # sync as a host-to-device or an (equally implicit) device-to-device move
+    with pytest.raises(Exception, match="Disallowed .* transfer"):
+        run(
+            [
+                "exp=ppo",
+                "env=dummy",
+                "env.num_envs=2",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "buffer.memmap=False",
+                "fabric.devices=2",
+                "metric.log_level=0",
+                "checkpoint.save_last=False",
+                f"log_root={tmp_path}/logs",
+                "algo.run_test=False",
+                "algo.total_steps=32",  # 2 iterations: the 2nd is guarded
+            ]
+            + PPO_FAST
+        )
+
+
+def test_planted_retrace_is_caught(tmp_path, trace_hygiene):
+    """And the budget half: a hot path whose signature drifts post-warmup
+    (here: a python-float scalar that should be a jnp array) trips strict
+    mode with a RetraceError naming the entry point."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.analysis.tracecheck import RetraceError
+
+    # transfer_guard=False so the retrace accounting (not the transfer
+    # guard, which would fire first on the implicit scalar transfer) trips
+    step = trace_hygiene.instrument(
+        jax.jit(lambda x, c: x * c), name="drifting_step", warmup=1, transfer_guard=False
+    )
+    step(jnp.ones((4,)), jnp.float32(0.9))
+    with pytest.raises(RetraceError, match="drifting_step"):
+        step(jnp.ones((4,)), 0.9)  # weak-type drift = retrace
