@@ -25,7 +25,17 @@ recorded trajectory stays comparable):
   ``BENCH_REPLAY_MODE=device`` (default) runs the device-resident ring
   (``buffer.device_resident=true``, howto/device_replay.md);
   ``BENCH_REPLAY_MODE=host`` runs the host-sampling path — the paired
-  driver compares the two on the same topology.
+  driver compares the two on the same topology;
+- ``sac_sebulba`` — ``sac_pendulum_sebulba_env_steps_per_sec``: the async
+  off-policy pipeline (``exp=sac_sebulba_benchmarks``,
+  howto/async_offpolicy.md) vs the coupled SAC host loop at an IDENTICAL
+  recipe and replay ratio (``BENCH_SAC_MODE=async`` (default) | ``coupled``
+  — the coupled twin is ``exp=sac_async_coupled_benchmarks``, whose
+  per-env-step critical path serializes env step + inference + numpy
+  sample + staging + train; the async run moves the first two onto actor
+  threads and the sampling in-graph). Both report env-steps/s plus the
+  Time/* split, so the serialized replay-path seconds the async topology
+  removes from the env-step critical path are visible in the JSON.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -87,9 +97,20 @@ def main() -> None:
         metric = "sac_pendulum_replay_grad_steps_per_sec"
         exp = "sac_replay_benchmarks"
         default_steps = 8192
+    elif which in ("sac_sebulba", "sac_async", "sac_pendulum_sebulba_env_steps_per_sec"):
+        metric = "sac_pendulum_sebulba_env_steps_per_sec"
+        sac_mode = os.environ.get("BENCH_SAC_MODE", "async").strip().lower()
+        if sac_mode not in ("async", "coupled"):
+            raise SystemExit(f"Unknown BENCH_SAC_MODE '{sac_mode}' (expected 'async' or 'coupled')")
+        # the coupled twin is a dedicated exp with the IDENTICAL recipe
+        # (model, batch, replay ratio, env) so the ONLY difference between
+        # the two runs is the topology
+        exp = "sac_sebulba_benchmarks" if sac_mode == "async" else "sac_async_coupled_benchmarks"
+        default_steps = 8192
     else:
         raise SystemExit(
-            f"Unknown BENCH_METRIC '{which}' (expected 'host', 'ondevice', 'sebulba' or 'replay')"
+            f"Unknown BENCH_METRIC '{which}' (expected 'host', 'ondevice', 'sebulba', 'replay' "
+            "or 'sac_sebulba')"
         )
     total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", default_steps))
     overrides = [
@@ -101,6 +122,12 @@ def main() -> None:
         "metric.log_level=0",
         "metric.disable_timer=True",
     ]
+    if metric == "sac_pendulum_sebulba_env_steps_per_sec":
+        # keep the Time/* instrumentation alive so the serialized replay-path
+        # segment (coupled: numpy sample + staging; async: the learner's
+        # append dispatch) is readable after the run
+        overrides.remove("metric.disable_timer=True")
+        overrides.append("metric.disable_timer=False")
     replay_mode = None
     if metric == "sac_pendulum_replay_grad_steps_per_sec":
         replay_mode = os.environ.get("BENCH_REPLAY_MODE", "device").strip().lower()
@@ -149,6 +176,33 @@ def main() -> None:
                     "elapsed_s": round(elapsed, 2),
                     # no vs_baseline: the PPO reference bar is env-steps/s —
                     # dividing grad-steps/s by it would be a unit mismatch
+                }
+            )
+        )
+        return
+    if metric == "sac_pendulum_sebulba_env_steps_per_sec":
+        # Both modes consume the identical grant schedule (same Ratio, same
+        # recipe), so env-steps/s is directly comparable. The replay-path
+        # seconds show WHERE the time went: for the coupled loop it is the
+        # serialized host sample+stage segment on the env-step critical
+        # path; for the async run it is just the learner's append dispatch
+        # (packing + transfer ride the actor threads).
+        from sheeprl_tpu.utils.timer import timer as _timer
+
+        timers = _timer.compute()
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": round(total_steps / elapsed, 2),
+                    "unit": "env-steps/s",
+                    "mode": sac_mode,
+                    "elapsed_s": round(elapsed, 2),
+                    "replay_path_s": round(timers.get("Time/replay_path_time", 0.0), 3),
+                    "train_s": round(timers.get("Time/train_time", 0.0), 3),
+                    "env_interaction_s": round(timers.get("Time/env_interaction_time", 0.0), 3),
+                    # no vs_baseline: the PPO reference bar is a different
+                    # algorithm's env rate
                 }
             )
         )

@@ -15,9 +15,9 @@ from sheeprl_tpu.utils.registry import register_evaluation
 __all__ = ["evaluate_sac"]
 
 
-# Shared with the decoupled main — same "agent" checkpoint layout
+# Shared with the decoupled mains — same "agent" checkpoint layout
 # (reference: ``sheeprl/algos/sac/evaluate.py:15``).
-@register_evaluation(algorithms=["sac", "sac_decoupled"])
+@register_evaluation(algorithms=["sac", "sac_decoupled", "sac_sebulba"])
 def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, fabric.global_rank)

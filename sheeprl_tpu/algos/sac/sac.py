@@ -47,7 +47,22 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, resolve_hybrid_player, save_configs
 from sheeprl_tpu.parallel.compat import shard_map
 
-__all__ = ["main", "make_train_step", "make_resident_train_step"]
+__all__ = ["main", "make_train_step", "make_resident_train_step", "restore_train_state"]
+
+
+def restore_train_state(fabric, good, params, aopt, copt, lopt, rng):
+    """Rebuild the live SAC train state from a rollback checkpoint payload
+    (the divergence sentinel's recover callback body, shared by the coupled
+    mains and ``sac_sebulba``). Returns the replicated replacements; ``rng``
+    passes through unchanged when the checkpoint carries no stream."""
+    params = fabric.put_replicated(jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"]))
+    cast = lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s
+    aopt = fabric.put_replicated(jax.tree.map(cast, aopt, good["actor_optimizer"]))
+    copt = fabric.put_replicated(jax.tree.map(cast, copt, good["qf_optimizer"]))
+    lopt = fabric.put_replicated(jax.tree.map(cast, lopt, good["alpha_optimizer"]))
+    if good.get("rng") is not None:
+        rng = jnp.asarray(good["rng"])
+    return params, aopt, copt, lopt, rng
 
 
 def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, donate: bool = True, guard: bool = False):
@@ -310,10 +325,20 @@ def make_resident_train_step(
     grad_max: int,
     guard: bool = False,
     donate: bool = True,
+    append: bool = True,
 ):
     """Fused append + in-graph sample + G-step update against a
     :class:`~sheeprl_tpu.replay.DeviceReplayBuffer` (the ``buffer.
     device_resident`` path; see ``howto/device_replay.md``).
+
+    ``append=False`` builds the TRAIN-ONLY variant for the decoupled
+    (Sebulba) topology: appends ride the replay buffer's own
+    :meth:`~sheeprl_tpu.replay.DeviceReplayBuffer.make_append_step` program
+    (fed by actor threads), and this step's ``blob`` is the small control
+    blob from :meth:`~sheeprl_tpu.replay.DeviceReplayBuffer.make_ctl_job`
+    (``__flags__``/``__valid__``/``__beta__`` only) — sampling, the key
+    stream, and the PER tree still advance in-graph exactly as in the fused
+    form (see ``howto/async_offpolicy.md``).
 
     One dispatch per env step does ALL of: append the staged transition row
     into the HBM ring (donated in-place scatter), draw every granted
@@ -345,7 +370,7 @@ def make_resident_train_step(
     per_alpha = drb.per_alpha
     per_eps = drb.per_eps
     B = int(cfg.algo.per_rank_batch_size) // n_dev
-    layout = drb.layout
+    layout = drb.layout if append else drb.ctl_layout
 
     def minibatch_step(carry, xs, storage, vld, beta):
         # Padding steps beyond the granted chunk skip EVERYTHING via
@@ -497,15 +522,19 @@ def make_resident_train_step(
 
         def packed_pre(params, aopt, copt, lopt, rb_state, blob):
             u = unpack_burst_blob(blob, layout)
-            staged = {k: u[k] for k in drb.specs}
             storage = rb_state["storage"]
-            count = u["__count__"]
-            # append: one in-place scatter; count==0 targets row `capacity`
-            # and is dropped (backlog-drain dispatch)
-            idx = jnp.where(count > 0, rb_state["pos"], capacity)
-            storage = {k: storage[k].at[idx].set(staged[k][0], mode="drop") for k in storage}
-            new_pos = (rb_state["pos"] + count) % capacity
-            new_vld = jnp.minimum(rb_state["valid"] + count, capacity)
+            if append:
+                staged = {k: u[k] for k in drb.specs}
+                count = u["__count__"]
+                # append: one in-place scatter; count==0 targets row
+                # `capacity` and is dropped (backlog-drain dispatch)
+                idx = jnp.where(count > 0, rb_state["pos"], capacity)
+                storage = {k: storage[k].at[idx].set(staged[k][0], mode="drop") for k in storage}
+                new_pos = (rb_state["pos"] + count) % capacity
+                new_vld = jnp.minimum(rb_state["valid"] + count, capacity)
+            else:
+                new_pos = rb_state["pos"]
+                new_vld = rb_state["valid"]
             state_key, sub = jax.random.split(rb_state["key"])
             k_pos, k_env, k_scan = jax.random.split(sub, 3)
             shape = (grad_max, B * n_dev)
@@ -525,17 +554,21 @@ def make_resident_train_step(
 
     def local_train(params, aopt, copt, lopt, storage, pos, vld, state_key, tree, max_p,
                     staged, count, flags, valid, beta):
-        # -- append: one in-place scatter; count==0 (backlog-drain dispatch)
-        # targets row `capacity` and is dropped
-        idx = jnp.where(count > 0, pos, capacity)
-        storage = {k: storage[k].at[idx].set(staged[k][0], mode="drop") for k in storage}
-        new_pos = (pos + count) % capacity
-        new_vld = jnp.minimum(vld + count, capacity)
-        if prioritized:
-            # fresh transitions enter at the running max priority
-            leaves = pos * n_envs + jnp.arange(n_envs, dtype=jnp.int32)
-            prio = jnp.where(count > 0, max_p, st.get(tree, leaves))
-            tree = st.update(tree, leaves, prio)
+        if append:
+            # -- append: one in-place scatter; count==0 (backlog-drain
+            # dispatch) targets row `capacity` and is dropped
+            idx = jnp.where(count > 0, pos, capacity)
+            storage = {k: storage[k].at[idx].set(staged[k][0], mode="drop") for k in storage}
+            new_pos = (pos + count) % capacity
+            new_vld = jnp.minimum(vld + count, capacity)
+            if prioritized:
+                # fresh transitions enter at the running max priority
+                leaves = pos * n_envs + jnp.arange(n_envs, dtype=jnp.int32)
+                prio = jnp.where(count > 0, max_p, st.get(tree, leaves))
+                tree = st.update(tree, leaves, prio)
+        else:
+            # decoupled topology: the append rode its own dispatch
+            new_pos, new_vld = pos, vld
 
         state_key, sub = jax.random.split(state_key)
         step_keys = jax.random.split(jax.random.fold_in(sub, jax.lax.axis_index("dp")), grad_max)
@@ -566,14 +599,17 @@ def make_resident_train_step(
 
     def packed(params, aopt, copt, lopt, rb_state, blob):
         u = unpack_burst_blob(blob, layout)
-        staged = {k: u[k] for k in drb.specs}
+        # append=False ships no transition segments: an empty staged pytree
+        # and a zero count make the scatter a statically-skipped branch
+        staged = {k: u[k] for k in drb.specs} if append else {}
+        count = u["__count__"] if append else jnp.zeros((), jnp.int32)
         tree = rb_state.get("tree", jnp.zeros((2,), jnp.float32))
         max_p = rb_state.get("max_p", jnp.ones((), jnp.float32))
         (params, aopt, copt, lopt, storage, pos, vld, key, tree, max_p, qf, al, ll, skipped
          ) = shard_train(
             params, aopt, copt, lopt,
             rb_state["storage"], rb_state["pos"], rb_state["valid"], rb_state["key"], tree, max_p,
-            staged, u["__count__"], u["__flags__"], u["__valid__"], u["__beta__"],
+            staged, count, u["__flags__"], u["__valid__"], u["__beta__"],
         )
         new_state = {"storage": storage, "pos": pos, "valid": vld, "key": key}
         if prioritized:
@@ -1104,15 +1140,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     if guard and sentinel.observe(outs[8]):
                         def _rollback_res(good):
                             nonlocal params, aopt, copt, lopt, rng
-                            params = fabric.put_replicated(
-                                jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"])
+                            params, aopt, copt, lopt, rng = restore_train_state(
+                                fabric, good, params, aopt, copt, lopt, rng
                             )
-                            cast = lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s
-                            aopt = fabric.put_replicated(jax.tree.map(cast, aopt, good["actor_optimizer"]))
-                            copt = fabric.put_replicated(jax.tree.map(cast, copt, good["qf_optimizer"]))
-                            lopt = fabric.put_replicated(jax.tree.map(cast, lopt, good["alpha_optimizer"]))
-                            if good.get("rng") is not None:
-                                rng = jnp.asarray(good["rng"])
 
                         sentinel.recover(ckpt_dir, _rollback_res)
                 if len(ema_backlog) < grad_max:
@@ -1149,15 +1179,9 @@ def main(fabric, cfg: Dict[str, Any]):
                 if guard and sentinel.observe(outs[7]):
                     def _rollback(good):
                         nonlocal params, aopt, copt, lopt, rng
-                        params = fabric.put_replicated(
-                            jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"])
+                        params, aopt, copt, lopt, rng = restore_train_state(
+                            fabric, good, params, aopt, copt, lopt, rng
                         )
-                        cast = lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s
-                        aopt = fabric.put_replicated(jax.tree.map(cast, aopt, good["actor_optimizer"]))
-                        copt = fabric.put_replicated(jax.tree.map(cast, copt, good["qf_optimizer"]))
-                        lopt = fabric.put_replicated(jax.tree.map(cast, lopt, good["alpha_optimizer"]))
-                        if good.get("rng") is not None:
-                            rng = jnp.asarray(good["rng"])
 
                     sentinel.recover(ckpt_dir, _rollback)
 

@@ -1,6 +1,19 @@
 """SAC — decoupled player/trainer topology
 (reference: ``sheeprl/algos/sac/sac_decoupled.py:547-640``).
 
+.. deprecated::
+    ``algo=sac_sebulba`` supersedes this main for decoupled off-policy
+    training: it keeps the player/trainer overlap but replaces the
+    host-side replay sampling + per-grant batch shipping below with the
+    device-resident ring (in-graph sampling, one append dispatch per
+    transition blob), adds N-actor batched inference on a dedicated device
+    slice, an explicit replay-ratio governor, PER, and the full
+    fault-tolerance stack (sentinel + ring checkpointing). This main is
+    kept as the faithful port of the REFERENCE's decoupled topology (its
+    ``scatter_object_list``-of-sampled-chunks pattern) and as the
+    checkpoint-compatible fallback when the ring cannot fit device memory;
+    see the README topology matrix and ``howto/async_offpolicy.md``.
+
 Same TPU-native mapping as decoupled PPO (one process, player thread +
 trainer mesh — see ``algos/ppo/ppo_decoupled.py``), with the off-policy
 specifics of the reference topology:
@@ -46,6 +59,15 @@ __all__ = ["main"]
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.optim.builders import build_optimizer
     from sheeprl_tpu.fault import load_resume_state
+
+    warnings.warn(
+        "algo=sac_decoupled is deprecated: algo=sac_sebulba runs the decoupled off-policy "
+        "topology over the device-resident replay ring (in-graph sampling, replay-ratio "
+        "governor, PER, fault tolerance). sac_decoupled remains the host-sampling fallback "
+        "for rings that cannot fit device memory. See howto/async_offpolicy.md.",
+        DeprecationWarning,
+        stacklevel=2,
+    )
 
     rank = fabric.global_rank
 

@@ -1,0 +1,595 @@
+"""SAC — Sebulba-style decoupled actor/learner over the device-resident
+replay ring (async off-policy; no reference counterpart).
+
+This main composes the two subsystems that were proven separately and never
+fused: the PR-3 pipeline (``parallel/pipeline.py``: bounded
+:class:`RolloutQueue`, versioned :class:`ParamServer`, ``Fabric.partition``
+device slices) and the PR-4 HBM replay ring (``replay/device_buffer.py``
+with in-graph uniform/PER sampling). It is the off-policy corner of the
+Podracer story (https://arxiv.org/pdf/2104.06272, §Sebulba) at
+Sample-Factory-style asynchrony (https://arxiv.org/pdf/2006.11751) with
+GA3C-style batched actor inference (https://arxiv.org/pdf/1611.06256):
+
+- **N actor threads**, each stepping its own :class:`FastSyncVectorEnv`
+  batch through a jitted squashed-Gaussian sample on the actor device slice
+  (newest-wins actor params from the :class:`ParamServer`). Every
+  ``algo.sebulba.rollout_block`` env steps an actor packs its transitions
+  into ONE uint8 blob (``DeviceReplayBuffer.pack_rows`` — a pure function,
+  safe for concurrent writers), stages it on the learner mesh from its own
+  thread, and hands it through the bounded queue;
+- the **learner** (main thread) consumes blobs: one donated in-place
+  **append dispatch** (``DeviceReplayBuffer.make_append_step``) scatters the
+  rows into the ring — env-sharded over the learner ``dp`` mesh when
+  divisible — then trains *at its own cadence*: the ``Ratio`` governor
+  converts consumed env steps into granted gradient steps
+  (``algo.replay_ratio`` is an explicit grad-steps-per-env-step knob,
+  decoupled from the env production rate), and each train dispatch samples
+  its minibatches IN-GRAPH (uniform, or proportional via the PER sum-tree)
+  through the append-free variant of
+  :func:`~sheeprl_tpu.algos.sac.sac.make_resident_train_step`.
+
+Rate coupling is exactly two mechanisms, both instrumented: queue
+back-pressure (a full queue stalls actors → env rate tracks the learner's
+drain rate) and the grad-steps-per-env-step governor (the learner never
+trains ahead of ``replay_ratio`` × consumed steps; it starves on an empty
+queue instead). ``Pipeline/replay_ratio_actual``, queue depth, and param
+staleness are logged so a throughput regression is diagnosable from logs
+alone.
+
+The serialized replay+dispatch segment of the coupled host loop — numpy
+sampling + per-grant staging + the env step itself — is OFF the env-step
+critical path here: sampling is in-graph, the blob transfer rides the actor
+thread, and the learner's only host-side replay work is the append dispatch.
+
+Fault semantics ride along from day one: the in-graph divergence sentinel
+(PER tree + ``max_p`` roll back inside ``guarded_select``) with a forced
+re-publish after a rollback, ``CheckpointManager`` (async-capable) saves
+through ``on_checkpoint_coupled`` with the ring state
+(:class:`DeviceReplayState` — storage, write head, PER tree, and the
+device train-key stream) in the ``rb`` sidecar, and
+``checkpoint.resume_from=latest`` restoring counters, params, the ring, and
+BOTH RNG streams (the actor base key and the in-ring train-key stream).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import queue as _queue
+import threading
+import time
+import warnings
+from typing import Any, Dict, List
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import make_resident_train_step, restore_train_state
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.analysis.tracecheck import tracecheck
+from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.parallel.pipeline import (
+    ParamServer,
+    PipelineStats,
+    RolloutQueue,
+    staleness_bound,
+)
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+__all__ = ["main"]
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    from sheeprl_tpu.fault import DivergenceSentinel, load_resume_state
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.replay import DeviceReplayBuffer, DeviceReplayState, resolve_device_resident
+
+    if jax.process_count() > 1:  # pragma: no cover - single-host subsystem
+        raise NotImplementedError(
+            "sac_sebulba pipelines actor threads and the learner inside one controller; "
+            "use the coupled `algo=sac` for multi-host runs."
+        )
+
+    rank = fabric.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_resume_state(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+    if cfg.buffer.sample_next_obs:
+        raise ValueError(
+            "buffer.sample_next_obs stores no explicit next observation; the device-resident "
+            "ring sac_sebulba streams into needs one — disable it or use the coupled host tier."
+        )
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if fabric.is_global_zero:
+        logger.log_hyperparams(cfg)
+    print(f"Log dir: {log_dir}")
+
+    # -- pipeline shape ------------------------------------------------------
+    seb_cfg = cfg.algo.get("sebulba") or {}
+    num_actors = max(1, int(seb_cfg.get("num_actor_threads", 2)))
+    queue_depth = max(1, int(seb_cfg.get("queue_depth", 2)))
+    publish_every = max(1, int(seb_cfg.get("publish_every", 1)))
+    block = max(1, int(seb_cfg.get("rollout_block", 8)))
+    actor_fabric, learner_fabric = fabric.partition(seb_cfg.get("actor_devices", "auto"))
+    actor_devs = list(actor_fabric.devices)
+
+    # -- envs: one vector batch per actor thread -----------------------------
+    # Seed offsets keep per-actor sub-env seeds disjoint (vectorize_env seeds
+    # `seed + rank*num_envs + i`); only actor 0 owns the logging env slot.
+    num_envs = int(cfg.env.num_envs)
+    actor_envs = [
+        vectorize_env(
+            cfg, cfg.seed + a * num_envs, rank, log_dir if (rank == 0 and a == 0) else None, prefix="train"
+        )
+        for a in range(num_actors)
+    ]
+    action_space = actor_envs[0].single_action_space
+    observation_space = actor_envs[0].single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the SAC agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}."
+            )
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    # Agent params live replicated on the LEARNER mesh; actors receive
+    # versioned snapshots of the (tiny) actor subtree on their own slice.
+    agent, params, player = build_agent(
+        learner_fabric, cfg, observation_space, action_space, state["agent"] if state is not None else None
+    )
+
+    critic_tx = build_optimizer(cfg.algo.critic.optimizer)
+    actor_tx = build_optimizer(cfg.algo.actor.optimizer)
+    alpha_tx = build_optimizer(cfg.algo.alpha.optimizer)
+    copt = critic_tx.init(params["critic"])
+    aopt = actor_tx.init(params["actor"])
+    lopt = alpha_tx.init(params["log_alpha"])
+    if state is not None:
+        aopt = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, aopt, state["actor_optimizer"])
+        copt = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, copt, state["qf_optimizer"])
+        lopt = jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, lopt, state["alpha_optimizer"])
+    aopt, copt, lopt = (learner_fabric.put_replicated(o) for o in (aopt, copt, lopt))
+
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        # actors and the learner tick at their own cadence — no rank sync
+        aggregator = build_aggregator(cfg.metric.aggregator, rank_independent=True)
+
+    # -- counters (coupled-loop conventions; see algos/sac/sac.py) -----------
+    last_train = 0
+    train_step = 0
+    start_iter = state["iter_num"] + 1 if state is not None else 1
+    policy_step = state["iter_num"] * num_envs if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state is not None:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size)
+    if batch_size % learner_fabric.world_size != 0:
+        raise ValueError(
+            f"per_rank_batch_size ({batch_size}) must be divisible by the number of learner "
+            f"devices ({learner_fabric.world_size}); adjust fabric.devices/algo.sebulba.actor_devices"
+        )
+
+    # -- device replay ring on the learner sub-mesh --------------------------
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(action_space.shape))
+    buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else block
+    block = min(block, buffer_size)
+    resident_specs = {
+        "observations": ((obs_dim,), jnp.float32),
+        "next_observations": ((obs_dim,), jnp.float32),
+        "actions": ((act_dim,), jnp.float32),
+        "rewards": ((1,), jnp.float32),
+        "terminated": ((1,), jnp.float32),
+    }
+    per_cfg = cfg.buffer.get("priority") or {}
+    prioritized = bool(per_cfg.get("enabled", False))
+    per_beta0 = float(per_cfg.get("beta", 0.4))
+    # The ring IS the storage tier of this topology (actors stream straight
+    # into HBM) — there is no host spillover twin to degrade to, so a ring
+    # that busts the budget is a hard config error, not a silent fallback.
+    use_device, shard_envs, resident_reason = resolve_device_resident(
+        True,
+        resident_specs,
+        buffer_size,
+        num_envs,
+        learner_fabric.world_size,
+        float(cfg.buffer.get("hbm_budget_gb", 4.0)),
+        prioritized,
+    )
+    if not use_device:
+        raise RuntimeError(
+            f"sac_sebulba streams transitions straight into the device-resident replay ring, but {resident_reason}. "
+            "Lower buffer.size, raise buffer.hbm_budget_gb, or run the coupled host tier (algo=sac)."
+        )
+    if cfg.metric.log_level > 0:
+        print(f"Replay: device ring on the learner mesh, shard_envs={shard_envs} ({resident_reason})")
+
+    # grad_max sizes ONE train dispatch's scan: the steady-state grant of a
+    # whole consumed block (bigger backlogs — e.g. the post-prefill burst —
+    # drain over several dispatches)
+    grad_max = max(1, int(np.ceil(cfg.algo.replay_ratio * num_envs * block)))
+    drb = DeviceReplayBuffer(
+        learner_fabric,
+        resident_specs,
+        buffer_size,
+        num_envs,
+        prioritized=prioritized,
+        per_alpha=float(per_cfg.get("alpha", 0.6)),
+        per_eps=float(per_cfg.get("eps", 1e-6)),
+        shard_envs=shard_envs,
+        stage_rows=block,
+        extra_spec=[
+            ("__flags__", (grad_max,), np.float32),
+            ("__valid__", (grad_max,), np.float32),
+            ("__beta__", (), np.float32),
+        ],
+        seed=cfg.seed + 29,
+    )
+    if state is not None and cfg.buffer.checkpoint and state.get("rb") is not None:
+        rb_state = state["rb"][0] if isinstance(state["rb"], list) else state["rb"]
+        if isinstance(rb_state, DeviceReplayState):
+            drb.load_state_dict(rb_state)
+        elif hasattr(rb_state, "buffer"):  # a coupled host-tier ReplayBuffer
+            drb.load_host_buffer(rb_state)
+        else:
+            raise RuntimeError(f"Cannot restore the replay buffer from {type(rb_state)}")
+
+    sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
+    guard = bool(sentinel_cfg.get("enabled", True))
+    sentinel = DivergenceSentinel(sentinel_cfg)
+    ckpt_dir = os.path.join(log_dir, "checkpoint")
+
+    # -- jitted programs: append (ring writer) + append-free train ----------
+    append_fn = tracecheck.instrument(drb.make_append_step(), name="sac_sebulba.append")
+    # donate=False keeps params/opts undonated (the ParamServer publishes
+    # references actors keep pulling across updates); the ring state is still
+    # donated and reused in place.
+    train_fn = tracecheck.instrument(
+        make_resident_train_step(
+            agent, actor_tx, critic_tx, alpha_tx, cfg, learner_fabric.mesh, drb, grad_max,
+            guard=guard, donate=False, append=False,
+        ),
+        name="sac_sebulba.train_step",
+    )
+
+    # -- RNG streams ---------------------------------------------------------
+    # the train-key stream lives ON DEVICE inside the ring state (checkpointed
+    # with it); these two host streams cover the actors and the greedy test
+    rng_train = jax.random.PRNGKey(cfg.seed)
+    actor_rng_base = jax.random.PRNGKey(cfg.seed + 2)
+    if state is not None and state.get("rng") is not None:
+        rng_train = jnp.asarray(state["rng"])
+    if state is not None and state.get("actor_rng") is not None:
+        actor_rng_base = jnp.asarray(state["actor_rng"])
+
+    # -- pipeline plumbing ---------------------------------------------------
+    stats = PipelineStats()
+    rollout_q = RolloutQueue(queue_depth, stats=stats)
+    param_server = ParamServer(params["actor"], publish_every=publish_every, stats=stats)
+    param_server.publish(params["actor"])  # version 1 = initial/restored weights
+    stop_event = threading.Event()
+    actor_errors: List[BaseException] = []
+    bound = staleness_bound(queue_depth, num_actors, publish_every)
+    # The first post-prefill grant replays the whole prefill backlog: the
+    # learner publishes ceil(backlog / (publish_every * grad_max)) times
+    # while the already-queued blobs wait — a one-off staleness transient on
+    # RANDOM-policy transitions (actors don't read params during prefill),
+    # tolerated by the imbalance guard below.
+    prefill_publishes = int(
+        np.ceil(cfg.algo.replay_ratio * cfg.algo.learning_starts / max(1, publish_every * grad_max))
+    )
+
+    # shared prefill account: actors act randomly until the GLOBAL number of
+    # produced env-step rows passes learning_starts (coupled-loop semantics)
+    produced_lock = threading.Lock()
+    produced = {"iters": start_iter - 1}
+
+    # -- actor-side jitted program -------------------------------------------
+    # forward + squashed-Gaussian sample ONLY; per-step keys are pre-split on
+    # the host once per block, so the graph carries no key state
+    def _act(actor_params, obs, key):
+        return agent.sample_action(actor_params, obs, key)[0]
+
+    act_fn = tracecheck.instrument(
+        jax.jit(_act), name="sac_sebulba.act", warmup=num_actors + 1, transfer_guard=False
+    )
+
+    def actor_fn(aid: int, envs) -> None:
+        try:
+            device = actor_devs[aid % len(actor_devs)]
+            rng = jax.random.fold_in(actor_rng_base, aid)
+            obs = envs.reset(seed=cfg.seed + aid * num_envs)[0]
+            rows: List[Dict[str, np.ndarray]] = []
+            ep_infos: List[Any] = []
+            while not stop_event.is_set():
+                version, actor_params = param_server.pull(device)
+                # ONE host-side split serves the whole block
+                _keys = jax.device_get(jax.random.split(rng, block + 1))
+                rng, step_keys = _keys[0], _keys[1:]
+                for t in range(block):
+                    if stop_event.is_set():
+                        return
+                    with produced_lock:
+                        produced["iters"] += 1
+                        my_iter = produced["iters"]
+                    flat_obs = prepare_obs(actor_fabric, obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                    if my_iter <= learning_starts:
+                        actions = envs.action_space.sample()
+                    else:
+                        actions = np.asarray(act_fn(actor_params, flat_obs, step_keys[t]))
+                    next_obs, rewards, terminated, truncated, infos = envs.step(
+                        actions.reshape(envs.action_space.shape)
+                    )
+                    if cfg.metric.log_level > 0 and "final_info" in infos:
+                        ep_info = infos["final_info"]
+                        if isinstance(ep_info, dict) and "episode" in ep_info:
+                            mask = np.asarray(
+                                ep_info.get("_episode", np.ones_like(np.asarray(ep_info["episode"]["r"]), dtype=bool))
+                            ).reshape(-1)
+                            rews = np.asarray(ep_info["episode"]["r"]).reshape(-1)
+                            lens = np.asarray(ep_info["episode"]["l"]).reshape(-1)
+                            for e in np.nonzero(mask)[0]:
+                                ep_infos.append((float(rews[e]), float(lens[e])))
+                    # store the real next observation, patching truncated envs
+                    # with their final obs (coupled-loop semantics)
+                    real_next_obs = copy.deepcopy(next_obs)
+                    if "final_obs" in infos:
+                        for idx, final_obs in enumerate(infos["final_obs"]):
+                            if final_obs is not None:
+                                for k, v in final_obs.items():
+                                    real_next_obs[k][idx] = v
+                    rows.append(
+                        {
+                            "observations": flat_obs,
+                            "next_observations": prepare_obs(
+                                actor_fabric, real_next_obs, mlp_keys=mlp_keys, num_envs=num_envs
+                            ),
+                            "actions": np.asarray(actions, dtype=np.float32).reshape(num_envs, -1),
+                            "rewards": np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1),
+                            "terminated": np.asarray(terminated, dtype=np.float32).reshape(num_envs, -1),
+                        }
+                    )
+                    obs = next_obs
+                # pack + stage on the actor thread: the learner only ever sees
+                # a committed device blob (its critical path has no host copy)
+                blob = learner_fabric.put_replicated(drb.pack_rows(rows))
+                item = {"blob": blob, "count": len(rows), "version": version, "ep_infos": ep_infos}
+                rows, ep_infos = [], []
+                if not rollout_q.put(item, stop_event=stop_event):
+                    return
+        except BaseException as e:  # surface crashes to the learner
+            actor_errors.append(e)
+        finally:
+            try:
+                envs.close()
+            except Exception:
+                pass
+
+    actor_threads = [
+        threading.Thread(target=actor_fn, args=(a, actor_envs[a]), name=f"sac-sebulba-actor-{a}", daemon=True)
+        for a in range(num_actors)
+    ]
+    for t in actor_threads:
+        t.start()
+
+    # -- learner loop --------------------------------------------------------
+    params_live, aopt_live, copt_live, lopt_live = params, aopt, copt, lopt
+    iter_num = start_iter - 1
+    ema_modulus = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
+    ema_backlog: List[float] = []
+    cumulative_grad_steps = 0
+
+    def _checkpoint_state(it: int) -> Dict[str, Any]:
+        return {
+            "agent": params_live,
+            "qf_optimizer": copt_live,
+            "actor_optimizer": aopt_live,
+            "alpha_optimizer": lopt_live,
+            "ratio": ratio.state_dict(),
+            "iter_num": it,
+            "batch_size": batch_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": rng_train,
+            "actor_rng": actor_rng_base,
+        }
+
+    try:
+        while iter_num < total_iters:
+            if actor_errors:  # surface a crashed actor NOW, not at run end
+                raise actor_errors[0]
+            try:
+                item = rollout_q.get(timeout=0.5)
+            except _queue.Empty:
+                if all(not t.is_alive() for t in actor_threads):
+                    raise RuntimeError("All sac_sebulba actor threads exited before training finished")
+                continue
+            count = int(item["count"])
+            stats.observe_staleness(param_server.version - item["version"])
+            # -- append: ONE donated in-place dispatch. This is the WHOLE
+            # replay path on the learner's critical path (packing and the
+            # host→device transfer rode the actor thread; sampling is inside
+            # the train dispatch) — timed for parity with the host tier's
+            # sample+stage segment.
+            with timer("Time/replay_path_time", SumMetric):
+                drb.state = append_fn(drb.state, item["blob"])
+                drb.note_append(count)
+            stats.add("env_steps", count * num_envs)
+
+            # -- grant accounting: identical to the coupled loop, one Ratio
+            # call per consumed env-step row
+            for _ in range(count):
+                iter_num += 1
+                policy_step += policy_steps_per_iter
+                if iter_num >= learning_starts:
+                    granted = ratio(policy_step - prefill_steps + policy_steps_per_iter)
+                    ema_backlog.extend([1.0 if iter_num % ema_modulus == 0 else 0.0] * granted)
+
+            # -- train at the learner's own cadence: drain the granted
+            # backlog in grad_max-sized scans, sampling in-graph
+            while ema_backlog:
+                chunk = min(grad_max, len(ema_backlog))
+                flags = np.zeros((grad_max,), np.float32)
+                valid_mask = np.zeros((grad_max,), np.float32)
+                flags[:chunk] = ema_backlog[:chunk]
+                valid_mask[:chunk] = 1.0
+                if prioritized:
+                    frac = min(1.0, policy_step / max(1, int(cfg.algo.total_steps)))
+                    beta = per_beta0 + (1.0 - per_beta0) * frac  # anneal beta → 1
+                else:
+                    beta = 0.0
+                ctl = drb.make_ctl_job(
+                    {"__flags__": flags, "__valid__": valid_mask, "__beta__": np.float32(beta)}
+                )
+                with timer("Time/train_time", SumMetric):
+                    t0 = time.perf_counter()
+                    outs = train_fn(params_live, aopt_live, copt_live, lopt_live, drb.state, ctl)
+                    params_live, aopt_live, copt_live, lopt_live, drb.state = outs[:5]
+                    drb.note_dispatch_latency(time.perf_counter() - t0)
+                del ema_backlog[:chunk]
+                cumulative_grad_steps += chunk
+                stats.add("grad_steps", chunk)
+                train_step += 1
+                param_server.maybe_publish(train_step, params_live["actor"])
+                qf_l, a_l, al_l = outs[5:8]
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Loss/value_loss", qf_l)
+                    aggregator.update("Loss/policy_loss", a_l)
+                    aggregator.update("Loss/alpha_loss", al_l)
+                if guard and sentinel.observe(outs[8]):
+                    def _rollback(good):
+                        nonlocal params_live, aopt_live, copt_live, lopt_live, rng_train
+                        params_live, aopt_live, copt_live, lopt_live, rng_train = restore_train_state(
+                            learner_fabric, good, params_live, aopt_live, copt_live, lopt_live, rng_train
+                        )
+
+                    sentinel.recover(ckpt_dir, _rollback)
+                    # actors must never keep acting on diverged weights
+                    param_server.publish(params_live["actor"])
+
+            for i, (ep_rew, ep_len) in enumerate(item["ep_infos"]):
+                if aggregator and not aggregator.disabled:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                if cfg.metric.log_level > 0:
+                    print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            # -- logging -----------------------------------------------------
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num >= total_iters
+            ):
+                if aggregator and not aggregator.disabled:
+                    logger.log_dict(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                pipe_metrics = stats.snapshot()
+                pipe_metrics["Pipeline/queue_depth"] = rollout_q.qsize()
+                logger.log_dict(pipe_metrics, policy_step)
+                logger.log_dict(drb.metrics(), policy_step)
+                if guard and sentinel.total_skipped:
+                    logger.log_dict({"Fault/skipped_updates": sentinel.total_skipped}, policy_step)
+                restarts = sum(getattr(e, "env_restarts", 0) for e in actor_envs)
+                if restarts:
+                    logger.log_dict({"Fault/env_restarts": restarts}, policy_step)
+                if policy_step > 0:
+                    logger.log_dict(
+                        {"Params/replay_ratio": cumulative_grad_steps / policy_step}, policy_step
+                    )
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_dict(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+            # -- checkpoint (learner-side; ring state rides the rb sidecar) --
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num >= total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=_checkpoint_state(iter_num),
+                    replay_buffer=drb.state_dict() if cfg.buffer.checkpoint else None,
+                )
+    finally:
+        stop_event.set()
+        rollout_q.drain()
+        for t in actor_threads:
+            t.join(timeout=30.0)
+
+    if actor_errors:
+        raise actor_errors[0]
+    if os.environ.get("SHEEPRL_SEBULBA_DEBUG"):  # pipeline-balance dump for bench/test tuning
+        print(
+            "SAC_SEBULBA_STATS",
+            {
+                **stats.snapshot(),
+                "staleness_max": stats.max_staleness_seen,
+                "policy_steps": policy_step,
+                "grad_steps": cumulative_grad_steps,
+                "prefill_policy_steps": prefill_steps * policy_steps_per_iter,
+            },
+        )
+    if stats.max_staleness_seen > 2 * bound + prefill_publishes:  # pragma: no cover - invariant guard
+        warnings.warn(
+            f"Pipeline params staleness reached {stats.max_staleness_seen} publishes "
+            f"(steady-state bound {bound} + prefill transient {prefill_publishes}): actors "
+            "cannot keep up with the learner — raise algo.sebulba.num_actor_threads or "
+            "publish_every."
+        )
+
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(player, params_live, fabric, cfg, log_dir, writer=logger)
+
+    if not cfg.model_manager.disabled and fabric.is_global_zero:  # pragma: no cover - mlflow optional
+        from sheeprl_tpu.algos.sac.utils import log_models
+        from sheeprl_tpu.utils.mlflow import register_model
+
+        register_model(fabric, log_models, cfg, {"agent": params_live})
+    logger.close()

@@ -92,6 +92,11 @@ class PipelineStats:
         self.max_depth_seen = 0
         self.max_staleness_seen = 0
         self.last_staleness = 0
+        # off-policy pipelines only (sac_sebulba): consumed env steps and
+        # executed gradient steps, so the ACHIEVED replay ratio is a logged
+        # gauge, not something inferred from two other charts
+        self.env_steps = 0
+        self.grad_steps = 0
 
     def add(self, field: str, value: float) -> None:
         with self._lock:
@@ -109,7 +114,7 @@ class PipelineStats:
     def snapshot(self) -> Dict[str, float]:
         """Metric dict (``Pipeline/*``) for ``logger.log_dict``."""
         with self._lock:
-            return {
+            out = {
                 "Pipeline/rollouts_produced": self.rollouts_produced,
                 "Pipeline/rollouts_consumed": self.rollouts_consumed,
                 "Pipeline/actor_stall_s": round(self.actor_stall_s, 4),
@@ -118,6 +123,14 @@ class PipelineStats:
                 "Pipeline/param_staleness": self.last_staleness,
                 "Pipeline/max_queue_depth": self.max_depth_seen,
             }
+            if self.env_steps > 0:
+                # off-policy gauges: the achieved grad-steps-per-env-step
+                # ratio is the governor's acceptance test (throughput
+                # regressions show here before they show in returns)
+                out["Pipeline/env_steps_consumed"] = self.env_steps
+                out["Pipeline/grad_steps"] = self.grad_steps
+                out["Pipeline/replay_ratio_actual"] = round(self.grad_steps / self.env_steps, 4)
+            return out
 
 
 class RolloutQueue:

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.data.ring import BlobLayout, make_layout, pack_burst_blob
+from sheeprl_tpu.data.ring import BlobLayout, make_layout, pack_burst_blob, unpack_burst_blob
 from sheeprl_tpu.replay import sumtree
 
 __all__ = [
@@ -179,12 +179,24 @@ class DeviceReplayBuffer:
         self.stage_rows = int(stage_rows)
         self.tree_leaves = sumtree.leaf_count(self.capacity * self.n_envs) if prioritized else 0
 
+        if self.stage_rows > self.capacity:
+            raise ValueError(
+                f"stage_rows ({self.stage_rows}) cannot exceed the ring capacity ({self.capacity})"
+            )
         # One packed host→device transfer per flush (data/ring.py layouts).
-        spec = [(k, (self.stage_rows, self.n_envs) + shape, np.dtype(str(dtype)))
-                for k, (shape, dtype) in self.specs.items()]
-        spec.append(("__count__", (), np.int32))
-        spec.extend((name, tuple(shape), np.dtype(dtype)) for name, shape, dtype in extra_spec)
-        self.layout: BlobLayout = make_layout(spec)
+        # Three layouts carve the same segment list for the two dispatch
+        # topologies: the coupled fused step consumes `layout` (transitions +
+        # control in one blob), the decoupled (Sebulba) pair consumes
+        # `append_layout` (transitions only — packed by actor threads) and
+        # `ctl_layout` (control segments only — packed by the learner at
+        # train-dispatch time, when the grant governor knows them).
+        base_spec = [(k, (self.stage_rows, self.n_envs) + shape, np.dtype(str(dtype)))
+                     for k, (shape, dtype) in self.specs.items()]
+        base_spec.append(("__count__", (), np.int32))
+        extra = [(name, tuple(shape), np.dtype(dtype)) for name, shape, dtype in extra_spec]
+        self.append_layout: BlobLayout = make_layout(base_spec)
+        self.ctl_layout: Optional[BlobLayout] = make_layout(extra) if extra else None
+        self.layout: BlobLayout = make_layout(base_spec + extra)
 
         self._storage_sharding = (
             fabric.sharding(None, "dp") if self.shard_envs else fabric.replicated
@@ -267,6 +279,28 @@ class DeviceReplayBuffer:
         self._staged.append(row)
         self._metrics["inserts"] += self.n_envs
 
+    def _advance_head(self, count: int) -> None:
+        """Shared wrap rule for the host head mirrors (same as the host
+        buffer, data/buffers.py:154-156)."""
+        if self._pos + count >= self.capacity:
+            self._full = True
+        self._pos = (self._pos + count) % self.capacity
+
+    def _stack_rows(self, rows: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        """Zero-filled ``(stage_rows, n_envs, ...)`` segment dict (+ the row
+        count) from a list of transition rows — the shared packing body of
+        :meth:`make_job` and :meth:`pack_rows`."""
+        values: Dict[str, np.ndarray] = {}
+        for k, (shape, dtype) in self.specs.items():
+            arr = np.zeros((self.stage_rows, self.n_envs) + shape, np.dtype(str(dtype)))
+            for i, row in enumerate(rows):
+                arr[i] = np.asarray(row[k], dtype=np.dtype(str(dtype))).reshape(
+                    (self.n_envs,) + shape
+                )
+            values[k] = arr
+        values["__count__"] = np.asarray(len(rows), np.int32)
+        return values
+
     def make_job(self, extras: Optional[Dict[str, np.ndarray]] = None) -> jax.Array:
         """Pack the staged rows (possibly zero — backlog-drain dispatches
         append nothing) plus the caller's extra segments into ONE uint8 blob,
@@ -277,25 +311,119 @@ class DeviceReplayBuffer:
         of the host loop instead of riding the dispatch."""
         t0 = time.perf_counter()
         count = len(self._staged)
-        values: Dict[str, np.ndarray] = {}
-        for k, (shape, dtype) in self.specs.items():
-            arr = np.zeros((self.stage_rows, self.n_envs) + shape, np.dtype(str(dtype)))
-            for i, row in enumerate(self._staged):
-                arr[i] = row[k]
-            values[k] = arr
-        values["__count__"] = np.asarray(count, np.int32)
+        values = self._stack_rows(self._staged)
         for k, v in (extras or {}).items():
             values[k] = v
         self._staged.clear()
         blob = self.fabric.put_replicated(pack_burst_blob(self.layout, values))
-        # same wrap rule as the host buffer (data/buffers.py:154-156)
-        if self._pos + count >= self.capacity:
-            self._full = True
-        self._pos = (self._pos + count) % self.capacity
+        self._advance_head(count)
         self._metrics["flushes"] += 1
         self._metrics["bytes_staged"] += int(blob.nbytes)
         self._metrics["insert_latency_s"] += time.perf_counter() - t0
         return blob
+
+    # -- decoupled (Sebulba) append/train dispatch pair ----------------------
+    def pack_rows(self, rows: Sequence[Dict[str, np.ndarray]]) -> np.ndarray:
+        """Pack up to ``stage_rows`` transition rows (each ``(n_envs, ...)``)
+        into one append blob for :meth:`make_append_step`.
+
+        Unlike :meth:`add`/:meth:`make_job` this is a pure function of its
+        argument — nothing on ``self`` is touched — so CONCURRENT actor
+        threads can each pack their own blob (the single-writer learner
+        advances the host mirrors via :meth:`note_append` when it consumes
+        one). Returns a host uint8 array; the caller stages it on the mesh
+        (``fabric.put_replicated``) from its own thread, off the learner's
+        critical path."""
+        if len(rows) > self.stage_rows:
+            raise ValueError(
+                f"{len(rows)} rows exceed the append blob capacity (stage_rows={self.stage_rows})"
+            )
+        return pack_burst_blob(self.append_layout, self._stack_rows(rows))
+
+    def note_append(self, count: int) -> None:
+        """Advance the host head mirrors for one consumed append blob (the
+        learner-side bookkeeping twin of :meth:`make_job`'s tail)."""
+        count = int(count)
+        if count <= 0:
+            return
+        self._advance_head(count)
+        self._metrics["flushes"] += 1
+        self._metrics["inserts"] += count * self.n_envs
+        self._metrics["bytes_staged"] += int(self.append_layout.nbytes)
+
+    def make_ctl_job(self, extras: Dict[str, np.ndarray]) -> jax.Array:
+        """Pack ONLY the control segments (``extra_spec``) and stage them on
+        the mesh — the append-free train step's per-dispatch input."""
+        if self.ctl_layout is None:
+            raise RuntimeError(
+                "DeviceReplayBuffer was built without extra_spec control segments"
+            )
+        return self.fabric.put_replicated(pack_burst_blob(self.ctl_layout, dict(extras)))
+
+    def make_append_step(self, donate: bool = True):
+        """Build the jitted multi-row append program for the decoupled
+        (Sebulba) topology: ``fn(rb_state, blob) -> rb_state``.
+
+        ``blob`` is an :meth:`pack_rows` blob already staged on the mesh. Up
+        to ``stage_rows`` rows are scattered at the write head in ONE
+        donated in-place dispatch (rows past ``__count__`` target index
+        ``capacity`` and are dropped); with PER enabled, fresh transitions
+        enter the sum-tree at the running max priority. Sampling stays with
+        the train step — the learner thread owns both dispatches, so the
+        ring never has two writers in flight."""
+        from jax.sharding import PartitionSpec as P
+
+        from sheeprl_tpu.parallel.compat import shard_map
+
+        capacity = self.capacity
+        rows = self.stage_rows
+        n_envs = self.n_envs
+        prioritized = self.prioritized
+        layout = self.append_layout
+        specs = self.specs
+
+        def local_append(storage, pos, vld, tree, max_p, staged, count):
+            real_idx = (pos + jnp.arange(rows)) % capacity
+            idx = jnp.where(jnp.arange(rows) < count, real_idx, capacity)
+            storage = {k: storage[k].at[idx].set(staged[k], mode="drop") for k in storage}
+            new_pos = (pos + count) % capacity
+            new_vld = jnp.minimum(vld + count, capacity)
+            if prioritized:
+                # fresh rows enter at the running max priority; padding rows
+                # rewrite their current value (a value-level no-op)
+                leaves = (
+                    real_idx[:, None] * n_envs + jnp.arange(n_envs, dtype=real_idx.dtype)[None, :]
+                ).reshape(-1)
+                row_valid = jnp.repeat(jnp.arange(rows) < count, n_envs)
+                prio = jnp.where(row_valid, max_p, sumtree.get(tree, leaves))
+                tree = sumtree.update(tree, leaves, prio)
+            return storage, new_pos, new_vld, tree, max_p
+
+        storage_spec = P(None, "dp") if self.shard_envs else P()
+        shard_append = shard_map(
+            local_append,
+            mesh=self.fabric.mesh,
+            in_specs=(storage_spec, P(), P(), P(), P(), storage_spec, P()),
+            out_specs=(storage_spec, P(), P(), P(), P()),
+            check_vma=False,
+        )
+
+        def packed_append(rb_state, blob):
+            u = unpack_burst_blob(blob, layout)
+            staged = {k: u[k] for k in specs}
+            tree = rb_state.get("tree", jnp.zeros((2,), jnp.float32))
+            max_p = rb_state.get("max_p", jnp.ones((), jnp.float32))
+            storage, pos, vld, tree, max_p = shard_append(
+                rb_state["storage"], rb_state["pos"], rb_state["valid"], tree, max_p,
+                staged, u["__count__"],
+            )
+            new_state = {"storage": storage, "pos": pos, "valid": vld, "key": rb_state["key"]}
+            if prioritized:
+                new_state["tree"] = tree
+                new_state["max_p"] = max_p
+            return new_state
+
+        return jax.jit(packed_append, donate_argnums=(0,) if donate else ())
 
     def note_dispatch_latency(self, seconds: float) -> None:
         """Wall time of the fused append+sample+train dispatch (the whole

@@ -24,6 +24,7 @@ _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
     "sheeprl_tpu.algos.sac.sac",
     "sheeprl_tpu.algos.sac.sac_decoupled",
+    "sheeprl_tpu.algos.sac.sac_sebulba",
     "sheeprl_tpu.algos.sac_ae.sac_ae",
     "sheeprl_tpu.algos.droq.droq",
     "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",

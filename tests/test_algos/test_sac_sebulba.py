@@ -1,0 +1,310 @@
+"""sac_sebulba end-to-end: async actor/learner dry runs through the real CLI
+(1/2 devices, env-sharded learner mesh, PER), the replay-ratio governor's
+measured grad-steps-per-env-step bound, queue back-pressure under more actors
+than slots, a checkpoint → SIGKILL → ``resume_from=latest`` round trip that
+restores both RNG streams and the ring state, and (slow lane) Pendulum return
+parity vs the coupled SAC host loop."""
+
+import ast
+import glob
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import sheeprl_tpu
+from sheeprl_tpu.cli import run
+
+REPO_ROOT = str(Path(sheeprl_tpu.__file__).parents[1])
+
+SEBULBA_FAST = [
+    "exp=sac_sebulba",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "buffer.size=64",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.per_rank_batch_size=8",
+    "algo.hidden_size=16",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.learning_starts=4",
+    "algo.total_steps=32",
+    "checkpoint.save_last=False",
+    "checkpoint.every=0",
+]
+
+
+def _ckpts(root):
+    return sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+
+
+def _stats(capfd):
+    """Parse the SAC_SEBULBA_STATS debug line the run prints."""
+    out, _err = capfd.readouterr()
+    lines = [l for l in out.splitlines() if l.startswith("SAC_SEBULBA_STATS ")]
+    assert lines, f"no SAC_SEBULBA_STATS line in output:\n{out[-2000:]}"
+    return ast.literal_eval(lines[-1][len("SAC_SEBULBA_STATS "):])
+
+
+@pytest.fixture()
+def sebulba_debug(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_SEBULBA_DEBUG", "1")
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_sac_sebulba_dry_run(tmp_path, devices):
+    """devices=1 time-slices one chip between the actor and learner sides;
+    devices=2 splits them into disjoint single-device slices."""
+    run(SEBULBA_FAST + [f"fabric.devices={devices}", f"log_root={tmp_path}/logs"])
+
+
+def test_sac_sebulba_env_sharded_learner(tmp_path, capfd):
+    """actor_devices=0 on a 2-device mesh keeps BOTH devices on the learner
+    side: with num_envs divisible the ring storage env-shards over `dp`
+    (per-device HBM = total/2) and the run must still consume total_steps."""
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=2",
+            "algo.sebulba.actor_devices=0",
+            "metric.log_level=1",
+            "metric.log_every=70000",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+    out, _ = capfd.readouterr()
+    assert "shard_envs=True" in out
+
+
+def test_sac_sebulba_replay_ratio_governor(tmp_path, sebulba_debug, capfd):
+    """The governor must hold the ACHIEVED grad-steps-per-env-step at the
+    configured algo.replay_ratio (up to the prefill window and integer
+    grant quantization), decoupled from how fast the actors produce."""
+    ratio = 2.0
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=1",
+            "env.num_envs=1",
+            f"algo.replay_ratio={ratio}",
+            "algo.learning_starts=8",
+            "algo.total_steps=128",
+            "algo.sebulba.rollout_block=4",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+    stats = _stats(capfd)
+    env_steps = stats["Pipeline/env_steps_consumed"]
+    grad_steps = stats["Pipeline/grad_steps"]
+    assert env_steps >= 128
+    # grants start after the prefill window: expected ≈ ratio * (consumed -
+    # prefill); allow the first-grant quantization one step of slack
+    expected = ratio * (env_steps - stats["prefill_policy_steps"])
+    assert abs(grad_steps - expected) <= ratio + 1, (grad_steps, expected, stats)
+    # and the logged gauge agrees with the raw counters
+    assert stats["Pipeline/replay_ratio_actual"] == pytest.approx(grad_steps / env_steps, abs=1e-3)
+
+
+def test_sac_sebulba_backpressure_small_queue(tmp_path, sebulba_debug, capfd):
+    """More actors than queue slots for many learner iterations: the bounded
+    queue must back-pressure (not drop/deadlock), the run must consume
+    total_steps, and the stall/starvation gauges must be populated."""
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=1",
+            "algo.total_steps=96",
+            "algo.sebulba.num_actor_threads=3",
+            "algo.sebulba.queue_depth=1",
+            "algo.sebulba.publish_every=2",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+    stats = _stats(capfd)
+    assert stats["Pipeline/env_steps_consumed"] >= 96
+    assert stats["Pipeline/rollouts_produced"] >= stats["Pipeline/rollouts_consumed"] > 0
+    # 3 fast actors against a depth-1 queue MUST have blocked at least once
+    assert stats["Pipeline/actor_stall_s"] > 0
+    assert stats["Pipeline/max_queue_depth"] <= 1
+    for key in ("Pipeline/learner_starved_s", "Pipeline/param_staleness", "Pipeline/replay_ratio_actual"):
+        assert key in stats
+
+
+def test_sac_sebulba_prioritized(tmp_path):
+    """PER on the async path: proportional in-graph sampling + IS weights,
+    fresh streamed transitions entering at max priority."""
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=1",
+            "buffer.priority.enabled=true",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+
+
+def test_sac_sebulba_evaluation_from_checkpoint(tmp_path):
+    """The sac_sebulba checkpoint shares the SAC "agent" layout: the shared
+    sac evaluate entrypoint loads it."""
+    from sheeprl_tpu.cli import evaluation
+
+    run(
+        SEBULBA_FAST[:-2]
+        + [
+            "fabric.devices=1",
+            "checkpoint.save_last=True",
+            "checkpoint.every=0",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+    ckpt = _ckpts(f"{tmp_path}/logs")[-1]
+    evaluation([f"checkpoint_path={ckpt}", "env.capture_video=False", "fabric.accelerator=cpu"])
+
+
+KILL_ARGS = [
+    "exp=sac_sebulba",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "buffer.size=64",
+    "buffer.checkpoint=True",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.per_rank_batch_size=8",
+    "algo.hidden_size=16",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.learning_starts=4",
+    "algo.total_steps=48",
+    "algo.sebulba.rollout_block=4",
+    "checkpoint.every=16",
+    "checkpoint.save_last=True",
+    "seed=11",
+    "log_root=logs",
+]
+
+
+def _launch(tmp_path, extra_args=(), extra_env=None):
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("SHEEPRL_FAULT_KILL", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", *KILL_ARGS, *extra_args],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+
+
+@pytest.mark.fault
+def test_sac_sebulba_checkpoint_kill_resume_from_latest(tmp_path):
+    """Checkpoint → SIGKILL mid-save → ``resume_from=latest``: the resumed
+    run continues the counters AND restores the two RNG streams (actor base
+    key + in-ring train-key stream) and the full ring state — proven by the
+    final ring holding every row of the whole 48-step schedule, which only a
+    restored ring can."""
+    proc = _launch(tmp_path, extra_env={"SHEEPRL_FAULT_KILL": "checkpoint.pre_commit:2"})
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    ckpt_dirs = glob.glob(str(tmp_path / "logs/sac_sebulba/continuous_dummy/*/version_*/checkpoint"))
+    assert len(ckpt_dirs) == 1
+    from sheeprl_tpu.fault.manager import latest_complete
+
+    first_complete = latest_complete(ckpt_dirs[0])
+    assert first_complete is not None and first_complete.name.startswith("ckpt_16")
+
+    proc2 = _launch(tmp_path, extra_args=["checkpoint.resume_from=latest"])
+    assert proc2.returncode == 0, (proc2.stdout[-2000:], proc2.stderr[-2000:])
+    assert "checkpoint.resume_from=latest ->" in proc2.stdout
+
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    final = find_latest_run_checkpoint(tmp_path / "logs/sac_sebulba/continuous_dummy")
+    state = load_state(final)
+    # counters continued monotonically to the full schedule
+    assert state["iter_num"] >= 48
+    assert int(os.path.basename(str(final)).split("_")[1]) >= 48
+    # both RNG streams rode the checkpoint
+    assert state.get("rng") is not None and state.get("actor_rng") is not None
+    import jax
+
+    for leaf in jax.tree.leaves(state["agent"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # ring state: every env-step row of the WHOLE run is in the ring — the
+    # resumed process must have restored the pre-kill rows, not re-allocated
+    rb = state["rb"][0] if isinstance(state["rb"], list) else state["rb"]
+    from sheeprl_tpu.replay import DeviceReplayState
+
+    assert isinstance(rb, DeviceReplayState)
+    assert int(rb.arrays["valid"]) >= 48
+    # the in-ring train-key stream advanced past its seed (fresh PRNGKey(seed
+    # + 29)) and was carried across the kill
+    import jax.random as jrandom
+
+    assert not np.array_equal(np.asarray(rb.arrays["key"]), np.asarray(jrandom.PRNGKey(11 + 29)))
+
+
+@pytest.mark.slow
+def test_sac_sebulba_return_parity_with_coupled_loop_on_pendulum(tmp_path):
+    """Same recipe, same budget on real Pendulum: the async run's returns
+    must match the coupled host loop's (the decoupling adds bounded
+    staleness, not a different algorithm). Both must clear an absolute floor
+    no non-learning agent reaches (random Pendulum ≈ -1200)."""
+    sys.path.insert(0, REPO_ROOT)
+    from benchmarks.learning_bench import capture_returns
+
+    budget = 16384
+    common = [
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.num_envs=1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "buffer.size=65536",
+        "buffer.checkpoint=False",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        "metric.log_every=70000",
+        "algo.run_test=False",
+        f"algo.total_steps={budget}",
+        "algo.replay_ratio=1.0",
+        "algo.learning_starts=512",
+        "algo.per_rank_batch_size=256",
+        "algo.hidden_size=64",
+        "algo.mlp_keys.encoder=[state]",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+        "seed=7",
+    ]
+
+    def best_window(returns, w=10):
+        if len(returns) < w:
+            return -1e9
+        return max(sum(returns[i : i + w]) / w for i in range(len(returns) - w + 1))
+
+    host = capture_returns(
+        ["exp=sac", "algo.hybrid_player.enabled=False", f"log_root={tmp_path}/host"] + common
+    )
+    seb = capture_returns(["exp=sac_sebulba", f"log_root={tmp_path}/sebulba"] + common)
+    host_best, seb_best = best_window(host), best_window(seb)
+    assert host_best >= -500, f"coupled SAC failed to learn Pendulum: best10={host_best} n={len(host)}"
+    assert seb_best >= -500, f"sac_sebulba failed to learn Pendulum: best10={seb_best} n={len(seb)}"

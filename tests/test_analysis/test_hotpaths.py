@@ -96,6 +96,17 @@ def test_ppo_sebulba_dry_run_clean(tmp_path, trace_hygiene):
     )
 
 
+def test_sac_sebulba_dry_run_clean(tmp_path, trace_hygiene):
+    """Async off-policy Sebulba: the actor inference path, the ring append
+    path, and the append-free train step must all run guarded steady-state
+    calls with 0 post-warmup retraces."""
+    run(_args(tmp_path, "sac_sebulba", extra=SAC_FAST + ["algo.learning_starts=0"]))
+    _assert_quiet(
+        trace_hygiene,
+        ["sac_sebulba.train_step", "sac_sebulba.act", "sac_sebulba.append"],
+    )
+
+
 def test_planted_host_sync_is_caught(tmp_path, trace_hygiene, monkeypatch):
     """Regression-proof the guard itself: break the explicit staging (the
     exact hazard class the suite polices) and the steady-state transfer guard

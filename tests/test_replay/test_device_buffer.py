@@ -264,3 +264,108 @@ def test_spillover_resolution():
     assert not shard
     _, shard, _ = resolve_device_resident("auto", small, 100, 4, 2, 1.0)
     assert shard
+
+
+# -- decoupled (Sebulba) append path ----------------------------------------
+
+
+def _np_ring_expect(blocks):
+    """Reference ring built with plain numpy from a list of row-lists."""
+    ring = {k: np.zeros((CAP, N_ENVS) + shape, np.float32) for k, (shape, _d) in SPECS.items()}
+    pos, valid = 0, 0
+    for rows in blocks:
+        for row in rows:
+            for k in SPECS:
+                ring[k][pos] = row[k].reshape((N_ENVS,) + SPECS[k][0])
+            pos = (pos + 1) % CAP
+            valid = min(valid + 1, CAP)
+    return ring, pos, valid
+
+
+def test_pack_rows_is_pure_and_thread_reusable(fabric1):
+    """pack_rows must not touch the buffer (concurrent actor threads each
+    pack their own blob): identical bytes twice, heads unmoved."""
+    drb = _mk(fabric1, stage_rows=3)
+    rows = [{k: v[0] for k, v in _row(t).items()} for t in range(2)]
+    b1 = drb.pack_rows(rows)
+    b2 = drb.pack_rows(rows)
+    np.testing.assert_array_equal(b1, b2)
+    assert b1.dtype == np.uint8 and b1.nbytes == drb.append_layout.nbytes
+    assert drb.pos == 0 and not drb.full and drb.empty
+    with pytest.raises(ValueError, match="exceed the append blob"):
+        drb.pack_rows([{k: v[0] for k, v in _row(t).items()} for t in range(4)])
+
+
+def test_append_step_multi_row_parity_and_wraparound(fabric1):
+    """The jitted multi-row append must match a plain numpy ring through
+    partial blobs and a wrap-around, and note_append must mirror the heads."""
+    drb = _mk(fabric1, stage_rows=3)
+    append = drb.make_append_step()
+    blocks = [
+        [{k: v[0] for k, v in _row(t).items()} for t in range(3)],        # rows 0-2
+        [{k: v[0] for k, v in _row(t).items()} for t in range(3, 5)],     # partial (2 of 3)
+        [{k: v[0] for k, v in _row(t).items()} for t in range(5, 8)],     # rows 5-7
+        [{k: v[0] for k, v in _row(t).items()} for t in range(8, 10)],    # wraps: rows 8-9
+    ]
+    for rows in blocks:
+        blob = fabric1.put_replicated(drb.pack_rows(rows))
+        drb.state = append(drb.state, blob)
+        drb.note_append(len(rows))
+    expect, pos, valid = _np_ring_expect(blocks)
+    for k in SPECS:
+        np.testing.assert_array_equal(np.asarray(drb.state["storage"][k]), expect[k])
+    assert int(drb.state["pos"]) == pos == drb.pos
+    assert int(drb.state["valid"]) == valid
+    assert drb.full
+
+
+def test_append_step_env_sharded(fabric2):
+    """Env-sharded storage: the append scatters each device's env shard in
+    place and the reassembled checkpoint equals the replicated reference."""
+    drb_sh = _mk(fabric2, shard_envs=True, stage_rows=2)
+    drb_rep = _mk(fabric2, shard_envs=False, stage_rows=2)
+    app_sh = drb_sh.make_append_step()
+    app_rep = drb_rep.make_append_step()
+    for t0 in range(0, 6, 2):
+        rows = [{k: v[0] for k, v in _row(t).items()} for t in range(t0, t0 + 2)]
+        blob = fabric2.put_replicated(drb_sh.pack_rows(rows))
+        drb_sh.state = app_sh(drb_sh.state, blob)
+        drb_sh.note_append(2)
+        blob = fabric2.put_replicated(drb_rep.pack_rows(rows))
+        drb_rep.state = app_rep(drb_rep.state, blob)
+        drb_rep.note_append(2)
+    sh, rep = drb_sh.state_dict(), drb_rep.state_dict()
+    for k in SPECS:
+        np.testing.assert_array_equal(sh.arrays[f"storage/{k}"], rep.arrays[f"storage/{k}"])
+    assert int(sh.arrays["valid"]) == 6
+
+
+def test_append_step_prioritized_fresh_rows_at_max_p(fabric1):
+    """PER: every fresh (row, env) leaf enters at the running max priority;
+    leaves beyond the blob's count keep their value (and the padding slots
+    beyond capacity stay zero)."""
+    drb = _mk(fabric1, prioritized=True, stage_rows=3)
+    append = drb.make_append_step()
+    blob = fabric1.put_replicated(drb.pack_rows([{k: v[0] for k, v in _row(t).items()} for t in range(2)]))
+    drb.state = append(drb.state, blob)
+    drb.note_append(2)
+    tree = np.asarray(drb.state["tree"])
+    P = tree.shape[0] // 2
+    assert tree[P : P + 2 * N_ENVS].tolist() == [1.0] * (2 * N_ENVS)  # max_p starts at 1
+    assert tree[P + 2 * N_ENVS :].sum() == 0
+    assert float(tree[1]) == 2.0 * N_ENVS  # root = total mass
+
+
+def test_ctl_job_layout_split(fabric1):
+    """The control blob carries ONLY the extra segments; a buffer without
+    extra_spec refuses to build one."""
+    drb = _mk(fabric1, extra_spec=[("__flags__", (4,), np.float32), ("__beta__", (), np.float32)])
+    ctl = drb.make_ctl_job({"__flags__": np.arange(4, dtype=np.float32), "__beta__": np.float32(0.5)})
+    assert int(ctl.nbytes) == drb.ctl_layout.nbytes < drb.layout.nbytes
+    u = jax.jit(lambda b: unpack_burst_blob(b, drb.ctl_layout))(ctl)
+    np.testing.assert_array_equal(np.asarray(u["__flags__"]), np.arange(4, dtype=np.float32))
+    assert float(u["__beta__"]) == 0.5
+    bare = _mk(fabric1)
+    assert bare.ctl_layout is None
+    with pytest.raises(RuntimeError, match="extra_spec"):
+        bare.make_ctl_job({})
