@@ -1,43 +1,32 @@
 #!/usr/bin/env python
-"""Benchmark harness: PPO CartPole env-steps/sec on the available accelerator.
+"""Benchmark harness: one lane per measured topology, one JSON line out.
 
-Mirrors the reference benchmark conditions (``sheeprl/configs/exp/
-ppo_benchmarks.yaml``: 65536 total steps, 1 env, sync, logging/checkpoints
-off; reference wall-clock 81.27 s on 4 CPUs → ~806 env-steps/s, see
-BASELINE.md).
+Mirrors the reference benchmark conditions for the default lane
+(``sheeprl/configs/exp/ppo_benchmarks.yaml``: 65536 total steps, 1 env, sync,
+logging/checkpoints off; reference wall-clock 81.27 s on 4 CPUs → ~806
+env-steps/s, see BASELINE.md).
 
-``BENCH_METRIC`` selects the measured topology (default unchanged so the
-recorded trajectory stays comparable):
+``BENCH_METRIC`` selects the lane from the registry below (default ``host``
+so the recorded trajectory stays comparable). Adding a lane = one
+``@lane(...)``-decorated runner — the selection error message and the CI
+matrix read the registry, nothing is hand-enumerated:
 
-- ``host`` (default) — ``ppo_cartpole_env_steps_per_sec``: the host-loop
-  PPO (``exp=ppo_benchmarks``), one jitted policy dispatch per env step;
-- ``ondevice`` — ``ppo_cartpole_ondevice_env_steps_per_sec``: the Anakin
-  path (``exp=ppo_anakin_benchmarks``, same model/optim/data conditions)
-  with the rollout fused in-graph over the pure-JAX CartPole
-  (howto/on_device_rollout.md);
-- ``sebulba`` — ``ppo_cartpole_sebulba_env_steps_per_sec``: the decoupled
-  actor/learner pipeline (``exp=ppo_sebulba_benchmarks``, same
-  model/optim/data conditions) with host env stepping, inference and
-  learning overlapped (howto/decoupled_training.md);
-- ``replay`` — ``sac_pendulum_replay_grad_steps_per_sec``: SAC
-  gradient-steps/s through the replay data path
-  (``exp=sac_replay_benchmarks``, replay-ratio-4 so sampling dominates).
-  ``BENCH_REPLAY_MODE=device`` (default) runs the device-resident ring
-  (``buffer.device_resident=true``, howto/device_replay.md);
-  ``BENCH_REPLAY_MODE=host`` runs the host-sampling path — the paired
-  driver compares the two on the same topology;
-- ``sac_sebulba`` — ``sac_pendulum_sebulba_env_steps_per_sec``: the async
-  off-policy pipeline (``exp=sac_sebulba_benchmarks``,
-  howto/async_offpolicy.md) vs the coupled SAC host loop at an IDENTICAL
-  recipe and replay ratio (``BENCH_SAC_MODE=async`` (default) | ``coupled``
-  — the coupled twin is ``exp=sac_async_coupled_benchmarks``, whose
-  per-env-step critical path serializes env step + inference + numpy
-  sample + staging + train; the async run moves the first two onto actor
-  threads and the sampling in-graph). Both report env-steps/s plus the
-  Time/* split, so the serialized replay-path seconds the async topology
-  removes from the env-step critical path are visible in the JSON.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- ``host`` — ``ppo_cartpole_env_steps_per_sec``: the host-loop PPO
+  (``exp=ppo_benchmarks``), one jitted policy dispatch per env step;
+- ``ondevice`` — the Anakin path (``exp=ppo_anakin_benchmarks``) with the
+  rollout fused in-graph (howto/on_device_rollout.md);
+- ``sebulba`` — the decoupled actor/learner pipeline
+  (``exp=ppo_sebulba_benchmarks``, howto/decoupled_training.md);
+- ``replay`` — SAC grad-steps/s through the replay data path
+  (``exp=sac_replay_benchmarks``; ``BENCH_REPLAY_MODE=device|host`` pairs
+  the device-resident ring against host sampling, howto/device_replay.md);
+- ``sac_sebulba`` — the async off-policy pipeline vs its coupled twin at an
+  identical recipe (``BENCH_SAC_MODE=async|coupled``,
+  howto/async_offpolicy.md);
+- ``serve`` — the continuous-batching inference tier: p50/p99 latency +
+  throughput at fixed offered loads, AOT bucketed engine
+  (``BENCH_SERVE_MODE=aot``) vs naive per-request jit dispatch (``naive``),
+  one hot weight swap per load (howto/serving.md; benchmarks/serve_bench.py).
 """
 
 from __future__ import annotations
@@ -46,8 +35,207 @@ import json
 import os
 import sys
 import time
+from typing import Callable, Dict, List
 
 BASELINE_STEPS_PER_SEC = 65536 / 81.27  # reference PPO benchmark (README.md:100-117)
+
+#: lane name -> {"runner": fn, "aliases": (...)}; populated by @lane
+LANES: Dict[str, Dict[str, object]] = {}
+
+
+def lane(name: str, *aliases: str) -> Callable:
+    """Register a bench lane under ``name`` (+ aliases, e.g. the metric id)."""
+
+    def decorator(fn: Callable[[], None]) -> Callable[[], None]:
+        LANES[name] = {"runner": fn, "aliases": (name, *aliases)}
+        return fn
+
+    return decorator
+
+
+def resolve_lane(which: str) -> Callable[[], None]:
+    for entry in LANES.values():
+        if which in entry["aliases"]:
+            return entry["runner"]  # type: ignore[return-value]
+    raise SystemExit(f"Unknown BENCH_METRIC '{which}' (expected one of {sorted(LANES)})")
+
+
+def _env_steps(default_steps: int) -> int:
+    return int(os.environ.get("BENCH_TOTAL_STEPS", default_steps))
+
+
+def _run_cli(exp: str, total_steps: int, extra: List[str] = (), keep_timer: bool = False) -> float:
+    """Run one training CLI invocation under the shared bench conditions;
+    returns the elapsed wall-clock seconds."""
+    overrides = [
+        f"exp={exp}",
+        f"algo.total_steps={total_steps}",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "checkpoint.save_last=False",
+        "metric.log_level=0",
+        # keep_timer: the Time/* instrumentation stays alive so per-segment
+        # seconds are readable after a log_level=0 run
+        f"metric.disable_timer={'False' if keep_timer else 'True'}",
+        *extra,
+    ]
+    from sheeprl_tpu.cli import run
+
+    start = time.perf_counter()
+    run(overrides)
+    return time.perf_counter() - start
+
+
+@lane("host", "", "default", "ppo_cartpole_env_steps_per_sec")
+def _lane_host() -> None:
+    total_steps = _env_steps(65536)
+    elapsed = _run_cli("ppo_benchmarks", total_steps)
+    steps_per_sec = total_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "env-steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+@lane("ondevice", "anakin", "ppo_cartpole_ondevice_env_steps_per_sec")
+def _lane_ondevice() -> None:
+    # The fused path retires 65536 steps in ~3s of loop time: at the host
+    # metric's step count the measurement is interpreter/compile-bound, not
+    # framework-bound. 16x the steps keeps the whole-wall convention while
+    # the training loop dominates (still well under a minute).
+    total_steps = _env_steps(1048576)
+    elapsed = _run_cli("ppo_anakin_benchmarks", total_steps)
+    steps_per_sec = total_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_ondevice_env_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "env-steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+@lane("sebulba", "ppo_cartpole_sebulba_env_steps_per_sec")
+def _lane_sebulba() -> None:
+    total_steps = _env_steps(65536)
+    elapsed = _run_cli("ppo_sebulba_benchmarks", total_steps)
+    steps_per_sec = total_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_sebulba_env_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "env-steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+@lane("replay", "sac_pendulum_replay_grad_steps_per_sec")
+def _lane_replay() -> None:
+    replay_mode = os.environ.get("BENCH_REPLAY_MODE", "device").strip().lower()
+    if replay_mode not in ("device", "host"):
+        raise SystemExit(f"Unknown BENCH_REPLAY_MODE '{replay_mode}' (expected 'device' or 'host')")
+    total_steps = _env_steps(8192)
+    exp = "sac_replay_benchmarks"
+    elapsed = _run_cli(
+        exp,
+        total_steps,
+        extra=[f"buffer.device_resident={'true' if replay_mode == 'device' else 'false'}"],
+        keep_timer=True,
+    )
+    # Both modes execute the identical grant schedule (same Ratio, same
+    # seeds), so per-mode throughput is directly comparable. Two views:
+    # - end-to-end grad-steps/s (whole wall): on a CPU-only host the two
+    #   modes tie — the gradient math dominates and there is no device
+    #   boundary to cross;
+    # - grad-steps per second of REPLAY-PATH time: the serialized host-side
+    #   sample+stage segment each gradient step waits on — numpy sampling +
+    #   device staging for the host tier vs one packed blob for the resident
+    #   tier. This is exactly the host-in-the-loop cost the subsystem
+    #   removes (and what a tunneled TPU multiplies by the wire latency), so
+    #   it is the headline `value`.
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.utils.timer import timer as _timer
+
+    cfg = compose([f"exp={exp}", f"algo.total_steps={total_steps}"])
+    grad_steps = max(1, int(cfg.algo.replay_ratio * (total_steps - cfg.algo.learning_starts)))
+    replay_path_s = _timer.compute().get("Time/replay_path_time", 0.0)
+    value = grad_steps / replay_path_s if replay_path_s > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "sac_pendulum_replay_grad_steps_per_sec",
+                "value": round(value, 2),
+                "unit": "grad-steps per replay-path second",
+                "mode": replay_mode,
+                "grad_steps": grad_steps,
+                "replay_path_s": round(replay_path_s, 3),
+                "end_to_end_grad_steps_per_sec": round(grad_steps / elapsed, 2),
+                "elapsed_s": round(elapsed, 2),
+                # no vs_baseline: the PPO reference bar is env-steps/s —
+                # dividing grad-steps/s by it would be a unit mismatch
+            }
+        )
+    )
+
+
+@lane("sac_sebulba", "sac_async", "sac_pendulum_sebulba_env_steps_per_sec")
+def _lane_sac_sebulba() -> None:
+    sac_mode = os.environ.get("BENCH_SAC_MODE", "async").strip().lower()
+    if sac_mode not in ("async", "coupled"):
+        raise SystemExit(f"Unknown BENCH_SAC_MODE '{sac_mode}' (expected 'async' or 'coupled')")
+    # the coupled twin is a dedicated exp with the IDENTICAL recipe (model,
+    # batch, replay ratio, env) so the ONLY difference between the two runs
+    # is the topology
+    exp = "sac_sebulba_benchmarks" if sac_mode == "async" else "sac_async_coupled_benchmarks"
+    total_steps = _env_steps(8192)
+    elapsed = _run_cli(exp, total_steps, keep_timer=True)
+    # Both modes consume the identical grant schedule, so env-steps/s is
+    # directly comparable. The replay-path seconds show WHERE the time went:
+    # coupled = the serialized host sample+stage segment on the env-step
+    # critical path; async = just the learner's append dispatch (packing +
+    # transfer ride the actor threads).
+    from sheeprl_tpu.utils.timer import timer as _timer
+
+    timers = _timer.compute()
+    print(
+        json.dumps(
+            {
+                "metric": "sac_pendulum_sebulba_env_steps_per_sec",
+                "value": round(total_steps / elapsed, 2),
+                "unit": "env-steps/s",
+                "mode": sac_mode,
+                "elapsed_s": round(elapsed, 2),
+                "replay_path_s": round(timers.get("Time/replay_path_time", 0.0), 3),
+                "train_s": round(timers.get("Time/train_time", 0.0), 3),
+                "env_interaction_s": round(timers.get("Time/env_interaction_time", 0.0), 3),
+                # no vs_baseline: the PPO reference bar is a different
+                # algorithm's env rate
+            }
+        )
+    )
+
+
+@lane("serve", "serve_policy_inference", "ppo_cartpole_serve_requests_per_sec")
+def _lane_serve() -> None:
+    # Offered-load latency/throughput SLO lane for the inference tier; all
+    # knobs (BENCH_SERVE_MODE / _LOADS / _DURATION / _CLIENTS) documented in
+    # benchmarks/serve_bench.py, results interpretation in howto/serving.md.
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from serve_bench import main as serve_main
+
+    serve_main()
 
 
 def main() -> None:
@@ -77,147 +265,7 @@ def main() -> None:
         pass
 
     which = os.environ.get("BENCH_METRIC", "host").strip().lower()
-    if which in ("", "host", "default", "ppo_cartpole_env_steps_per_sec"):
-        metric = "ppo_cartpole_env_steps_per_sec"
-        exp = "ppo_benchmarks"
-        default_steps = 65536
-    elif which in ("ondevice", "anakin", "ppo_cartpole_ondevice_env_steps_per_sec"):
-        metric = "ppo_cartpole_ondevice_env_steps_per_sec"
-        exp = "ppo_anakin_benchmarks"
-        # The fused path retires 65536 steps in ~3s of loop time: at the host
-        # metric's step count the measurement is interpreter/compile-bound,
-        # not framework-bound. 16x the steps keeps the whole-wall convention
-        # while the training loop dominates (still well under a minute).
-        default_steps = 1048576
-    elif which in ("sebulba", "ppo_cartpole_sebulba_env_steps_per_sec"):
-        metric = "ppo_cartpole_sebulba_env_steps_per_sec"
-        exp = "ppo_sebulba_benchmarks"
-        default_steps = 65536
-    elif which in ("replay", "sac_pendulum_replay_grad_steps_per_sec"):
-        metric = "sac_pendulum_replay_grad_steps_per_sec"
-        exp = "sac_replay_benchmarks"
-        default_steps = 8192
-    elif which in ("sac_sebulba", "sac_async", "sac_pendulum_sebulba_env_steps_per_sec"):
-        metric = "sac_pendulum_sebulba_env_steps_per_sec"
-        sac_mode = os.environ.get("BENCH_SAC_MODE", "async").strip().lower()
-        if sac_mode not in ("async", "coupled"):
-            raise SystemExit(f"Unknown BENCH_SAC_MODE '{sac_mode}' (expected 'async' or 'coupled')")
-        # the coupled twin is a dedicated exp with the IDENTICAL recipe
-        # (model, batch, replay ratio, env) so the ONLY difference between
-        # the two runs is the topology
-        exp = "sac_sebulba_benchmarks" if sac_mode == "async" else "sac_async_coupled_benchmarks"
-        default_steps = 8192
-    else:
-        raise SystemExit(
-            f"Unknown BENCH_METRIC '{which}' (expected 'host', 'ondevice', 'sebulba', 'replay' "
-            "or 'sac_sebulba')"
-        )
-    total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", default_steps))
-    overrides = [
-        f"exp={exp}",
-        f"algo.total_steps={total_steps}",
-        "env.capture_video=False",
-        "buffer.memmap=False",
-        "checkpoint.save_last=False",
-        "metric.log_level=0",
-        "metric.disable_timer=True",
-    ]
-    if metric == "sac_pendulum_sebulba_env_steps_per_sec":
-        # keep the Time/* instrumentation alive so the serialized replay-path
-        # segment (coupled: numpy sample + staging; async: the learner's
-        # append dispatch) is readable after the run
-        overrides.remove("metric.disable_timer=True")
-        overrides.append("metric.disable_timer=False")
-    replay_mode = None
-    if metric == "sac_pendulum_replay_grad_steps_per_sec":
-        replay_mode = os.environ.get("BENCH_REPLAY_MODE", "device").strip().lower()
-        if replay_mode not in ("device", "host"):
-            raise SystemExit(f"Unknown BENCH_REPLAY_MODE '{replay_mode}' (expected 'device' or 'host')")
-        overrides.append(f"buffer.device_resident={'true' if replay_mode == 'device' else 'false'}")
-        # keep the Time/replay_path_time instrumentation alive: with
-        # log_level=0 nothing ever resets it, so the accumulated sum is
-        # readable after the run
-        overrides.remove("metric.disable_timer=True")
-        overrides.append("metric.disable_timer=False")
-    from sheeprl_tpu.cli import run
-
-    start = time.perf_counter()
-    run(overrides)
-    elapsed = time.perf_counter() - start
-    if metric == "sac_pendulum_replay_grad_steps_per_sec":
-        # Both modes execute the identical grant schedule (same Ratio, same
-        # seeds), so per-mode throughput is directly comparable. Two views:
-        # - end-to-end grad-steps/s (whole wall): on a CPU-only host the two
-        #   modes tie — the gradient math dominates and there is no device
-        #   boundary to cross;
-        # - grad-steps per second of REPLAY-PATH time: the serialized
-        #   host-side sample+stage segment each gradient step waits on —
-        #   numpy sampling + device staging for the host tier vs one packed
-        #   blob for the resident tier. This is exactly the host-in-the-loop
-        #   cost the subsystem removes (and what a tunneled TPU multiplies
-        #   by the wire latency), so it is the headline `value`.
-        from sheeprl_tpu.config import compose
-        from sheeprl_tpu.utils.timer import timer as _timer
-
-        cfg = compose([f"exp={exp}", f"algo.total_steps={total_steps}"])
-        grad_steps = max(1, int(cfg.algo.replay_ratio * (total_steps - cfg.algo.learning_starts)))
-        replay_path_s = _timer.compute().get("Time/replay_path_time", 0.0)
-        value = grad_steps / replay_path_s if replay_path_s > 0 else 0.0
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": round(value, 2),
-                    "unit": "grad-steps per replay-path second",
-                    "mode": replay_mode,
-                    "grad_steps": grad_steps,
-                    "replay_path_s": round(replay_path_s, 3),
-                    "end_to_end_grad_steps_per_sec": round(grad_steps / elapsed, 2),
-                    "elapsed_s": round(elapsed, 2),
-                    # no vs_baseline: the PPO reference bar is env-steps/s —
-                    # dividing grad-steps/s by it would be a unit mismatch
-                }
-            )
-        )
-        return
-    if metric == "sac_pendulum_sebulba_env_steps_per_sec":
-        # Both modes consume the identical grant schedule (same Ratio, same
-        # recipe), so env-steps/s is directly comparable. The replay-path
-        # seconds show WHERE the time went: for the coupled loop it is the
-        # serialized host sample+stage segment on the env-step critical
-        # path; for the async run it is just the learner's append dispatch
-        # (packing + transfer ride the actor threads).
-        from sheeprl_tpu.utils.timer import timer as _timer
-
-        timers = _timer.compute()
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": round(total_steps / elapsed, 2),
-                    "unit": "env-steps/s",
-                    "mode": sac_mode,
-                    "elapsed_s": round(elapsed, 2),
-                    "replay_path_s": round(timers.get("Time/replay_path_time", 0.0), 3),
-                    "train_s": round(timers.get("Time/train_time", 0.0), 3),
-                    "env_interaction_s": round(timers.get("Time/env_interaction_time", 0.0), 3),
-                    # no vs_baseline: the PPO reference bar is a different
-                    # algorithm's env rate
-                }
-            )
-        )
-        return
-    steps_per_sec = total_steps / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(steps_per_sec, 2),
-                "unit": "env-steps/s",
-                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
-            }
-        )
-    )
+    resolve_lane(which)()
 
 
 if __name__ == "__main__":

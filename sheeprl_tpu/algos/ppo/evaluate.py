@@ -1,4 +1,6 @@
-"""PPO evaluation entrypoint (reference: ``sheeprl/algos/ppo/evaluate.py``)."""
+"""PPO evaluation entrypoint (reference: ``sheeprl/algos/ppo/evaluate.py``)
+plus the serving-tier policy builder (same checkpoint layout, same registry
+population trigger)."""
 
 from __future__ import annotations
 
@@ -10,9 +12,9 @@ from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.utils import test
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.registry import register_evaluation, register_policy_builder
 
-__all__ = ["evaluate_ppo"]
+__all__ = ["evaluate_ppo", "serve_policy_ppo"]
 
 
 # The decoupled, Anakin and Sebulba mains write the same checkpoint layout
@@ -49,3 +51,74 @@ def evaluate_ppo(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     _, params, player = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, state["agent"])
     test(player, params, fabric, cfg, log_dir, writer=logger)
     logger.close()
+
+
+@register_policy_builder(algorithms=["ppo", "ppo_decoupled", "ppo_anakin", "ppo_sebulba"])
+def serve_policy_ppo(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state):
+    """:class:`~sheeprl_tpu.serve.policy.ServePolicy` over the PPO agent.
+
+    The greedy/sample programs are ``sample_actions`` — the exact math the
+    eval ``test`` loop runs — with the eval loop's host-side action
+    conversion (continuous: concat heads; discrete: per-head argmax) moved
+    in-graph, so served actions match ``sheeprl_tpu eval`` bit for bit.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.ppo.agent import sample_actions
+    from sheeprl_tpu.algos.ppo.utils import prepare_obs
+    from sheeprl_tpu.serve.policy import ServePolicy
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    agent, params, _ = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_state)
+    params_template = params
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_spec = {}
+    for k in cnn_keys:
+        obs_spec[k] = (tuple(int(d) for d in observation_space[k].shape[-3:]), np.float32)
+    for k in mlp_keys:
+        obs_spec[k] = ((int(np.prod(observation_space[k].shape)),), np.float32)
+
+    def _env_actions(acts):
+        if is_continuous:
+            return jnp.concatenate(acts, axis=-1)
+        return jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)
+
+    _greedy_key = jax.random.PRNGKey(0)  # greedy path never consumes it
+
+    def greedy_fn(p, obs):
+        acts, _, _ = sample_actions(agent, p, obs, _greedy_key, greedy=True)
+        return _env_actions(acts)
+
+    def sample_fn(p, obs, key):
+        acts, _, _ = sample_actions(agent, p, obs, key, greedy=False)
+        return _env_actions(acts)
+
+    def prepare(obs, n):
+        prepared = prepare_obs(fabric, {k: obs[k] for k in obs_spec}, cnn_keys=cnn_keys, num_envs=n)
+        return {k: prepared[k] for k in obs_spec}
+
+    def params_from_state(new_agent_state):
+        rebuilt = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params_template, new_agent_state)
+        return fabric.put_replicated(rebuilt)
+
+    action_dim = int(sum(actions_dim)) if is_continuous else len(actions_dim)
+    return ServePolicy(
+        name=str(cfg.algo.name),
+        params=params,
+        obs_spec=obs_spec,
+        action_dim=action_dim,
+        greedy_fn=greedy_fn,
+        sample_fn=sample_fn,
+        prepare=prepare,
+        params_from_state=params_from_state,
+    )

@@ -10,9 +10,9 @@ from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.registry import register_evaluation, register_policy_builder
 
-__all__ = ["evaluate_sac"]
+__all__ = ["evaluate_sac", "serve_policy_sac"]
 
 
 # Shared with the decoupled mains — same "agent" checkpoint layout
@@ -32,3 +32,48 @@ def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     _, params, player = build_agent(fabric, cfg, observation_space, action_space, state["agent"])
     test(player, params, fabric, cfg, log_dir, writer=logger)
     logger.close()
+
+
+@register_policy_builder(algorithms=["sac", "sac_decoupled", "sac_sebulba"])
+def serve_policy_sac(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state):
+    """:class:`~sheeprl_tpu.serve.policy.ServePolicy` over the SAC agent:
+    greedy = ``agent.greedy_action`` (tanh-squashed mean, rescaled), sample =
+    the squashed-Gaussian draw — the same programs the eval player jits, over
+    the same flattened mlp-keys observation ``utils.prepare_obs`` builds."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.sac.utils import prepare_obs
+    from sheeprl_tpu.serve.policy import ServePolicy
+
+    agent, params, _ = build_agent(fabric, cfg, observation_space, action_space, agent_state)
+    params_template = params
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in mlp_keys))
+    obs_spec = {"obs": ((obs_dim,), np.float32)}
+    act_dim = int(np.prod(action_space.shape))
+
+    def greedy_fn(p, obs):
+        return agent.greedy_action(p["actor"], obs["obs"])
+
+    def sample_fn(p, obs, key):
+        return agent.sample_action(p["actor"], obs["obs"], key)[0]
+
+    def prepare(obs, n):
+        return {"obs": prepare_obs(fabric, obs, mlp_keys=mlp_keys, num_envs=n)}
+
+    def params_from_state(new_agent_state):
+        rebuilt = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params_template, new_agent_state)
+        return fabric.put_replicated(rebuilt)
+
+    return ServePolicy(
+        name=str(cfg.algo.name),
+        params=params,
+        obs_spec=obs_spec,
+        action_dim=act_dim,
+        greedy_fn=greedy_fn,
+        sample_fn=sample_fn,
+        prepare=prepare,
+        params_from_state=params_from_state,
+    )

@@ -4,6 +4,8 @@ Verbs mirror the reference console scripts:
 
 - ``sheeprl_tpu run exp=ppo ...`` (or just ``sheeprl_tpu exp=ppo``) — train;
 - ``sheeprl_tpu eval checkpoint_path=...`` — evaluate a checkpoint;
+- ``sheeprl_tpu serve checkpoint_path=...`` — serve a checkpoint behind the
+  continuous-batching inference tier (howto/serving.md);
 - ``sheeprl_tpu agents`` — list registered algorithms;
 - ``sheeprl_tpu registration ...`` — MLflow model registration (optional dep).
 
@@ -30,7 +32,63 @@ from sheeprl_tpu.utils.registry import (
     resolve_evaluation,
 )
 
-__all__ = ["run", "evaluation", "registration", "available_agents", "main", "run_algorithm", "eval_algorithm"]
+__all__ = [
+    "run",
+    "evaluation",
+    "serve",
+    "registration",
+    "available_agents",
+    "main",
+    "run_algorithm",
+    "eval_algorithm",
+    "serve_algorithm",
+    "find_run_config",
+]
+
+
+def find_run_config(checkpoint_path: "str | Path") -> Path:
+    """Locate the ``config.yaml`` of the run that wrote ``checkpoint_path``.
+
+    The canonical layout puts the checkpoint at
+    ``<run_dir>/checkpoint/ckpt_*.ckpt`` with the config at
+    ``<run_dir>/config.yaml`` — but checkpoints get copied around, and the
+    old ``checkpoint_path.parent.parent / "config.yaml"`` guess died with a
+    raw open failure. Discovery order:
+
+    1. the canonical ``parent.parent / config.yaml``;
+    2. the checkpoint-manifest anchor: if an ancestor directory holds the
+       fault-runtime ``manifest.json``, that directory is the run's
+       ``checkpoint/`` dir, so its parent's ``config.yaml`` is the run
+       config;
+    3. walking upward from the checkpoint: the nearest ancestor (up to the
+       filesystem root) with a ``config.yaml``.
+
+    Raises a typed :class:`~sheeprl_tpu.utils.checkpoint.CheckpointError`
+    naming the checkpoint and every path searched when nothing is found.
+    """
+    from sheeprl_tpu.fault.manager import MANIFEST_NAME
+    from sheeprl_tpu.utils.checkpoint import CheckpointError
+
+    ckpt = Path(checkpoint_path)
+    candidates: List[Path] = [ckpt.parent.parent / "config.yaml"]
+    for anc in ckpt.parents:
+        if (anc / MANIFEST_NAME).is_file():
+            candidates.append(anc.parent / "config.yaml")
+    candidates.extend(anc / "config.yaml" for anc in ckpt.parents)
+    searched: List[Path] = []
+    for cand in candidates:
+        if cand in searched:
+            continue
+        searched.append(cand)
+        if cand.is_file():
+            return cand
+    raise CheckpointError(
+        f"No run config.yaml found for checkpoint {ckpt}. Searched: "
+        + ", ".join(str(p) for p in searched)
+        + ". Pass a checkpoint inside its run directory (<run>/checkpoint/ckpt_*.ckpt) "
+        "or place the run's config.yaml next to it.",
+        searched[0],
+    )
 
 
 def resolve_resume_latest(cfg: DotDict) -> DotDict:
@@ -61,7 +119,7 @@ def resume_from_checkpoint(cfg: DotDict) -> DotDict:
     from sheeprl_tpu.config import deep_merge
 
     ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
-    old_cfg = dotdict(load_yaml(ckpt_path.parent.parent / "config.yaml"))
+    old_cfg = dotdict(load_yaml(find_run_config(ckpt_path)))
     if old_cfg.env.id != cfg.env.id:
         raise ValueError(
             "This experiment is run with a different environment from the one of the experiment you want to restart. "
@@ -148,7 +206,7 @@ def run_algorithm(cfg: DotDict) -> None:
     kwargs: Dict[str, Any] = {}
     if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
         ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
-        exploration_cfg = dotdict(load_yaml(ckpt_path.parent.parent / "config.yaml"))
+        exploration_cfg = dotdict(load_yaml(find_run_config(ckpt_path)))
         if exploration_cfg.env.id != cfg.env.id:
             raise ValueError(
                 "This experiment is run with a different environment from the one of the exploration you want to "
@@ -247,6 +305,55 @@ def eval_algorithm(cfg: DotDict) -> None:
     fabric.launch(command, cfg, state)
 
 
+def serve_algorithm(cfg: DotDict) -> None:
+    """Build the serving tier for one checkpoint and run it
+    (howto/serving.md). Mirrors :func:`eval_algorithm` — single-device
+    fabric, checkpoint state, per-algo registry resolution — but resolves
+    the algorithm's *policy builder* and hands off to the continuous-batching
+    server instead of the offline test loop."""
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.serve.server import serve_policy
+    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.utils.registry import resolve_policy_builder
+    from sheeprl_tpu.utils.utils import pin_cpu_platform
+
+    pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
+
+    fabric = Fabric(
+        devices=1,
+        accelerator=cfg.fabric.get("accelerator", "auto"),
+        precision=str(cfg.fabric.get("precision", "32-true")),
+    )
+    fabric.seed_everything(cfg.seed if cfg.get("seed") is not None else 42)
+    state = load_state(cfg.checkpoint_path)
+
+    entry = resolve_policy_builder(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(
+            f"Given the algorithm named '{cfg.algo.name}', no serving policy builder has been registered."
+        )
+    builder = get_entrypoint(entry)
+    fabric.launch(serve_policy, cfg, state, builder)
+
+
+def serve(args: Optional[List[str]] = None) -> None:
+    """Serve a checkpoint behind the continuous-batching inference tier
+    (``sheeprl_tpu serve checkpoint_path=... [serve.buckets=[1,8,32] ...]``).
+    Shares :func:`find_run_config` discovery and the config-merge shape with
+    :func:`evaluation`."""
+    args = list(sys.argv[1:] if args is None else args)
+    serve_cfg = compose(args, config_name="serve_config")
+    if not serve_cfg.get("checkpoint_path"):
+        raise ValueError("You must specify the checkpoint path to serve")
+    merged = _merged_ckpt_cfg(
+        serve_cfg,
+        "serve",
+        capture_video=False,
+        extra={"serve": dict(serve_cfg.get("serve", {}))},
+    )
+    serve_algorithm(merged)
+
+
 def available_agents() -> None:
     """Rich table of registered algorithms
     (reference: ``sheeprl/available_agents.py:7-35``)."""
@@ -286,39 +393,59 @@ def run(args: Optional[List[str]] = None) -> None:
     run_algorithm(cfg)
 
 
-def evaluation(args: Optional[List[str]] = None) -> None:
-    """Evaluate a checkpoint (reference: ``cli.py:368-404``)."""
-    args = list(sys.argv[1:] if args is None else args)
-    eval_cfg = compose(args, config_name="eval_config")
-    if not eval_cfg.get("checkpoint_path"):
-        raise ValueError("You must specify the evaluation checkpoint path")
-    checkpoint_path = Path(os.path.abspath(eval_cfg.checkpoint_path))
-    ckpt_cfg = dotdict(load_yaml(checkpoint_path.parent.parent / "config.yaml"))
-
+def _merged_ckpt_cfg(
+    verb_cfg: DotDict,
+    verb: str,
+    capture_video: bool,
+    extra: Optional[Dict[str, Any]] = None,
+) -> DotDict:
+    """The eval/serve config-merge shape: the checkpoint run's own config
+    (via :func:`find_run_config`) overlaid with single-device fabric, the
+    verb's seed/accelerator overrides and the run-relative log anchors.
+    ``root_dir``/``run_name`` follow the canonical
+    ``<root>/<algo>/<env>/<run>/checkpoint/ckpt_*.ckpt`` layout (for a
+    checkpoint discovered elsewhere they only steer where the verb's own
+    logs land)."""
     from sheeprl_tpu.config import deep_merge
 
-    capture_video = eval_cfg.get("env", {}).get("capture_video", True)
+    checkpoint_path = Path(os.path.abspath(verb_cfg.checkpoint_path))
+    ckpt_cfg = dotdict(load_yaml(find_run_config(checkpoint_path)))
     merged = dict(ckpt_cfg)
     deep_merge(
         merged,
         {
             "env": {"capture_video": capture_video, "num_envs": 1},
-            "fabric": {"devices": 1, "strategy": "auto", "accelerator": eval_cfg.get("fabric", {}).get("accelerator", "auto")},
+            "fabric": {
+                "devices": 1,
+                "strategy": "auto",
+                "accelerator": verb_cfg.get("fabric", {}).get("accelerator", "auto"),
+            },
             "checkpoint_path": str(checkpoint_path),
-            "seed": eval_cfg.get("seed") if eval_cfg.get("seed") is not None else ckpt_cfg.get("seed", 42),
+            "seed": verb_cfg.get("seed") if verb_cfg.get("seed") is not None else ckpt_cfg.get("seed", 42),
             "root_dir": str(checkpoint_path.parent.parent.parent.parent),
             "run_name": str(
                 Path(
                     os.path.join(
                         os.path.basename(str(checkpoint_path.parent.parent.parent)),
                         os.path.basename(str(checkpoint_path.parent.parent)),
-                        "evaluation",
+                        verb,
                     )
                 )
             ),
+            **(extra or {}),
         },
     )
-    eval_algorithm(dotdict(merged))
+    return dotdict(merged)
+
+
+def evaluation(args: Optional[List[str]] = None) -> None:
+    """Evaluate a checkpoint (reference: ``cli.py:368-404``)."""
+    args = list(sys.argv[1:] if args is None else args)
+    eval_cfg = compose(args, config_name="eval_config")
+    if not eval_cfg.get("checkpoint_path"):
+        raise ValueError("You must specify the evaluation checkpoint path")
+    capture_video = eval_cfg.get("env", {}).get("capture_video", True)
+    eval_algorithm(_merged_ckpt_cfg(eval_cfg, "evaluation", capture_video=capture_video))
 
 
 def registration(args: Optional[List[str]] = None) -> None:
@@ -330,7 +457,7 @@ def registration(args: Optional[List[str]] = None) -> None:
     args = list(sys.argv[1:] if args is None else args)
     cfg = compose(args, config_name="model_manager_config")
     checkpoint_path = Path(cfg.checkpoint_path)
-    ckpt_cfg = dotdict(load_yaml(checkpoint_path.parent.parent / "config.yaml"))
+    ckpt_cfg = dotdict(load_yaml(find_run_config(checkpoint_path)))
     for k in ("env", "exp_name", "algo", "distribution", "seed"):
         cfg[k] = ckpt_cfg[k]
     cfg.to_log = ckpt_cfg
@@ -352,7 +479,7 @@ def registration(args: Optional[List[str]] = None) -> None:
 def main() -> None:
     """Entry: dispatch on first positional verb."""
     argv = sys.argv[1:]
-    if argv and argv[0] in ("run", "eval", "evaluation", "agents", "registration"):
+    if argv and argv[0] in ("run", "eval", "evaluation", "serve", "agents", "registration"):
         verb, rest = argv[0], argv[1:]
     else:
         verb, rest = "run", argv
@@ -360,6 +487,8 @@ def main() -> None:
         run(rest)
     elif verb in ("eval", "evaluation"):
         evaluation(rest)
+    elif verb == "serve":
+        serve(rest)
     elif verb == "agents":
         available_agents()
     elif verb == "registration":

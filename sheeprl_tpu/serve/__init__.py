@@ -1,0 +1,48 @@
+"""graft-serve: continuous-batching policy inference tier.
+
+The serving half of the shared train/serve hot path (ROADMAP item 3): trained
+checkpoints exposed behind a micro-batching request scheduler feeding
+AOT-compiled (``jit(...).lower(...).compile()``) policy programs at a static
+ladder of padded batch buckets, with versioned hot-swappable weights riding
+:class:`~sheeprl_tpu.parallel.pipeline.ParamServer`'s newest-wins snapshot
+cache. GA3C's predictor queue (arXiv 1611.06256) with Podracer's fixed-shape
+pre-compiled device programs (arXiv 2104.06272) — the same machinery whether
+the callers are end users over the socket front end or actor threads using
+:class:`PolicyClient` as their batched-inference backend.
+
+Layers (``howto/serving.md`` is the operator guide):
+
+- :mod:`sheeprl_tpu.serve.policy` — the algo-agnostic :class:`ServePolicy`
+  contract policy builders return (registered per algorithm next to the
+  evaluation entry points);
+- :mod:`sheeprl_tpu.serve.engine` — :class:`BucketEngine`: per-checkpoint AOT
+  compilation at the bucket ladder, bucket selection + padding/unpadding on
+  the hot path (no request shape ever triggers a fresh trace), plus the
+  deliberately naive :class:`JitEngine` baseline the bench compares against;
+- :mod:`sheeprl_tpu.serve.scheduler` — :class:`RequestScheduler`: max-wait /
+  max-batch admission, backpressure past a queue bound, ``Serve/*`` metrics;
+- :mod:`sheeprl_tpu.serve.weights` — :class:`WeightStore` versioned hot swap
+  + :class:`CheckpointWatcher` (checkpoint-dir manifests → publishes);
+- :mod:`sheeprl_tpu.serve.server` — :class:`PolicyServer` assembly,
+  in-process :class:`PolicyClient`, and the thin JSON-lines socket front end.
+"""
+
+from sheeprl_tpu.serve.engine import BucketEngine, JitEngine
+from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeClosedError, ServeOverloadedError, ServeStats
+from sheeprl_tpu.serve.server import PolicyClient, PolicyServer
+from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
+
+__all__ = [
+    "BucketEngine",
+    "JitEngine",
+    "ServePolicy",
+    "RequestScheduler",
+    "ServeStats",
+    "ServeOverloadedError",
+    "ServeClosedError",
+    "WeightStore",
+    "CheckpointWatcher",
+    "PolicyClient",
+    "PolicyServer",
+]

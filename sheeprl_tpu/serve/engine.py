@@ -1,0 +1,270 @@
+"""AOT bucket-compiled policy inference engine.
+
+Podracer's observation (arXiv 2104.06272) is that cheap TPU dispatch comes
+from *pre-compiled, fixed-shape* device programs. :class:`BucketEngine`
+applies it to serving: at construction it lowers and compiles the policy
+program once per padded batch bucket (``jit(fn).lower(...).compile()`` —
+ahead-of-time, so the jit dispatch cache and its retrace machinery are out of
+the picture entirely), and the hot path only ever selects a bucket, pads the
+batch into a preallocated staging slab, runs the compiled executable and
+slices the real rows back out. No request shape can trigger a fresh trace:
+arbitrary batch sizes map onto the static ladder (oversize batches are
+chunked through the largest bucket).
+
+Hot-swap contract: ``infer`` takes the params tree per call — the engine
+holds no weights. A rebuilt tree with identical avals (see
+``ServePolicy.params_from_state``) drops into the compiled executables with
+zero recompiles, which is what makes weight swaps torn-request-free: every
+batch runs under exactly one params snapshot.
+
+:class:`JitEngine` is the deliberately naive per-request baseline (one
+``jax.jit`` dispatch at whatever shape shows up) the ``BENCH_METRIC=serve``
+lane compares against — it is correct, but every new batch size is a fresh
+trace and every request its own dispatch.
+
+Both engines are registered with :mod:`sheeprl_tpu.analysis.tracecheck`:
+``serve.infer`` (the shared padded-dispatch entry; one abstract signature per
+bucket, all warmed at construction) and ``serve.bucket[N]`` (each compiled
+executable). The trace-hygiene suite asserts 0 post-warmup retraces — by
+construction for the AOT path, and the assertion is what keeps it true.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.analysis.tracecheck import tracecheck
+from sheeprl_tpu.parallel.pipeline import DoubleBufferedStager
+from sheeprl_tpu.serve.policy import ServePolicy
+
+__all__ = ["BucketEngine", "JitEngine", "default_buckets"]
+
+
+def default_buckets() -> Tuple[int, ...]:
+    return (1, 8, 32, 128)
+
+
+def _shape_struct(tree: Any) -> Any:
+    return jax.tree.map(lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), tree)
+
+
+class BucketEngine:
+    """Continuous-batching inference over a static ladder of AOT programs.
+
+    ``mode``: ``"greedy"`` compiles only the greedy program, ``"sample"``
+    only the stochastic one, ``"both"`` compiles the pair per bucket.
+
+    Thread-safety: :meth:`infer` reuses per-bucket staging slabs and is
+    serialized by an internal lock — the scheduler drives it from one worker
+    thread anyway; the lock makes direct multi-threaded use (e.g. several
+    in-process :class:`~sheeprl_tpu.serve.server.PolicyClient` users without
+    a scheduler) safe rather than subtly corrupt.
+    """
+
+    def __init__(
+        self,
+        policy: ServePolicy,
+        buckets: Optional[Sequence[int]] = None,
+        mode: str = "greedy",
+        warmup: bool = True,
+    ) -> None:
+        buckets = tuple(sorted({int(b) for b in (buckets or default_buckets())}))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bucket ladder must be positive ints, got {buckets}")
+        if mode not in ("greedy", "sample", "both"):
+            raise ValueError(f"engine mode must be greedy|sample|both, got {mode!r}")
+        self.policy = policy
+        self.buckets = buckets
+        self.mode = mode
+        self._lock = threading.Lock()
+        # per-bucket host staging rides the pipeline's DoubleBufferedStager
+        # (acquire mode: slabs handed out for in-place row writes, the same
+        # discipline the Sebulba actors use). Ring depth 2 covers the one
+        # dispatch that can be in flight while the next batch assembles;
+        # infer() blocks on the result before releasing the slab anyway
+        # (CPU device_put may zero-copy-alias host memory).
+        self._templates: Dict[int, Dict[str, Tuple[Tuple[int, ...], Any]]] = {
+            b: {k: ((b, *shape), np.dtype(dtype)) for k, (shape, dtype) in policy.obs_spec.items()}
+            for b in buckets
+        }
+        self._stagers: Dict[int, DoubleBufferedStager] = {b: DoubleBufferedStager(None) for b in buckets}
+        # per-(bucket, greedy) compiled executables; lowered against the
+        # CURRENT params avals — any swapped-in tree must match them
+        self._programs: Dict[Tuple[int, bool], Any] = {}
+        self._key_aval = jax.random.PRNGKey(0)
+        params_struct = _shape_struct(policy.params)
+        modes = {"greedy": (True,), "sample": (False,), "both": (True, False)}[mode]
+        for b in buckets:
+            obs_struct = {
+                k: jax.ShapeDtypeStruct((b, *shape), np.dtype(dtype)) for k, (shape, dtype) in policy.obs_spec.items()
+            }
+            for greedy in modes:
+                if greedy:
+                    compiled = jax.jit(policy.greedy_fn).lower(params_struct, obs_struct).compile()
+                else:
+                    compiled = jax.jit(policy.sample_fn).lower(params_struct, obs_struct, _shape_struct(self._key_aval)).compile()
+                tag = "greedy" if greedy else "sample"
+                self._programs[(b, greedy)] = tracecheck.instrument(
+                    compiled,
+                    name=f"serve.bucket[{b}].{tag}",
+                    warmup=1,  # first call registers the (only) signature
+                    transfer_guard=False,  # host obs slabs by contract
+                )
+        # one shared entry over the padded dispatch: exactly one abstract
+        # signature per (bucket, mode), all of them warmed below
+        self._dispatch = tracecheck.instrument(
+            self._dispatch_impl,
+            name="serve.infer",
+            warmup=len(buckets) * len(modes),
+            transfer_guard=False,
+        )
+        # counters (read by the scheduler's Serve/* metrics)
+        self.dispatches = 0
+        self.rows = 0
+        self.padded_rows = 0
+        if warmup:
+            self._warmup()
+
+    # -- construction helpers ------------------------------------------------ #
+
+    def _warmup(self) -> None:
+        """Run every compiled program once on a zeroed slab: pays first-call
+        transfer/layout costs up front AND registers every abstract signature
+        inside the tracecheck warmup window."""
+        for (b, greedy) in self._programs:
+            slab = self._stagers[b].acquire(self._templates[b])
+            for k in slab:
+                slab[k][:] = 0
+            self._dispatch(b, greedy, self.policy.params, slab, self._key_aval)
+
+    # -- hot path ------------------------------------------------------------ #
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket admitting ``n`` rows (largest bucket if ``n``
+        exceeds the ladder — the caller chunks)."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _dispatch_impl(self, bucket: int, greedy: bool, params: Any, obs: Dict[str, Any], key: Any):
+        program = self._programs[(bucket, greedy)]
+        if greedy:
+            return program(params, obs)
+        return program(params, obs, key)
+
+    def infer(
+        self,
+        params: Any,
+        obs: Dict[str, np.ndarray],
+        key: Optional[Any] = None,
+        greedy: Optional[bool] = None,
+    ) -> np.ndarray:
+        """Actions for a prepared batch of ``n`` rows, any ``n >= 1``.
+
+        Selects the smallest admitting bucket, pads into the bucket's staging
+        slab (stale tail rows are zeroed — row-independent programs make them
+        free either way), runs the AOT executable and returns the real rows
+        as a host array. Batches beyond the largest bucket are chunked
+        through it. ``greedy`` defaults by engine mode; sample mode requires
+        ``key`` (one key per call — the caller advances it).
+        """
+        if greedy is None:
+            greedy = self.mode != "sample"
+        want = "greedy" if greedy else "sample"
+        if self.mode not in (want, "both"):
+            raise ValueError(f"engine compiled for mode={self.mode!r} cannot serve {want} requests")
+        if not greedy and key is None:
+            raise ValueError("sample-mode infer needs a PRNG key")
+        n = self.policy.validate_batch(obs)
+        cap = self.buckets[-1]
+        if n > cap:
+            outs = []
+            for start in range(0, n, cap):
+                chunk = {k: v[start : start + cap] for k, v in obs.items()}
+                sub = key if key is None else jax.random.fold_in(key, start)
+                outs.append(self.infer(params, chunk, key=sub, greedy=greedy))
+            return np.concatenate(outs, axis=0)
+        bucket = self.bucket_for(n)
+        with self._lock:
+            slab = self._stagers[bucket].acquire(self._templates[bucket])
+            for k, v in obs.items():
+                dst = slab[k]
+                np.copyto(dst[:n], v)
+                if n < bucket:
+                    dst[n:] = 0  # ring slabs carry stale rows; padded rows must be deterministic
+            out = self._dispatch(bucket, greedy, params, slab, self._key_aval if key is None else key)
+            # np.asarray blocks on the computation — the slab is free for
+            # reuse once we return (device_put may alias host memory on CPU)
+            actions = np.asarray(out)[:n]
+            self.dispatches += 1
+            self.rows += n
+            self.padded_rows += bucket - n
+        return actions
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.rows + self.padded_rows
+            return {
+                "dispatches": self.dispatches,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "batch_fill_ratio": round(self.rows / total, 4) if total else 0.0,
+            }
+
+
+class JitEngine:
+    """Naive per-shape ``jax.jit`` dispatch — the bench baseline.
+
+    Same ``infer`` surface as :class:`BucketEngine` but no ladder: every
+    distinct batch size traces its own program on first sight and every call
+    goes through the jit dispatch path. Kept deliberately simple; its only
+    job is to be the honest thing the AOT engine is measured against.
+    """
+
+    def __init__(self, policy: ServePolicy, mode: str = "greedy") -> None:
+        if mode not in ("greedy", "sample", "both"):
+            raise ValueError(f"engine mode must be greedy|sample|both, got {mode!r}")
+        self.policy = policy
+        self.mode = mode
+        self.buckets: Tuple[int, ...] = ()
+        self._greedy = jax.jit(policy.greedy_fn)
+        self._sample = jax.jit(policy.sample_fn)
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.rows = 0
+        self.padded_rows = 0
+
+    def infer(
+        self,
+        params: Any,
+        obs: Dict[str, np.ndarray],
+        key: Optional[Any] = None,
+        greedy: Optional[bool] = None,
+    ) -> np.ndarray:
+        if greedy is None:
+            greedy = self.mode != "sample"
+        if not greedy and key is None:
+            raise ValueError("sample-mode infer needs a PRNG key")
+        n = self.policy.validate_batch(obs)
+        out = self._greedy(params, obs) if greedy else self._sample(params, obs, key)
+        actions = np.asarray(out)
+        with self._lock:
+            self.dispatches += 1
+            self.rows += n
+        return actions
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "rows": self.rows,
+                "padded_rows": 0,
+                "batch_fill_ratio": 1.0 if self.rows else 0.0,
+            }

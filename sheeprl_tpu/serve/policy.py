@@ -1,0 +1,78 @@
+"""The algo-agnostic contract between per-algorithm policy builders and the
+serving tier.
+
+A *policy builder* (registered with
+:func:`sheeprl_tpu.utils.registry.register_policy_builder`, living next to
+each algorithm's evaluation entry point) turns a checkpoint's ``state["agent"]``
+into a :class:`ServePolicy`: pure jittable greedy/sample programs over a
+*prepared* observation dict, plus the host-side preparation and the
+params-rebuild hook the hot-swap path needs. Everything downstream — the AOT
+bucket engine, the scheduler, the weight store — is algorithm-blind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+__all__ = ["ServePolicy"]
+
+
+@dataclasses.dataclass
+class ServePolicy:
+    """Everything the serving tier needs to know about one policy.
+
+    ``greedy_fn`` / ``sample_fn`` are PURE jittable callables over
+    ``(params, obs)`` / ``(params, obs, key)`` where ``obs`` is a dict of
+    batched arrays matching ``obs_spec`` — they return env-format actions
+    shaped ``(B, action_dim)`` (continuous: concatenated heads; discrete:
+    per-head argmax indices), exactly the conversion the offline ``eval``
+    loop applies on the host, moved in-graph so a served batch is one
+    dispatch. The engine AOT-compiles them at the bucket ladder; they must be
+    batch-row-independent (no batch-coupled normalization), which every
+    policy in this repo is — that is what makes padded rows free.
+
+    ``prepare`` is the HOST-side normalizer mapping raw env observations
+    (numpy, layouts as the env emits them) to the prepared dict — the same
+    normalization the algorithm's ``utils.prepare_obs`` applies during
+    rollouts/eval, so served actions are bit-identical to ``sheeprl_tpu
+    eval`` for the same checkpoint.
+
+    ``params_from_state`` rebuilds a params pytree (matching ``params``'s
+    structure/shapes/dtypes) from a checkpoint ``state["agent"]`` — the
+    hot-swap path: the AOT programs were compiled against these avals, so a
+    rebuilt tree drops in with zero recompiles.
+    """
+
+    name: str
+    params: Any
+    #: key -> (per-row shape, dtype) of the PREPARED observation leaves
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], Any]]
+    action_dim: int
+    greedy_fn: Callable[[Any, Dict[str, Any]], Any]
+    sample_fn: Callable[[Any, Dict[str, Any], Any], Any]
+    prepare: Callable[[Dict[str, np.ndarray], int], Dict[str, np.ndarray]]
+    params_from_state: Callable[[Any], Any]
+
+    def validate_batch(self, obs: Dict[str, np.ndarray]) -> int:
+        """Check a prepared batch against ``obs_spec``; returns the (shared)
+        leading batch size. Raises ``ValueError`` on unknown/missing keys,
+        per-row shape mismatch, or inconsistent batch sizes."""
+        if set(obs) != set(self.obs_spec):
+            raise ValueError(
+                f"observation keys {sorted(obs)} do not match the policy's spec {sorted(self.obs_spec)}"
+            )
+        n = None
+        for k, (shape, _) in self.obs_spec.items():
+            v = obs[k]
+            if v.ndim != len(shape) + 1 or tuple(v.shape[1:]) != tuple(shape):
+                raise ValueError(
+                    f"observation '{k}' has per-row shape {tuple(v.shape[1:])}, expected {tuple(shape)}"
+                )
+            if n is None:
+                n = int(v.shape[0])
+            elif int(v.shape[0]) != n:
+                raise ValueError(f"inconsistent batch sizes across observation keys: {n} vs {v.shape[0]}")
+        return int(n or 0)

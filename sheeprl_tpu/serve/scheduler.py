@@ -1,0 +1,357 @@
+"""Micro-batching request scheduler: the GA3C predictor queue.
+
+Requests (each a prepared observation batch of ``n >= 1`` rows) enter a
+bounded queue; one worker thread runs the admission loop:
+
+- the first request opens a batch and arms a **max-wait deadline** — the
+  latency the operator is willing to trade for batch fill;
+- further requests are admitted until the assembled batch would exceed
+  **max_batch** rows (an oversize-for-this-batch request is held over, never
+  reordered) or the deadline fires;
+- the batch is served as ONE engine dispatch under ONE pulled weight
+  snapshot (newest-wins — see :mod:`sheeprl_tpu.serve.weights`), and every
+  caller's future resolves with its own action rows plus the weight version
+  that produced them.
+
+Past the queue bound ``submit`` blocks (backpressure — offered load above
+capacity throttles callers instead of growing an unbounded queue) and raises
+:class:`ServeOverloadedError` once its timeout expires.
+
+``Serve/*`` metrics ride :class:`~sheeprl_tpu.parallel.pipeline.PipelineStats`
+(:class:`ServeStats` extends it): queue depth, batch-fill ratio, p50/p99
+request latency over a sliding window, swap count, served totals.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.parallel.pipeline import PipelineStats
+from sheeprl_tpu.serve.policy import ServePolicy
+
+__all__ = ["ServeStats", "RequestScheduler", "ServeOverloadedError", "ServeClosedError"]
+
+
+class ServeOverloadedError(RuntimeError):
+    """The request queue stayed at its bound past the submit timeout."""
+
+
+class ServeClosedError(RuntimeError):
+    """submit() after the scheduler stopped."""
+
+
+class ServeStats(PipelineStats):
+    """``Pipeline/*`` counters plus the serving tier's ``Serve/*`` gauges."""
+
+    def __init__(self, latency_window: int = 4096) -> None:
+        super().__init__()
+        self.requests = 0
+        self.rows_served = 0
+        self.batches = 0
+        self.rejected = 0
+        self.swaps = 0
+        self.weight_version = 0
+        self._latencies = collections.deque(maxlen=int(latency_window))
+        self._depth_fn = None  # wired by the scheduler
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def observe_version(self, version: int) -> None:
+        with self._lock:
+            if version > self.weight_version:
+                self.swaps += version - self.weight_version
+                self.weight_version = version
+
+    def latency_percentiles(self) -> Tuple[float, float]:
+        """(p50, p99) in seconds over the sliding window (0.0, 0.0 empty)."""
+        with self._lock:
+            lat = list(self._latencies)
+        if not lat:
+            return 0.0, 0.0
+        arr = np.sort(np.asarray(lat))
+        return float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
+
+    def snapshot(self) -> Dict[str, float]:
+        out = super().snapshot()
+        p50, p99 = self.latency_percentiles()
+        with self._lock:
+            depth = self._depth_fn() if self._depth_fn is not None else 0
+            rows = self.rows_served
+            batches = self.batches
+            out.update(
+                {
+                    "Serve/requests": self.requests,
+                    "Serve/rows": rows,
+                    "Serve/batches": batches,
+                    "Serve/rows_per_batch": round(rows / batches, 2) if batches else 0.0,
+                    "Serve/rejected": self.rejected,
+                    "Serve/queue_depth": depth,
+                    "Serve/weight_version": self.weight_version,
+                    "Serve/swap_count": self.swaps,
+                    "Serve/p50_latency_ms": round(p50 * 1e3, 3),
+                    "Serve/p99_latency_ms": round(p99 * 1e3, 3),
+                }
+            )
+        return out
+
+
+class _Request:
+    __slots__ = ("obs", "n", "event", "actions", "version", "error", "t_submit", "t_resolve")
+
+    def __init__(self, obs: Dict[str, np.ndarray], n: int) -> None:
+        self.obs = obs
+        self.n = n
+        self.event = threading.Event()
+        self.actions: Optional[np.ndarray] = None
+        self.version = -1
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_resolve = 0.0
+
+    @property
+    def latency_s(self) -> float:
+        """Submit→resolve seconds (exact — stamped by the worker, so a slow
+        caller reading the future late doesn't inflate it)."""
+        return max(0.0, self.t_resolve - self.t_submit)
+
+    def resolve(self, actions: Optional[np.ndarray], version: int, error: Optional[BaseException] = None) -> None:
+        self.actions = actions
+        self.version = version
+        self.error = error
+        self.t_resolve = time.perf_counter()
+        self.event.set()
+
+
+class RequestScheduler:
+    """Deadline/size-admission micro-batcher feeding one engine.
+
+    ``weights`` is anything with a ``pull() -> (version, params)`` — in
+    practice :class:`~sheeprl_tpu.serve.weights.WeightStore`. ``greedy``
+    fixes the served program (mixed batches would need two dispatches; run a
+    second scheduler for that). In sample mode each BATCH gets a fresh key
+    folded from the scheduler's base key — per-row decorrelation rides the
+    in-graph per-row key split of the policy's ``sample_fn``.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        weights: Any,
+        max_wait_s: float = 0.005,
+        max_batch: Optional[int] = None,
+        queue_bound: int = 256,
+        greedy: bool = True,
+        seed: int = 0,
+        stats: Optional[ServeStats] = None,
+    ) -> None:
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        if queue_bound < 1:
+            raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        self.engine = engine
+        self.weights = weights
+        self.max_wait_s = float(max_wait_s)
+        buckets = getattr(engine, "buckets", ()) or ()
+        self.max_batch = int(max_batch) if max_batch else (max(buckets) if buckets else 128)
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        self.queue_bound = int(queue_bound)
+        self.greedy = bool(greedy)
+        self.stats = stats or ServeStats()
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=self.queue_bound)
+        self.stats._depth_fn = self._q.qsize
+        self._holdover: Optional[_Request] = None
+        self._base_key = jax.random.PRNGKey(seed)
+        self._batch_idx = 0
+        self._stop = threading.Event()
+        self._closed = threading.Event()
+        self._worker = threading.Thread(target=self._run, name="serve-scheduler", daemon=True)
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    def start(self) -> "RequestScheduler":
+        if not self._started:
+            self._started = True
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the worker. With ``drain`` (default) every request already
+        admitted is still served before the thread exits — a shutdown drops
+        nothing; without it, pending requests resolve with
+        :class:`ServeClosedError`."""
+        self._closed.set()  # no new submits
+        self._drain_on_stop = drain
+        self._stop.set()
+        if self._started:
+            self._worker.join(timeout=30.0)
+            if self._worker.is_alive():
+                # still mid-dispatch past the join budget: the worker owns
+                # the drain (its shutdown loop sweeps until the queue is
+                # empty) — serving leftovers from THIS thread would race it
+                # on the engine slabs and the sample-key counter
+                return
+        # a submit that passed the closed-check just before stop() may have
+        # enqueued after the worker's final drain sweep — settle stragglers
+        leftovers: List[_Request] = []
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        if leftovers:
+            self._settle(leftovers, drain)
+
+    # -- client side --------------------------------------------------------- #
+
+    def submit(self, obs: Dict[str, np.ndarray], timeout: Optional[float] = None) -> _Request:
+        """Enqueue a prepared batch; returns the request future. Blocks while
+        the queue sits at its bound (backpressure); ``timeout`` seconds later
+        it gives up with :class:`ServeOverloadedError`. Sample-mode keys are
+        the SCHEDULER's (one fresh fold per batch — see class docstring);
+        callers needing caller-chosen keys talk to the engine directly."""
+        if self._closed.is_set():
+            raise ServeClosedError("scheduler is stopped")
+        n = self.engine.policy.validate_batch(obs)
+        req = _Request(obs, n)
+        try:
+            if timeout is None:
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(req, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    raise ServeClosedError("scheduler stopped while waiting for queue space")
+            elif timeout <= 0:
+                self._q.put_nowait(req)
+            else:
+                self._q.put(req, timeout=timeout)
+        except queue.Full:
+            self.stats.add("rejected", 1)
+            raise ServeOverloadedError(
+                f"request queue held {self.queue_bound} pending requests for {timeout}s"
+            ) from None
+        self.stats.add("requests", 1)
+        self.stats.observe_depth(self._q.qsize())
+        return req
+
+    def result(self, req: _Request, timeout: Optional[float] = None) -> Tuple[np.ndarray, int]:
+        """Block until ``req`` resolves; returns ``(actions, weight_version)``."""
+        if not req.event.wait(timeout):
+            raise TimeoutError("request did not resolve in time")
+        if req.error is not None:
+            raise req.error
+        self.stats.observe_latency(req.latency_s)
+        return req.actions, req.version
+
+    # -- worker side --------------------------------------------------------- #
+
+    def _next_request(self, timeout: float) -> Optional[_Request]:
+        if self._holdover is not None:
+            req, self._holdover = self._holdover, None
+            return req
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _collect(self) -> List[_Request]:
+        """One admission round: first request arms the deadline, admission
+        closes at ``max_batch`` rows or the deadline, whichever first."""
+        first = self._next_request(timeout=0.05)
+        if first is None:
+            return []
+        batch = [first]
+        rows = first.n
+        deadline = time.perf_counter() + self.max_wait_s
+        while rows < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            nxt = self._next_request(timeout=remaining)
+            if nxt is None:
+                break
+            if rows + nxt.n > self.max_batch:
+                self._holdover = nxt  # serve it at the head of the next batch
+                break
+            batch.append(nxt)
+            rows += nxt.n
+        return batch
+
+    def _serve_batch(self, batch: List[_Request]) -> None:
+        rows = sum(r.n for r in batch)
+        obs = (
+            batch[0].obs
+            if len(batch) == 1
+            else {k: np.concatenate([r.obs[k] for r in batch], axis=0) for k in batch[0].obs}
+        )
+        version, params = self.weights.pull()
+        key = None
+        if not self.greedy:
+            key = jax.random.fold_in(self._base_key, self._batch_idx)
+            self._batch_idx += 1
+        try:
+            actions = self.engine.infer(params, obs, key=key, greedy=self.greedy)
+        except BaseException as e:  # resolve callers, keep serving
+            for r in batch:
+                r.resolve(None, version, error=e)
+            return
+        self.stats.observe_version(version)
+        self.stats.add("batches", 1)
+        self.stats.add("rows_served", rows)
+        start = 0
+        for r in batch:
+            r.resolve(actions[start : start + r.n], version)
+            start += r.n
+
+    def _settle(self, pending: List[_Request], drain: bool) -> None:
+        """Shutdown settlement: serve ``pending`` in admission-preserving
+        chunks of at most ``max_batch`` rows, or fail them all closed."""
+        if drain:
+            batch: List[_Request] = []
+            rows = 0
+            for r in pending:
+                if batch and rows + r.n > self.max_batch:
+                    self._serve_batch(batch)
+                    batch, rows = [], 0
+                batch.append(r)
+                rows += r.n
+            if batch:
+                self._serve_batch(batch)
+        else:
+            err = ServeClosedError("scheduler stopped before this request was served")
+            for r in pending:
+                r.resolve(None, -1, error=err)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._collect()
+            if batch:
+                self._serve_batch(batch)
+        # shutdown: drain everything already admitted
+        drain = getattr(self, "_drain_on_stop", True)
+        while True:
+            pending: List[_Request] = []
+            if self._holdover is not None:
+                pending.append(self._holdover)
+                self._holdover = None
+            while True:
+                try:
+                    pending.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            if not pending:
+                break
+            self._settle(pending, drain)

@@ -1,0 +1,255 @@
+"""Server assembly: in-process client, socket front end, CLI entry glue.
+
+:class:`PolicyServer` wires one checkpoint's :class:`ServePolicy` into the
+full tier — AOT bucket engine, micro-batching scheduler, versioned weight
+store, optional checkpoint-dir watcher, optional JSON-lines TCP front end —
+and owns their lifecycles. :class:`PolicyClient` is the in-process caller
+(the same interface a Sebulba actor thread would use as its batched-inference
+backend: GA3C's predictor queue); the socket front end is a thin adapter
+mapping one newline-delimited JSON request to one client call.
+
+Wire protocol (one JSON object per line, both directions)::
+
+    -> {"obs": {"state": [[...]]}, "n": 1}
+    <- {"actions": [[...]], "version": 3}
+    <- {"error": "..."}                       # per-request failure
+
+``obs`` leaves are RAW env observations (the server applies the algorithm's
+own normalization via ``ServePolicy.prepare``); ``n`` (default 1) is the
+number of batched rows in the request.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.serve.engine import BucketEngine, JitEngine, default_buckets
+from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeStats
+from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
+
+__all__ = ["PolicyClient", "PolicyServer", "serve_policy"]
+
+
+class PolicyClient:
+    """In-process client: raw env obs in, env-format actions out.
+
+    ``act`` prepares the observation (the algorithm's own host-side
+    normalization), submits it to the scheduler and blocks for the result —
+    concurrent callers are micro-batched into shared engine dispatches.
+    """
+
+    def __init__(self, policy: ServePolicy, scheduler: RequestScheduler) -> None:
+        self.policy = policy
+        self.scheduler = scheduler
+
+    def act(
+        self,
+        obs: Dict[str, np.ndarray],
+        n: int = 1,
+        timeout: Optional[float] = None,
+        submit_timeout: Optional[float] = None,
+    ) -> Tuple[np.ndarray, int]:
+        """Actions (``(n, action_dim)``) + the weight version that produced
+        them. ``timeout`` bounds the wait for the result; ``submit_timeout``
+        bounds the backpressure wait for queue space."""
+        prepared = self.policy.prepare(obs, n)
+        req = self.scheduler.submit(prepared, timeout=submit_timeout)
+        return self.scheduler.result(req, timeout=timeout)
+
+
+class _JsonLineHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:  # one connection, many newline-framed requests
+        server: "_TcpFrontEnd" = self.server  # type: ignore[assignment]
+        for raw in self.rfile:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+                obs = {k: np.asarray(v) for k, v in msg["obs"].items()}
+                n = int(msg.get("n", 1))
+                # submit_timeout: under sustained overload the request must
+                # error out (backpressure made visible), not pin this
+                # connection's thread forever — serve_config.yaml promises it
+                actions, version = server.client.act(
+                    obs, n=n, timeout=server.request_timeout_s, submit_timeout=server.request_timeout_s
+                )
+                resp = {"actions": np.asarray(actions).tolist(), "version": int(version)}
+            except Exception as e:  # per-request: report, keep the connection
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):  # client went away
+                return
+
+
+class _TcpFrontEnd(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, client: PolicyClient, request_timeout_s: float = 30.0) -> None:
+        super().__init__(addr, _JsonLineHandler)
+        self.client = client
+        self.request_timeout_s = request_timeout_s
+
+
+class PolicyServer:
+    """One checkpoint, fully assembled and lifecycle-managed.
+
+    ``serve_cfg`` mirrors the ``serve:`` block of ``serve_config.yaml``
+    (buckets, mode, max_wait_ms, max_batch, queue_bound, host/port, watch
+    options); any mapping with those keys works. ``engine="naive"`` swaps in
+    the per-request jit-dispatch :class:`JitEngine` — the bench baseline.
+    """
+
+    def __init__(
+        self,
+        policy: ServePolicy,
+        serve_cfg: Optional[Dict[str, Any]] = None,
+        watch_dir: "str | None" = None,
+        engine: str = "aot",
+        stats: Optional[ServeStats] = None,
+    ) -> None:
+        cfg = dict(serve_cfg or {})
+        self.policy = policy
+        self.stats = stats or ServeStats()
+        mode = str(cfg.get("mode", "greedy"))
+        if mode not in ("greedy", "sample"):
+            raise ValueError(f"serve.mode must be greedy|sample, got {mode!r}")
+        buckets = cfg.get("buckets") or default_buckets()
+        if engine == "aot":
+            self.engine: Any = BucketEngine(policy, buckets=buckets, mode=mode)
+        elif engine == "naive":
+            self.engine = JitEngine(policy, mode=mode)
+        else:
+            raise ValueError(f"engine must be 'aot' or 'naive', got {engine!r}")
+        self.weights = WeightStore(policy.params, policy.params_from_state, stats=self.stats)
+        max_wait_ms = cfg.get("max_wait_ms", 5.0)
+        self.scheduler = RequestScheduler(
+            self.engine,
+            self.weights,
+            max_wait_s=float(max_wait_ms) / 1e3,
+            max_batch=cfg.get("max_batch"),
+            queue_bound=int(cfg.get("queue_bound", 256)),
+            greedy=mode == "greedy",
+            seed=int(cfg.get("seed", 0) or 0),
+            stats=self.stats,
+        )
+        self.client = PolicyClient(policy, self.scheduler)
+        self.watcher: Optional[CheckpointWatcher] = None
+        if watch_dir is not None:
+            self.watcher = CheckpointWatcher(watch_dir, self.weights, poll_s=float(cfg.get("watch_poll_s", 2.0)))
+        self._tcp: Optional[_TcpFrontEnd] = None
+        self._tcp_thread: Optional[threading.Thread] = None
+        self._host = str(cfg.get("host", "127.0.0.1"))
+        self._port = cfg.get("port", None)
+
+    # -- lifecycle ----------------------------------------------------------- #
+
+    @property
+    def address(self) -> Optional[Tuple[str, int]]:
+        """Bound (host, port) of the socket front end, if one is up."""
+        return self._tcp.server_address[:2] if self._tcp is not None else None
+
+    def start(self, with_socket: Optional[bool] = None) -> "PolicyServer":
+        self.scheduler.start()
+        if self.watcher is not None:
+            self.watcher.start()
+        want_socket = (self._port is not None) if with_socket is None else with_socket
+        if want_socket:
+            port = int(self._port or 0)
+            self._tcp = _TcpFrontEnd((self._host, port), self.client)
+            self._tcp_thread = threading.Thread(target=self._tcp.serve_forever, name="serve-tcp", daemon=True)
+            self._tcp_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._tcp is not None:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+            self._tcp = None
+        if self.watcher is not None:
+            self.watcher.stop()
+        self.scheduler.stop(drain=True)
+
+    def __enter__(self) -> "PolicyServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def request_over_socket(addr: Tuple[str, int], obs: Dict[str, Any], n: int = 1, timeout: float = 30.0) -> Dict[str, Any]:
+    """One request/response round trip over the JSON-lines protocol (test &
+    example helper — real clients keep one connection open for many
+    requests)."""
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        payload = {"obs": {k: np.asarray(v).tolist() for k, v in obs.items()}, "n": n}
+        sock.sendall((json.dumps(payload) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) -> None:
+    """CLI entrypoint body: build the policy from the checkpoint and serve.
+
+    Runs until ``serve.max_requests`` requests have been answered (None →
+    forever) or KeyboardInterrupt; prints a ``Serve/*`` stats snapshot every
+    ``serve.log_every_s`` seconds and once on shutdown.
+    """
+    import gymnasium as gym
+
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.utils.logger import get_log_dir
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name) if cfg.get("root_dir") and cfg.get("run_name") else None
+    env = make_env(cfg, cfg.seed, 0, log_dir, "serve", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    env.close()
+
+    policy = builder(fabric, cfg, observation_space, action_space, state["agent"])
+    serve_cfg = dict(cfg.get("serve", {}))
+    watch_dir = None
+    if serve_cfg.get("watch"):
+        from pathlib import Path
+
+        watch_dir = str(Path(cfg.checkpoint_path).parent)
+    server = PolicyServer(policy, serve_cfg, watch_dir=watch_dir)
+    max_requests = serve_cfg.get("max_requests")
+    log_every_s = float(serve_cfg.get("log_every_s", 10.0) or 10.0)
+    server.start()
+    addr = server.address
+    if addr is not None:
+        print(f"serving {cfg.algo.name} on {addr[0]}:{addr[1]} (buckets={list(server.engine.buckets) or 'jit'})")
+    try:
+        last_log = time.perf_counter()
+        while True:
+            time.sleep(0.2)
+            now = time.perf_counter()
+            if now - last_log >= log_every_s:
+                print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}))
+                last_log = now
+            if max_requests is not None and server.stats.requests >= int(max_requests):
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}))

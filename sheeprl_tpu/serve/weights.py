@@ -1,0 +1,157 @@
+"""Versioned hot-swappable serving weights.
+
+:class:`WeightStore` is the serving face of
+:class:`~sheeprl_tpu.parallel.pipeline.ParamServer`: the same newest-wins
+versioned pub-sub (and per-device snapshot cache) the Sebulba learners
+publish through — so a live training run can hand its ``ParamServer``
+straight to the serving tier and the server tracks training with zero extra
+machinery. Swap semantics are torn-request-free by construction: the
+scheduler pulls ONE ``(version, params)`` snapshot per micro-batch, every
+row in the batch is served under it, and the AOT programs were lowered
+against the params avals, so a swapped tree (same structure/shapes/dtypes,
+see ``ServePolicy.params_from_state``) runs with zero recompiles. Nothing is
+ever dropped: a swap is a reference publish, never an interruption.
+
+:class:`CheckpointWatcher` feeds a store from a checkpoint directory: it
+polls the :mod:`sheeprl_tpu.fault.manager` manifests
+(:func:`~sheeprl_tpu.fault.manager.latest_complete` — only *complete*,
+digest-verified saves are ever considered, so a torn mid-write checkpoint
+can't be published) and publishes each new step's ``state["agent"]``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Callable, Optional, Tuple
+
+from sheeprl_tpu.parallel.pipeline import ParamServer, PipelineStats
+
+__all__ = ["WeightStore", "CheckpointWatcher"]
+
+
+class WeightStore:
+    """Newest-wins versioned weights for the scheduler.
+
+    ``params_from_state`` (usually ``ServePolicy.params_from_state``)
+    converts a checkpoint ``state["agent"]`` into a servable params tree;
+    :meth:`publish_state` applies it, :meth:`publish_params` takes an
+    already-built tree (e.g. straight from a learner). ``device`` pins pull
+    placement (and engages ``ParamServer``'s per-device cache — one transfer
+    per version no matter how many pullers).
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        params_from_state: Optional[Callable[[Any], Any]] = None,
+        device: Any = None,
+        stats: Optional[PipelineStats] = None,
+    ) -> None:
+        self._server = ParamServer(params, publish_every=1, stats=stats or PipelineStats())
+        self._params_from_state = params_from_state
+        self._device = device
+        # version 0 is the construction-time params; real publishes are >= 1
+
+    @property
+    def version(self) -> int:
+        return self._server.version
+
+    def pull(self) -> Tuple[int, Any]:
+        return self._server.pull(self._device)
+
+    def publish_params(self, params: Any) -> int:
+        return self._server.publish(params)
+
+    def publish_state(self, agent_state: Any) -> int:
+        if self._params_from_state is None:
+            raise RuntimeError("this WeightStore was built without a params_from_state converter")
+        return self.publish_params(self._params_from_state(agent_state))
+
+
+class CheckpointWatcher:
+    """Background thread publishing new complete checkpoints into a store.
+
+    Watches ``ckpt_dir`` (a run's ``checkpoint/`` directory) through the
+    fault-runtime manifests; a new complete entry with a strictly newer step
+    is loaded and its ``state["agent"]`` published. Load errors are warned
+    and skipped — the server keeps serving the previous version (manifest
+    completeness makes these rare: half-written saves are invisible).
+    """
+
+    def __init__(self, ckpt_dir: "str | Path", store: WeightStore, poll_s: float = 2.0) -> None:
+        self.ckpt_dir = Path(ckpt_dir)
+        self.store = store
+        self.poll_s = float(poll_s)
+        self._last: Optional[Path] = None
+        self._last_step = -1
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="serve-ckpt-watcher", daemon=True)
+        self.published = 0
+
+    def start(self, publish_current: bool = False) -> "CheckpointWatcher":
+        """Begin watching. With ``publish_current`` the newest existing
+        checkpoint is published immediately; by default only checkpoints
+        appearing AFTER the watcher starts swap in (the server was built from
+        an explicit checkpoint already)."""
+        if not publish_current:
+            self._prime()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+    def poll_once(self) -> bool:
+        """One manifest sweep; returns True iff a new checkpoint published
+        (exposed for tests and for pollers that bring their own cadence)."""
+        from sheeprl_tpu.fault.manager import latest_complete
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        newest = latest_complete(self.ckpt_dir)
+        if newest is None or newest == self._last:
+            return False
+        step = _step_of(newest)
+        if step <= self._last_step:
+            return False
+        try:
+            state = load_state(newest)
+            agent_state = state["agent"]
+        except Exception as e:
+            warnings.warn(f"serve checkpoint watcher could not load {newest}: {e}")
+            return False
+        self.store.publish_state(agent_state)
+        self._last, self._last_step = newest, step
+        self.published += 1
+        return True
+
+    def _prime(self) -> None:
+        from sheeprl_tpu.fault.manager import latest_complete
+
+        newest = latest_complete(self.ckpt_dir)
+        if newest is not None:
+            self._last, self._last_step = newest, _step_of(newest)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # never kill serving over a watcher hiccup
+                warnings.warn(f"serve checkpoint watcher error: {e}")
+            self._stop.wait(self.poll_s)
+
+
+def _step_of(path: Path) -> int:
+    from sheeprl_tpu.fault.manager import _parse_step
+
+    step = _parse_step(path.name)
+    if step is None:
+        # fall back to mtime ordering for foreign naming schemes
+        try:
+            return int(path.stat().st_mtime)
+        except OSError:
+            return 0
+    return step

@@ -14,6 +14,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
 evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+#: algo name -> ServePolicy builders (the serving tier's analogue of the
+#: evaluation registry; populated by the same ``evaluate`` modules)
+policy_builder_registry: Dict[str, List[Dict[str, Any]]] = {}
 
 _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
@@ -69,19 +72,36 @@ def register_algorithm(decoupled: bool = False) -> Callable:
     return decorator
 
 
-def register_evaluation(algorithms: str | List[str]) -> Callable:
+def _register_into(registry: Dict[str, List[Dict[str, Any]]], algorithms: str | List[str]) -> Callable:
+    """Shared per-algo registration decorator body: one dedup/setdefault rule
+    for every name-keyed registry."""
     if isinstance(algorithms, str):
         algorithms = [algorithms]
 
     def decorator(fn: Callable) -> Callable:
         for algo in algorithms:
-            entries = evaluation_registry.setdefault(algo, [])
+            entries = registry.setdefault(algo, [])
             entry = {"name": algo, "module": fn.__module__, "entrypoint": fn.__name__}
             if not any(e["module"] == fn.__module__ and e["entrypoint"] == fn.__name__ for e in entries):
                 entries.append(entry)
         return fn
 
     return decorator
+
+
+def register_evaluation(algorithms: str | List[str]) -> Callable:
+    return _register_into(evaluation_registry, algorithms)
+
+
+def register_policy_builder(algorithms: str | List[str]) -> Callable:
+    """Register ``fn`` as the serving-tier policy builder for ``algorithms``.
+
+    A builder has the signature ``(fabric, cfg, observation_space,
+    action_space, agent_state) -> sheeprl_tpu.serve.policy.ServePolicy``;
+    the ``serve`` CLI resolves it exactly like ``eval`` resolves its
+    evaluation entry point (same modules, same population trigger).
+    """
+    return _register_into(policy_builder_registry, algorithms)
 
 
 def _ensure_populated() -> None:
@@ -123,10 +143,18 @@ def resolve_algorithm(name: str) -> Optional[Dict[str, Any]]:
     return entries[0] if entries else None
 
 
-def resolve_evaluation(algo_name: str) -> Optional[Dict[str, Any]]:
+def _resolve_from(registry: Dict[str, List[Dict[str, Any]]], algo_name: str) -> Optional[Dict[str, Any]]:
     _ensure_populated()
-    entries = evaluation_registry.get(algo_name)
+    entries = registry.get(algo_name)
     return entries[0] if entries else None
+
+
+def resolve_evaluation(algo_name: str) -> Optional[Dict[str, Any]]:
+    return _resolve_from(evaluation_registry, algo_name)
+
+
+def resolve_policy_builder(algo_name: str) -> Optional[Dict[str, Any]]:
+    return _resolve_from(policy_builder_registry, algo_name)
 
 
 def get_entrypoint(entry: Dict[str, Any]) -> Callable:

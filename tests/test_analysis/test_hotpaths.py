@@ -107,6 +107,49 @@ def test_sac_sebulba_dry_run_clean(tmp_path, trace_hygiene):
     )
 
 
+def test_serve_engine_hotpaths_clean(trace_hygiene):
+    """The serving tier's hot paths: AOT bucket programs are compiled at
+    construction, so arbitrary request shapes hammered through ``infer`` must
+    produce 0 post-warmup retraces — `serve.infer` sees exactly one abstract
+    signature per (bucket, mode) and every `serve.bucket[N]` executable is a
+    fixed-shape program by construction (strict mode + the per-entry
+    host-slab transfer opt-out)."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo.evaluate import serve_policy_ppo
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.serve.engine import BucketEngine
+
+    cfg = compose(
+        [
+            "exp=ppo",
+            "env=gym",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(42)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    policy = serve_policy_ppo(fabric, cfg, obs_space, gym.spaces.Discrete(2), None)
+
+    engine = BucketEngine(policy, buckets=(1, 4, 16), mode="greedy")
+    rng = np.random.default_rng(0)
+    for n in (1, 2, 3, 4, 5, 7, 15, 16, 17, 33):  # every boundary + chunking
+        obs = {"state": rng.standard_normal((n, 4)).astype(np.float32)}
+        engine.infer(policy.params, obs)
+    _assert_quiet(
+        trace_hygiene,
+        ["serve.infer", "serve.bucket[1].greedy", "serve.bucket[4].greedy", "serve.bucket[16].greedy"],
+    )
+    report = trace_hygiene.report()
+    # one abstract signature per bucket on the shared entry, none added since
+    assert report["serve.infer"]["compiles"] == 3
+
+
 def test_planted_host_sync_is_caught(tmp_path, trace_hygiene, monkeypatch):
     """Regression-proof the guard itself: break the explicit staging (the
     exact hazard class the suite polices) and the steady-state transfer guard

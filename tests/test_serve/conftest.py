@@ -1,0 +1,88 @@
+"""Shared serve-tier fixtures: a pure-function toy policy (scheduler/weights
+semantics without the algo stack) and real PPO/SAC policies built through the
+registered builders over synthetic spaces."""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.parallel import Fabric
+from sheeprl_tpu.serve.policy import ServePolicy
+
+
+@pytest.fixture()
+def toy_policy():
+    """Linear map policy: tiny, deterministic, swap-observable (actions scale
+    with the params), no flax/env dependency."""
+    w = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    params = {"w": w}
+
+    def greedy_fn(p, obs):
+        return obs["x"] @ p["w"]
+
+    def sample_fn(p, obs, key):
+        noise = jax.random.normal(key, (obs["x"].shape[0], 3), dtype=jnp.float32)
+        return obs["x"] @ p["w"] + 1e-3 * noise
+
+    return ServePolicy(
+        name="toy",
+        params=params,
+        obs_spec={"x": ((2,), np.float32)},
+        action_dim=3,
+        greedy_fn=greedy_fn,
+        sample_fn=sample_fn,
+        prepare=lambda obs, n: {"x": np.asarray(obs["x"], dtype=np.float32).reshape(n, 2)},
+        params_from_state=lambda state: jax.tree.map(jnp.asarray, state),
+    )
+
+
+def _fabric():
+    f = Fabric(devices=1, accelerator="cpu")
+    f.seed_everything(42)
+    return f
+
+
+@pytest.fixture(scope="module")
+def ppo_policy():
+    """Real PPO policy (discrete CartPole spaces) through the registered
+    builder, random init params."""
+    from sheeprl_tpu.algos.ppo.evaluate import serve_policy_ppo
+
+    cfg = compose(
+        [
+            "exp=ppo",
+            "env=gym",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    act_space = gym.spaces.Discrete(2)
+    return serve_policy_ppo(_fabric(), cfg, obs_space, act_space, None)
+
+
+@pytest.fixture(scope="module")
+def sac_policy():
+    """Real SAC policy (continuous Pendulum spaces) through the registered
+    builder, random init params."""
+    from sheeprl_tpu.algos.sac.evaluate import serve_policy_sac
+
+    cfg = compose(
+        [
+            "exp=sac",
+            "env=gym",
+            "env.id=Pendulum-v1",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32)})
+    act_space = gym.spaces.Box(-2.0, 2.0, (1,), np.float32)
+    return serve_policy_sac(_fabric(), cfg, obs_space, act_space, None)

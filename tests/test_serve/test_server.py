@@ -1,0 +1,211 @@
+"""Server assembly + CLI: run-config discovery (typed errors), the in-process
+client, the JSON-lines socket front end, and the `serve` verb end-to-end —
+served greedy actions bit-identical to the eval player path for the same
+checkpoint."""
+
+import glob
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import find_run_config, run, serve
+from sheeprl_tpu.serve.server import PolicyServer, request_over_socket
+from sheeprl_tpu.utils.checkpoint import CheckpointError
+
+
+# -- find_run_config: the resolver eval/serve/registration share ------------- #
+
+
+def test_find_run_config_canonical(tmp_path):
+    run_dir = tmp_path / "run"
+    (run_dir / "checkpoint").mkdir(parents=True)
+    (run_dir / "config.yaml").write_text("seed: 1\n")
+    ckpt = run_dir / "checkpoint" / "ckpt_10_0.ckpt"
+    ckpt.mkdir()
+    assert find_run_config(ckpt) == run_dir / "config.yaml"
+
+
+def test_find_run_config_manifest_anchor(tmp_path):
+    """A checkpoint nested deeper than the canonical layout still resolves:
+    the fault-runtime manifest marks its directory as the run's checkpoint/
+    dir, whose parent holds the config."""
+    from sheeprl_tpu.fault.manager import MANIFEST_NAME
+
+    run_dir = tmp_path / "run"
+    deep = run_dir / "checkpoint" / "extra"
+    deep.mkdir(parents=True)
+    (run_dir / "checkpoint" / MANIFEST_NAME).write_text("{}")
+    (run_dir / "config.yaml").write_text("seed: 1\n")
+    ckpt = deep / "ckpt_10_0.ckpt"
+    ckpt.mkdir()
+    assert find_run_config(ckpt) == run_dir / "config.yaml"
+
+
+def test_find_run_config_upward_walk(tmp_path):
+    """A checkpoint copied out of its run dir resolves against the nearest
+    ancestor config.yaml."""
+    copied = tmp_path / "copied"
+    copied.mkdir()
+    (copied / "config.yaml").write_text("seed: 1\n")
+    ckpt = copied / "ckpt_10_0.ckpt"
+    ckpt.mkdir()
+    assert find_run_config(ckpt) == copied / "config.yaml"
+
+
+def test_find_run_config_typed_error_names_paths(tmp_path):
+    ckpt = tmp_path / "orphan" / "ckpt_10_0.ckpt"
+    ckpt.mkdir(parents=True)
+    with pytest.raises(CheckpointError) as exc:
+        find_run_config(ckpt)
+    msg = str(exc.value)
+    assert "ckpt_10_0.ckpt" in msg
+    assert "config.yaml" in msg  # the searched candidates are enumerated
+
+
+# -- PolicyServer assembly --------------------------------------------------- #
+
+
+def test_policy_server_client_roundtrip(toy_policy):
+    """In-process client over the assembled tier: raw obs in, actions +
+    version out, stats populated."""
+    with PolicyServer(toy_policy, {"buckets": [1, 4], "max_wait_ms": 1.0, "port": None}) as server:
+        obs = {"x": np.ones(2, np.float32)}
+        actions, version = server.client.act(obs, n=1, timeout=10.0)
+        assert actions.shape == (1, 3)
+        assert version == 0
+        expected = np.ones((1, 2), np.float32) @ np.asarray(toy_policy.params["w"])
+        assert np.allclose(actions, expected)
+    snap = server.stats.snapshot()
+    assert snap["Serve/requests"] == 1 and snap["Serve/rows"] == 1
+
+
+def test_socket_front_end(toy_policy):
+    """JSON-lines protocol: single-row, multi-row, and a malformed request
+    that must produce a per-request error without killing the connection."""
+    with PolicyServer(toy_policy, {"buckets": [1, 4], "max_wait_ms": 1.0, "port": 0}) as server:
+        addr = server.address
+        assert addr is not None
+        resp = request_over_socket(addr, {"x": [1.0, 1.0]}, n=1)
+        assert resp["version"] == 0
+        assert np.allclose(resp["actions"], [[3.0, 5.0, 7.0]])  # ones @ arange(6).reshape(2,3)
+        resp = request_over_socket(addr, {"x": [[1.0, 0.0], [0.0, 1.0]]}, n=2)
+        assert np.asarray(resp["actions"]).shape == (2, 3)
+        # bad key -> per-request error, then the same connection still works
+        with socket.create_connection(addr, timeout=10.0) as sock:
+            f = sock.makefile("rw")
+            f.write(json.dumps({"obs": {"wrong": [1.0]}, "n": 1}) + "\n")
+            f.flush()
+            assert "error" in json.loads(f.readline())
+            f.write(json.dumps({"obs": {"x": [1.0, 1.0]}, "n": 1}) + "\n")
+            f.flush()
+            assert "actions" in json.loads(f.readline())
+
+
+# -- the serve verb end-to-end ---------------------------------------------- #
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_serve_cli_end_to_end_bit_identical_to_eval(tmp_path):
+    """The acceptance bar, through the real CLI: train a tiny PPO run, serve
+    its checkpoint over the socket front end, and every served greedy action
+    is BIT-identical to what the eval player path (``player.get_actions``
+    + the eval loop's host-side argmax conversion) computes from the same
+    checkpoint for the same observation."""
+    import jax
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.utils import prepare_obs
+    from sheeprl_tpu.config import dotdict, load_yaml
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    run(PPO_TINY + [f"log_root={tmp_path}/train", "dry_run=True", "checkpoint.save_last=True"])
+    ckpts = sorted(glob.glob(f"{tmp_path}/train/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+    assert ckpts, "the training run saved no checkpoint"
+    ckpt = ckpts[-1]
+
+    # eval-path reference actions from the SAME checkpoint
+    cfg = dotdict(load_yaml(find_run_config(ckpt)))
+    state = load_state(ckpt)
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(cfg.seed)
+    env = make_env(cfg, cfg.seed, 0, None, "serve_test", vector_env_idx=0)()
+    obs_space, act_space = env.observation_space, env.action_space
+    env.close()
+    _, params, player = build_agent(fabric, (act_space.n,), False, cfg, obs_space, state["agent"])
+    rng = np.random.default_rng(0)
+    raw_rows = [rng.uniform(-1, 1, size=obs_space["state"].shape).astype(np.float32) for _ in range(4)]
+    expected = []
+    key = jax.random.PRNGKey(0)  # greedy ignores it — same contract as eval
+    for row in raw_rows:
+        jobs = prepare_obs(fabric, {"state": row}, num_envs=1)
+        acts = player.get_actions(params, jobs, key, greedy=True)
+        expected.append(np.concatenate([np.asarray(a).argmax(axis=-1) for a in acts], axis=-1))
+
+    # the serve verb: resolver + registry + AOT engine + socket front end
+    port = _free_port()
+    t = threading.Thread(
+        target=serve,
+        args=(
+            [
+                f"checkpoint_path={ckpt}",
+                "fabric.accelerator=cpu",
+                f"serve.port={port}",
+                "serve.buckets=[1,2]",
+                "serve.max_wait_ms=1.0",
+                f"serve.max_requests={len(raw_rows)}",
+                "serve.log_every_s=60",
+            ],
+        ),
+        daemon=True,
+    )
+    t.start()
+    addr = ("127.0.0.1", port)
+    deadline = time.perf_counter() + 120.0
+    responses = []
+    for i, row in enumerate(raw_rows):
+        while True:  # first request retries until the server is up
+            try:
+                resp = request_over_socket(addr, {"state": row.tolist()}, n=1)
+                break
+            except (ConnectionRefusedError, OSError):
+                if i > 0 or time.perf_counter() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert "actions" in resp, resp
+        responses.append(resp)
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "serve loop did not exit at max_requests"
+
+    for resp, want in zip(responses, expected):
+        got = np.asarray(resp["actions"])
+        assert got.shape == (1, 1)
+        assert np.array_equal(got[0], want), f"served action {got[0]} != eval action {want}"
+        assert resp["version"] == 0

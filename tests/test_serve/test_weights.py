@@ -1,0 +1,131 @@
+"""Hot-swap semantics: versioned store, manifest-driven checkpoint watcher,
+and the load-bearing claim — swaps under live traffic drop and tear nothing."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.fault.manager import CheckpointManager
+from sheeprl_tpu.serve.engine import BucketEngine
+from sheeprl_tpu.serve.scheduler import RequestScheduler
+from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
+
+
+def test_weight_store_versions_monotone(toy_policy):
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    assert store.version == 0
+    v0, p0 = store.pull()
+    assert v0 == 0 and p0 is toy_policy.params
+    v1 = store.publish_params(jax.tree.map(lambda x: x + 1, toy_policy.params))
+    v2 = store.publish_state({"w": np.ones((2, 3), np.float32)})
+    assert (v1, v2) == (1, 2)
+    v, params = store.pull()
+    assert v == 2
+    assert np.allclose(np.asarray(params["w"]), 1.0)
+
+
+def test_weight_store_without_converter(toy_policy):
+    store = WeightStore(toy_policy.params)
+    with pytest.raises(RuntimeError):
+        store.publish_state({"w": np.ones((2, 3), np.float32)})
+
+
+def _save(manager, ckpt_dir, step, scale):
+    state = {"agent": {"w": np.full((2, 3), float(scale), np.float32)}}
+    manager.save(ckpt_dir / f"ckpt_{step}_0.ckpt", state, step=step)
+
+
+def test_checkpoint_watcher_publishes_new_saves(tmp_path, toy_policy):
+    """Manifest-published saves flow into the store in step order; the save
+    that existed BEFORE the watcher started is not re-published (the server
+    was built from it)."""
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    manager = CheckpointManager()
+    _save(manager, ckpt_dir, 10, scale=1.0)
+
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    watcher = CheckpointWatcher(ckpt_dir, store, poll_s=30.0)
+    watcher._prime()  # what start() does; poll manually for determinism
+    assert watcher.poll_once() is False  # nothing new
+    assert store.version == 0
+
+    _save(manager, ckpt_dir, 20, scale=2.0)
+    assert watcher.poll_once() is True
+    assert store.version == 1
+    _, params = store.pull()
+    assert np.allclose(np.asarray(params["w"]), 2.0)
+    # same checkpoint again: no re-publish
+    assert watcher.poll_once() is False
+    assert watcher.published == 1
+
+
+def test_checkpoint_watcher_thread_end_to_end(tmp_path, toy_policy):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    manager = CheckpointManager()
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    watcher = CheckpointWatcher(ckpt_dir, store, poll_s=0.05).start()
+    try:
+        _save(manager, ckpt_dir, 5, scale=3.0)
+        deadline = time.perf_counter() + 10.0
+        while store.version < 1 and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        assert store.version == 1
+    finally:
+        watcher.stop()
+
+
+def test_hot_swap_under_load(toy_policy):
+    """Traffic hammers the scheduler from several threads while weights swap
+    repeatedly: every request resolves (zero dropped), versions are monotone
+    in serve order (zero torn — each batch under exactly one snapshot), and
+    post-final-swap actions reflect the final weights."""
+    engine = BucketEngine(toy_policy, buckets=(1, 4, 16), mode="greedy")
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    sched = RequestScheduler(engine, store, max_wait_s=0.001, queue_bound=256).start()
+
+    n_threads, n_requests = 4, 60
+    results = [[] for _ in range(n_threads)]
+    errors = []
+
+    def client(idx):
+        rng = np.random.default_rng(idx)
+        for _ in range(n_requests):
+            obs = {"x": rng.standard_normal((1, 2)).astype(np.float32)}
+            try:
+                req = sched.submit(obs, timeout=10.0)
+                actions, version = sched.result(req, timeout=10.0)
+                results[idx].append((req.t_resolve, version, obs, actions))
+            except Exception as e:  # noqa: BLE001 - the test asserts emptiness
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    n_swaps = 5
+    for s in range(1, n_swaps + 1):
+        time.sleep(0.02)
+        store.publish_state({"w": np.full((2, 3), float(s), np.float32)})
+    for t in threads:
+        t.join(timeout=30.0)
+    # deterministic final probe: served strictly after the last publish
+    probe_obs = {"x": np.ones((1, 2), np.float32)}
+    probe = sched.submit(probe_obs, timeout=10.0)
+    _, probe_version = sched.result(probe, timeout=10.0)
+    sched.stop()
+
+    assert not errors, errors
+    assert probe_version == n_swaps
+    flat = sorted((item for r in results for item in r), key=lambda it: it[0])
+    assert len(flat) == n_threads * n_requests  # zero dropped
+    versions = [v for _, v, _, _ in flat]
+    assert all(a <= b for a, b in zip(versions, versions[1:])), "versions regressed mid-stream"
+    assert sched.stats.snapshot()["Serve/swap_count"] == n_swaps
+    # zero torn: each response matches a SINGLE version's weights exactly
+    for _, version, obs, actions in flat:
+        w = np.asarray(toy_policy.params["w"]) if version == 0 else np.full((2, 3), float(version), np.float32)
+        assert np.allclose(actions, obs["x"] @ w, rtol=1e-5), f"actions torn across versions at v{version}"
