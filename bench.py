@@ -26,7 +26,13 @@ matrix read the registry, nothing is hand-enumerated:
 - ``serve`` — the continuous-batching inference tier: p50/p99 latency +
   throughput at fixed offered loads, AOT bucketed engine
   (``BENCH_SERVE_MODE=aot``) vs naive per-request jit dispatch (``naive``),
-  one hot weight swap per load (howto/serving.md; benchmarks/serve_bench.py).
+  one hot weight swap per load (howto/serving.md; benchmarks/serve_bench.py);
+- ``population`` — P-member population training on the Anakin path:
+  ``BENCH_POP_MODE=vmapped`` trains all P members in ONE jitted dispatch
+  (``exp=ppo_anakin_population_benchmarks``) vs ``sequential`` = P
+  back-to-back ``ppo_anakin_benchmarks`` runs at the matched recipe;
+  reports aggregate env-steps/s and the fused-block compile count
+  (howto/population_training.md).
 """
 
 from __future__ import annotations
@@ -222,6 +228,57 @@ def _lane_sac_sebulba() -> None:
                 "env_interaction_s": round(timers.get("Time/env_interaction_time", 0.0), 3),
                 # no vs_baseline: the PPO reference bar is a different
                 # algorithm's env rate
+            }
+        )
+    )
+
+
+@lane("population", "ppo_cartpole_population_env_steps_per_sec")
+def _lane_population() -> None:
+    pop_mode = os.environ.get("BENCH_POP_MODE", "vmapped").strip().lower()
+    if pop_mode not in ("vmapped", "sequential"):
+        raise SystemExit(f"Unknown BENCH_POP_MODE '{pop_mode}' (expected 'vmapped' or 'sequential')")
+    pop_size = int(os.environ.get("BENCH_POP_SIZE", 8))
+    # per-member steps, identical to the single-run ondevice recipe so the
+    # pairing measures the topology (one dispatch vs P) and nothing else
+    total_steps = _env_steps(65536)
+
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
+
+    tracecheck.reset()
+    if pop_mode == "vmapped":
+        # seed-only population (hparams={} in the exp): every member runs the
+        # EXACT recipe the sequential baseline runs
+        elapsed = _run_cli(
+            "ppo_anakin_population_benchmarks",
+            total_steps,
+            # hparams override: the exp's seed-only intent must survive the
+            # algo default's lr grid through deep-merge at any BENCH_POP_SIZE
+            extra=[f"algo.population.size={pop_size}", "algo.population.hparams={}"],
+        )
+        block = tracecheck.report().get("ppo_anakin_pop.block", {})
+    else:
+        elapsed = 0.0
+        for member in range(pop_size):
+            elapsed += _run_cli("ppo_anakin_benchmarks", total_steps, extra=[f"seed={42 + member}"])
+        block = tracecheck.report().get("ppo_anakin.block", {})
+    aggregate_steps = pop_size * total_steps
+    # per-member rate = each member's own training rate: the vmapped members
+    # share the whole wall-clock, a sequential member only its elapsed/P slice
+    member_elapsed = elapsed if pop_mode == "vmapped" else elapsed / pop_size
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_population_env_steps_per_sec",
+                "value": round(aggregate_steps / elapsed, 2),
+                "unit": "aggregate env-steps/s",
+                "mode": pop_mode,
+                "population_size": pop_size,
+                "per_member_env_steps_per_sec": round(total_steps / member_elapsed, 2),
+                "block_compiles": int(block.get("compiles", 0)),
+                "block_calls": int(block.get("calls", 0)),
+                "elapsed_s": round(elapsed, 2),
+                "vs_baseline": round((aggregate_steps / elapsed) / BASELINE_STEPS_PER_SEC, 3),
             }
         )
     )

@@ -14,7 +14,26 @@ from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.registry import register_evaluation, register_policy_builder
 
-__all__ = ["evaluate_ppo", "serve_policy_ppo"]
+__all__ = ["evaluate_ppo", "serve_policy_ppo", "evaluate_ppo_population", "serve_policy_ppo_population"]
+
+
+def _member_slice(tree: Any, member: int) -> Any:
+    """Slice one member out of a member-stacked (P, ...) pytree. Plain
+    ``x[member]`` indexing: numpy leaves (loaded checkpoints) slice on host,
+    jax leaves (hot-swapped live params) slice on device — no forced
+    device→host copy of the whole P-stacked tree."""
+    import jax
+
+    return jax.tree.map(lambda x: x[member], tree)
+
+
+def _best_member_state(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Population checkpoints stack every member on leading axis 0; slice the
+    fittest member (recorded at save time) so the single-agent eval/serve
+    paths run unchanged."""
+    sliced = dict(state)
+    sliced["agent"] = _member_slice(state["agent"], int(state.get("best_member", 0)))
+    return sliced
 
 
 # The decoupled, Anakin and Sebulba mains write the same checkpoint layout
@@ -121,4 +140,40 @@ def serve_policy_ppo(fabric, cfg: Dict[str, Any], observation_space, action_spac
         sample_fn=sample_fn,
         prepare=prepare,
         params_from_state=params_from_state,
+    )
+
+
+@register_evaluation(algorithms=["ppo_anakin_population"])
+def evaluate_ppo_population(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    """Evaluate the fittest member of a population checkpoint on the
+    gymnasium twin of its pure-JAX training env."""
+    return evaluate_ppo(fabric, cfg, _best_member_state(state))
+
+
+@register_policy_builder(algorithms=["ppo_anakin_population"])
+def serve_policy_ppo_population(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state, full_state=None):
+    """Serve the fittest member of a population checkpoint. ``full_state``
+    (the whole loaded checkpoint, handed over by ``serve_policy`` so the
+    population checkpoint is not deserialized twice) carries the
+    ``best_member`` index the driver stamped at save time; absent that, it
+    is read from the checkpoint being served. The member choice also wraps
+    the hot-swap path: a watched population run keeps publishing
+    member-STACKED ``state["agent"]`` trees, so ``params_from_state`` must
+    slice the served member before rebuilding — stacked ``(P, ...)`` leaves
+    reaching the AOT bucket executables would fail every dispatch."""
+    import dataclasses
+
+    if full_state is not None:
+        best = int(full_state.get("best_member", 0))
+    elif cfg.get("checkpoint_path"):
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        best = int(load_state(cfg.checkpoint_path).get("best_member", 0))
+    else:
+        best = 0
+
+    policy = serve_policy_ppo(fabric, cfg, observation_space, action_space, _member_slice(agent_state, best))
+    rebuild_single = policy.params_from_state
+    return dataclasses.replace(
+        policy, params_from_state=lambda new_agent_state: rebuild_single(_member_slice(new_agent_state, best))
     )

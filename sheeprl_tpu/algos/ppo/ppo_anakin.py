@@ -66,7 +66,146 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.parallel.compat import shard_map
 
-__all__ = ["main", "make_anakin_block"]
+__all__ = [
+    "main",
+    "make_anakin_block",
+    "make_anakin_local_block",
+    "resolve_iters_per_block",
+    "AnakinBlockCache",
+]
+
+#: per-block metric ferry budget in elements — bounds the stacked episode
+#: arrays a single block dispatch ships back to the host
+FERRY_ELEMS_BOUND = 1 << 24
+
+
+def make_anakin_local_block(
+    agent,
+    tx,
+    cfg,
+    benv,
+    local_envs: int,
+    iters_per_block: int,
+    obs_key: str,
+    ferry_episodes: bool = True,
+    guard: bool = False,
+    population: bool = False,
+):
+    """Build the PER-DEVICE fused block body: ``iters_per_block`` × (rollout
+    ``lax.scan`` → GAE → epoch/minibatch optimization). Must run inside a
+    ``shard_map`` with a ``dp`` axis; :func:`make_anakin_block` wraps it for
+    the single-run path, the population driver ``vmap``s it over a leading
+    member axis first (``shard_map(vmap(local_block))``).
+
+    ``population=True`` switches the per-run hyperparameters from baked-in
+    Python constants to TRACED arguments — the signature grows
+    ``(..., gamma, gae_lambda)`` after the loss coefficients — so ONE compile
+    serves every (seed, hparam) member of a vmapped population, and adds a
+    per-iteration ``fit`` metric (mean per-env raw-reward sum over the
+    rollout, ``pmean``'d over ``dp``) as the in-graph fitness the PBT
+    selection step consumes. With ``population=False`` the emitted graph is
+    the exact pre-population block (constants folded at trace time).
+    """
+    T = int(cfg.algo.rollout_steps)
+    cfg_gamma = float(cfg.algo.gamma)
+    cfg_gae_lambda = float(cfg.algo.gae_lambda)
+    is_continuous = agent.is_continuous
+    n_heads = 1 if is_continuous else len(agent.actions_dim)
+    # guard=True: NaN/Inf minibatches skip their update in graph and the
+    # per-iteration skip count rides out with the block metrics ("bad") —
+    # the only way to sentinel a fused multi-iteration program.
+    local_train = make_local_train(agent, tx, cfg, T * local_envs, guard=guard)
+
+    def local_block(params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key, clip_coef, ent_coef, *hp):
+        if population:
+            gamma, gae_lambda = hp
+        else:
+            gamma, gae_lambda = cfg_gamma, cfg_gae_lambda
+
+        def rollout_step(carry, _):
+            params, env_state, obs, ep_ret, ep_len, key = carry
+            key, akey = jax.random.split(key)
+            acts, logprob, value = sample_actions(agent, params, {obs_key: obs}, akey)
+            if is_continuous:
+                buf_action = jnp.concatenate(acts, axis=-1)
+                env_action = buf_action
+            else:
+                buf_action = jnp.concatenate(acts, axis=-1)
+                idx = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)
+                env_action = idx[..., 0] if n_heads == 1 else idx
+            env_state, next_obs, reward, done, info = benv.step(env_state, env_action)
+
+            # time-limit bootstrap, fused (host loop: rewards[trunc] += gamma *
+            # V(final_obs)); cond-gated so the extra critic forward only runs on
+            # the rare steps where some env actually hit the time limit
+            truncated = info["truncated"]
+
+            def bootstrap(r):
+                v_final = agent.apply(params, {obs_key: info["final_obs"]})[1]
+                return r + gamma * v_final[..., 0] * truncated.astype(jnp.float32)
+
+            train_reward = jax.lax.cond(truncated.any(), bootstrap, lambda r: r, reward)
+
+            ep_ret = ep_ret + reward
+            ep_len = ep_len + 1
+            y = {
+                "obs": obs,
+                "actions": buf_action,
+                "logprobs": logprob,
+                "values": value,
+                "rewards": train_reward[..., None],
+                "dones": done.astype(jnp.float32)[..., None],
+            }
+            if population:
+                y["raw_rewards"] = reward
+            if ferry_episodes:
+                y["ep_done"] = done
+                y["ep_ret"] = jnp.where(done, ep_ret, 0.0)
+                y["ep_len"] = jnp.where(done, ep_len, 0)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            ep_len = jnp.where(done, 0, ep_len)
+            return (params, env_state, next_obs, ep_ret, ep_len, key), y
+
+        def one_iter(carry, train_key):
+            params, opt_state, env_state, obs, ep_ret, ep_len, env_key = carry
+            (params, env_state, obs, ep_ret, ep_len, env_key), traj = jax.lax.scan(
+                rollout_step, (params, env_state, obs, ep_ret, ep_len, env_key), None, length=T
+            )
+            next_value = agent.apply(params, {obs_key: obs})[1]
+            returns, advantages = gae_op(
+                traj["rewards"], traj["values"], traj["dones"], next_value, gamma=gamma, gae_lambda=gae_lambda
+            )
+            data = {
+                obs_key: traj["obs"],
+                "actions": traj["actions"],
+                "logprobs": traj["logprobs"],
+                "values": traj["values"],
+                "returns": returns,
+                "advantages": advantages,
+            }
+            data = {k: v.reshape(T * local_envs, *v.shape[2:]) for k, v in data.items()}
+            outs = local_train(params, opt_state, data, train_key, clip_coef, ent_coef)
+            params, opt_state, pg, v, ent = outs[:5]
+            metrics = {"pg": pg, "v": v, "ent": ent}
+            if guard:
+                metrics["bad"] = outs[5]
+            if population:
+                # fitness: per-env raw-reward sum over this iteration's
+                # rollout, averaged over envs and the mesh — defined for every
+                # env (episodic or not) and monotone with episodic return
+                metrics["fit"] = jax.lax.pmean(traj["raw_rewards"].sum(axis=0).mean(), "dp")
+            if ferry_episodes:
+                metrics.update(ep_done=traj["ep_done"], ep_ret=traj["ep_ret"], ep_len=traj["ep_len"])
+            return (params, opt_state, env_state, obs, ep_ret, ep_len, env_key), metrics
+
+        env_key = env_keys[0]
+        train_keys = jax.random.split(train_key, iters_per_block)
+        carry = (params, opt_state, env_state, obs, ep_ret, ep_len, env_key)
+        carry, metrics = jax.lax.scan(one_iter, carry, train_keys)
+        params, opt_state, env_state, obs, ep_ret, ep_len, env_key = carry
+        return params, opt_state, env_state, obs, ep_ret, ep_len, env_key[None], metrics
+
+    return local_block
 
 
 def make_anakin_block(
@@ -94,92 +233,10 @@ def make_anakin_block(
     so a metrics-off run (the benchmark path) transfers only the per-iteration
     loss scalars per block.
     """
-    T = int(cfg.algo.rollout_steps)
-    gamma = float(cfg.algo.gamma)
-    gae_lambda = float(cfg.algo.gae_lambda)
-    is_continuous = agent.is_continuous
-    n_heads = 1 if is_continuous else len(agent.actions_dim)
-    # guard=True: NaN/Inf minibatches skip their update in graph and the
-    # per-iteration skip count rides out with the block metrics ("bad") —
-    # the only way to sentinel a fused multi-iteration program.
-    local_train = make_local_train(agent, tx, cfg, T * local_envs, guard=guard)
-
-    def rollout_step(carry, _):
-        params, env_state, obs, ep_ret, ep_len, key = carry
-        key, akey = jax.random.split(key)
-        acts, logprob, value = sample_actions(agent, params, {obs_key: obs}, akey)
-        if is_continuous:
-            buf_action = jnp.concatenate(acts, axis=-1)
-            env_action = buf_action
-        else:
-            buf_action = jnp.concatenate(acts, axis=-1)
-            idx = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)
-            env_action = idx[..., 0] if n_heads == 1 else idx
-        env_state, next_obs, reward, done, info = benv.step(env_state, env_action)
-
-        # time-limit bootstrap, fused (host loop: rewards[trunc] += gamma *
-        # V(final_obs)); cond-gated so the extra critic forward only runs on
-        # the rare steps where some env actually hit the time limit
-        truncated = info["truncated"]
-
-        def bootstrap(r):
-            v_final = agent.apply(params, {obs_key: info["final_obs"]})[1]
-            return r + gamma * v_final[..., 0] * truncated.astype(jnp.float32)
-
-        train_reward = jax.lax.cond(truncated.any(), bootstrap, lambda r: r, reward)
-
-        ep_ret = ep_ret + reward
-        ep_len = ep_len + 1
-        y = {
-            "obs": obs,
-            "actions": buf_action,
-            "logprobs": logprob,
-            "values": value,
-            "rewards": train_reward[..., None],
-            "dones": done.astype(jnp.float32)[..., None],
-        }
-        if ferry_episodes:
-            y["ep_done"] = done
-            y["ep_ret"] = jnp.where(done, ep_ret, 0.0)
-            y["ep_len"] = jnp.where(done, ep_len, 0)
-        ep_ret = jnp.where(done, 0.0, ep_ret)
-        ep_len = jnp.where(done, 0, ep_len)
-        return (params, env_state, next_obs, ep_ret, ep_len, key), y
-
-    def one_iter(carry, train_key):
-        params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef = carry
-        (params, env_state, obs, ep_ret, ep_len, env_key), traj = jax.lax.scan(
-            rollout_step, (params, env_state, obs, ep_ret, ep_len, env_key), None, length=T
-        )
-        next_value = agent.apply(params, {obs_key: obs})[1]
-        returns, advantages = gae_op(
-            traj["rewards"], traj["values"], traj["dones"], next_value, gamma=gamma, gae_lambda=gae_lambda
-        )
-        data = {
-            obs_key: traj["obs"],
-            "actions": traj["actions"],
-            "logprobs": traj["logprobs"],
-            "values": traj["values"],
-            "returns": returns,
-            "advantages": advantages,
-        }
-        data = {k: v.reshape(T * local_envs, *v.shape[2:]) for k, v in data.items()}
-        outs = local_train(params, opt_state, data, train_key, clip_coef, ent_coef)
-        params, opt_state, pg, v, ent = outs[:5]
-        metrics = {"pg": pg, "v": v, "ent": ent}
-        if guard:
-            metrics["bad"] = outs[5]
-        if ferry_episodes:
-            metrics.update(ep_done=traj["ep_done"], ep_ret=traj["ep_ret"], ep_len=traj["ep_len"])
-        return (params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef), metrics
-
-    def local_block(params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key, clip_coef, ent_coef):
-        env_key = env_keys[0]
-        train_keys = jax.random.split(train_key, iters_per_block)
-        carry = (params, opt_state, env_state, obs, ep_ret, ep_len, env_key, clip_coef, ent_coef)
-        carry, metrics = jax.lax.scan(one_iter, carry, train_keys)
-        params, opt_state, env_state, obs, ep_ret, ep_len, env_key, _, _ = carry
-        return params, opt_state, env_state, obs, ep_ret, ep_len, env_key[None], metrics
+    local_block = make_anakin_local_block(
+        agent, tx, cfg, benv, local_envs, iters_per_block, obs_key,
+        ferry_episodes=ferry_episodes, guard=guard,
+    )
 
     env_sharded = P("dp")
     metric_specs = {"pg": P(), "v": P(), "ent": P()}
@@ -194,12 +251,99 @@ def make_anakin_block(
         out_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, metric_specs),
         check_vma=False,
     )
-    return jax.jit(shard_block, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+    # Pin the env-carried outputs to the driver's staging sharding: left to
+    # inference, jit canonicalizes the shard_map's P("dp") outputs (e.g. to
+    # P() on small meshes) — an EQUIVALENT placement but a different C++
+    # jit-cache key, so the next block call (fed by this call's outputs)
+    # silently recompiled the whole program: one abstract signature, two
+    # compiles, no tracing-cache miss.
+    from jax.sharding import NamedSharding
+
+    env_out = NamedSharding(mesh, env_sharded)
+    out_shardings = (None, None, env_out, env_out, env_out, env_out, env_out, None)
+    return jax.jit(shard_block, donate_argnums=(0, 1, 2, 3, 4, 5, 6), out_shardings=out_shardings)
+
+
+def resolve_iters_per_block(
+    cfg,
+    total_iters: int,
+    policy_steps_per_iter: int,
+    ferry_episodes: bool,
+    population_size: int = 1,
+) -> int:
+    """Iterations fused per host dispatch: the log/checkpoint interval (so
+    metrics surface exactly when the host loop would emit them), bounded by
+    the per-block metric ferry budget.
+
+    The ferry bound covers the stacked episode arrays — 3 arrays of
+    ``(P, iters, T, num_envs)`` — so it divides by the POPULATION size too:
+    a P-member block ships P× the episode metrics of a single run, and a
+    bound that assumed scalar hparams (P == 1) would let a wide population
+    queue gigabyte-scale device→host ferries per dispatch.
+    """
+    if cfg.algo.get("iters_per_block"):
+        iters_per_block = int(cfg.algo.iters_per_block)
+    else:
+        intervals = []
+        if cfg.metric.log_level > 0 and cfg.metric.log_every > 0:
+            intervals.append(int(cfg.metric.log_every))
+        if cfg.checkpoint.every > 0:
+            intervals.append(int(cfg.checkpoint.every))
+        interval = min(intervals) if intervals else cfg.algo.total_steps
+        iters_per_block = max(1, int(interval) // policy_steps_per_iter)
+    iters_per_block = max(1, min(iters_per_block, total_iters))
+    if ferry_episodes:
+        T = int(cfg.algo.rollout_steps)
+        num_envs = int(cfg.env.num_envs)
+        ferry_rows = max(1, T * num_envs * max(1, int(population_size)))
+        iters_per_block = max(1, min(iters_per_block, FERRY_ELEMS_BOUND // ferry_rows))
+    return iters_per_block
+
+
+class AnakinBlockCache:
+    """Per-block-length compile cache for the fused block.
+
+    A run dispatches at most two distinct block lengths — the body length and
+    the final remainder — and each compiled program is registered as the same
+    tracecheck hot path, so the fused block must NEVER retrace past its own
+    first compile. ``builder(n_iters)`` returns the jitted block for one
+    length; the population driver passes its own builder (same contract, the
+    member axis and traced hparams change the program, not the cache rule).
+    """
+
+    def __init__(self, builder, name: str):
+        self._builder = builder
+        self._name = name
+        self._fns: Dict[int, Any] = {}
+
+    def __call__(self, n_iters: int):
+        if n_iters not in self._fns:
+            self._fns[n_iters] = tracecheck.instrument(self._builder(n_iters), name=self._name)
+        return self._fns[n_iters]
+
+    def __len__(self) -> int:
+        return len(self._fns)
 
 
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     from sheeprl_tpu.fault import DivergenceSentinel, load_resume_state
+
+    # algo.population.size > 1 turns the Anakin main into the vmapped
+    # population driver (one dispatch trains the whole population); the
+    # dedicated algo=ppo_anakin_population entry point lands there directly.
+    pop_cfg = cfg.algo.get("population") or {}
+    if int(pop_cfg.get("size") or 1) > 1:
+        from sheeprl_tpu.algos.ppo.ppo_anakin_population import population_main
+
+        return population_main(fabric, cfg)
+    if pop_cfg.get("hparams"):
+        warnings.warn(
+            "algo.population.hparams is configured but algo.population.size is 1: the sweep is "
+            "IGNORED and this trains one member at the run config's scalars. Set "
+            "algo.population.size=P (or algo=ppo_anakin_population) to train the population.",
+            UserWarning,
+        )
 
     if jax.process_count() > 1:  # pragma: no cover - single-host subsystem
         raise NotImplementedError(
@@ -305,23 +449,8 @@ def main(fabric, cfg: Dict[str, Any]):
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
-    # Block size: iterations fused per host dispatch — the log/checkpoint
-    # interval, so metrics surface exactly when the host loop would emit them.
-    if cfg.algo.get("iters_per_block"):
-        iters_per_block = int(cfg.algo.iters_per_block)
-    else:
-        intervals = []
-        if cfg.metric.log_level > 0 and cfg.metric.log_every > 0:
-            intervals.append(int(cfg.metric.log_every))
-        if cfg.checkpoint.every > 0:
-            intervals.append(int(cfg.checkpoint.every))
-        interval = min(intervals) if intervals else cfg.algo.total_steps
-        iters_per_block = max(1, int(interval) // policy_steps_per_iter)
     ferry_episodes = cfg.metric.log_level > 0
-    iters_per_block = max(1, min(iters_per_block, total_iters))
-    if ferry_episodes:
-        # bound the per-block metric ferry (3 arrays of (iters, T, num_envs))
-        iters_per_block = max(1, min(iters_per_block, (1 << 24) // max(1, T * num_envs)))
+    iters_per_block = resolve_iters_per_block(cfg, total_iters, policy_steps_per_iter, ferry_episodes)
 
     sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
     guard = bool(sentinel_cfg.get("enabled", True))
@@ -332,6 +461,10 @@ def main(fabric, cfg: Dict[str, Any]):
     rng, env_reset_key, rollout_root = jax.random.split(rng, 3)
     if state is not None and state.get("rng") is not None:
         rng = jnp.asarray(state["rng"])  # continue the killed run's stream
+    # committed-replicated up front so the per-block eager split yields keys
+    # already placed on the mesh (an uncommitted key would be replicated
+    # implicitly INSIDE the guarded block dispatch)
+    rng = fabric.put_replicated(rng)
 
     benv = BatchedJaxEnv(jenv, num_envs)
     env_state, first_obs = jax.jit(benv.reset)(env_reset_key)
@@ -342,21 +475,13 @@ def main(fabric, cfg: Dict[str, Any]):
     ep_len = jax.device_put(jnp.zeros((num_envs,), jnp.int32), env_sharding)
     env_keys = jax.device_put(jax.random.split(rollout_root, world), env_sharding)
 
-    block_fns: Dict[int, Any] = {}
-
-    def get_block_fn(n_iters: int):
-        # one compile per distinct block length (at most two: body + remainder),
-        # each a registered hot path — the fused block must NEVER retrace past
-        # its own first compile
-        if n_iters not in block_fns:
-            block_fns[n_iters] = tracecheck.instrument(
-                make_anakin_block(
-                    agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key,
-                    ferry_episodes=ferry_episodes, guard=guard,
-                ),
-                name="ppo_anakin.block",
-            )
-        return block_fns[n_iters]
+    get_block_fn = AnakinBlockCache(
+        lambda n_iters: make_anakin_block(
+            agent, tx, cfg, fabric.mesh, benv, local_envs, n_iters, obs_key,
+            ferry_episodes=ferry_episodes, guard=guard,
+        ),
+        name="ppo_anakin.block",
+    )
 
     lr = lr0
     clip_coef = float(cfg.algo.clip_coef)
@@ -373,10 +498,15 @@ def main(fabric, cfg: Dict[str, Any]):
         profiler.tick(iter_num + 1)
 
         rng, train_key = jax.random.split(rng)
+        # loss coefficients staged with ONE explicit replicated put each —
+        # left uncommitted they would be replicated across the mesh
+        # implicitly inside the guarded dispatch
+        clip_arr = fabric.put_replicated(jnp.asarray(clip_coef, dtype=jnp.float32))
+        ent_arr = fabric.put_replicated(jnp.asarray(ent_coef, dtype=jnp.float32))
         with timer("Time/train_time", SumMetric):
             params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, metrics = block_fn(
                 params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key,
-                jnp.asarray(clip_coef, dtype=jnp.float32), jnp.asarray(ent_coef, dtype=jnp.float32),
+                clip_arr, ent_arr,
             )
             metrics = jax.device_get(metrics)
 
@@ -420,7 +550,10 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
                 )
                 if good.get("rng") is not None:
-                    rng = jnp.asarray(good["rng"])
+                    # committed-replicated like the launch-time staging: an
+                    # uncommitted key would re-enter the guarded dispatch as
+                    # an implicit transfer + sharding-level recompile
+                    rng = fabric.put_replicated(jnp.asarray(good["rng"]))
 
             sentinel.recover(ckpt_dir, _rollback)
 
@@ -449,7 +582,10 @@ def main(fabric, cfg: Dict[str, Any]):
         # Annealing at block granularity (identical when annealing is off)
         if cfg.algo.anneal_lr:
             lr = polynomial_decay(iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0)
-            opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+            # staged replicated like the initial opt_state: an uncommitted
+            # scalar here would flip the input's committed-ness next call
+            # (sharding-level cache miss) and transfer inside the dispatch
+            opt_state.hyperparams["learning_rate"] = fabric.put_replicated(jnp.asarray(lr, dtype=jnp.float32))
         if cfg.algo.anneal_clip_coef:
             clip_coef = polynomial_decay(
                 iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0

@@ -4,7 +4,8 @@ When the environment itself is a jittable function, the whole
 rollout→advantage→update loop compiles into ONE XLA program (Podracer /
 Anakin, https://arxiv.org/pdf/2104.06272): no per-step host dispatch, no
 host↔device transfers, envs `vmap`-batched and sharded across the mesh.
-:mod:`sheeprl_tpu.algos.ppo.ppo_anakin` is the first consumer.
+:mod:`sheeprl_tpu.algos.ppo.ppo_anakin` (and its population twin) consume
+them.
 
 Surface:
 
@@ -18,7 +19,15 @@ Surface:
 - :func:`~sheeprl_tpu.envs.jax_envs.base.make_jax_env` /
   :func:`~sheeprl_tpu.envs.jax_envs.base.is_jax_env` — registry keyed by the
   gymnasium id, so ``env.id=CartPole-v1`` selects the pure-JAX twin.
+
+Adding an env is ONE file: drop ``myenv.py`` in this package with a
+``@register_jax_env("MyEnv-v1")``-decorated :class:`JaxEnv` subclass — every
+module here is auto-imported below (no ``__init__`` edit), the registry picks
+it up, and the env class is re-exported from the package namespace.
 """
+
+import importlib as _importlib
+import pkgutil as _pkgutil
 
 from sheeprl_tpu.envs.jax_envs.base import (
     JAX_ENV_REGISTRY,
@@ -26,16 +35,27 @@ from sheeprl_tpu.envs.jax_envs.base import (
     JaxEnv,
     is_jax_env,
     make_jax_env,
+    register_jax_env,
 )
-from sheeprl_tpu.envs.jax_envs.cartpole import JaxCartPole
-from sheeprl_tpu.envs.jax_envs.pendulum import JaxPendulum
 
 __all__ = [
     "JaxEnv",
     "BatchedJaxEnv",
-    "JaxCartPole",
-    "JaxPendulum",
     "JAX_ENV_REGISTRY",
+    "register_jax_env",
     "make_jax_env",
     "is_jax_env",
 ]
+
+# Auto-discovery: import every sibling module so its @register_jax_env
+# decorators run, then re-export the registered classes (JaxCartPole etc.
+# stay importable from the package, new envs join with zero edits here).
+for _mod in _pkgutil.iter_modules(__path__):
+    if _mod.name.startswith("_") or _mod.name == "base":
+        continue
+    _importlib.import_module(f"{__name__}.{_mod.name}")
+
+for _cls in JAX_ENV_REGISTRY.values():
+    globals()[_cls.__name__] = _cls
+    if _cls.__name__ not in __all__:
+        __all__.append(_cls.__name__)

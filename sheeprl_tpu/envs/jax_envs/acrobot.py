@@ -1,0 +1,129 @@
+"""Pure-JAX Acrobot-v1, dynamics-exact against gymnasium.
+
+Same constants, RK4 integrator over one ``dt=0.2`` interval, ``book`` dynamics
+variant, angle wrap / velocity bound, -1-per-step reward (0 on the terminating
+step) and U(-0.1, 0.1) reset as
+``gymnasium.envs.classic_control.AcrobotEnv`` (gymnasium integrates in
+float64, this env in float32 — parity within float tolerance is asserted by
+``tests/test_envs/test_jax_envs.py``). The 500-step TimeLimit truncation is a
+step counter in the env state, keeping the env a pure function.
+
+Third dynamics regime of the zoo: unlike CartPole (unstable equilibrium,
+dense +1) and Pendulum (continuous torque, shaped cost), Acrobot is an
+underactuated double pendulum with a sparse cost — the population bench
+sweeps hyperparameters across genuinely different optimization landscapes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
+
+__all__ = ["JaxAcrobot", "AcrobotState"]
+
+
+class AcrobotState(NamedTuple):
+    physics: jax.Array  # (4,) float32: theta1, theta2, dtheta1, dtheta2
+    t: jax.Array  # () int32 steps taken this episode
+
+
+def _wrap(x: jax.Array, m: float, M: float) -> jax.Array:
+    # gymnasium's while-loop wrap, closed form: fold x into [m, M)
+    return ((x - m) % (M - m)) + m
+
+
+@register_jax_env("Acrobot-v1")
+class JaxAcrobot(JaxEnv):
+    # gymnasium AcrobotEnv constants (book variant, zero torque noise)
+    dt = 0.2
+    link_length_1 = 1.0
+    link_mass_1 = 1.0
+    link_mass_2 = 1.0
+    link_com_pos_1 = 0.5
+    link_com_pos_2 = 0.5
+    link_moi = 1.0
+    max_vel_1 = 4 * np.pi
+    max_vel_2 = 9 * np.pi
+    avail_torque = (-1.0, 0.0, 1.0)
+    gravity = 9.8
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = int(max_episode_steps)
+
+    @property
+    def observation_space(self) -> gym.Space:
+        high = np.array([1.0, 1.0, 1.0, 1.0, self.max_vel_1, self.max_vel_2], dtype=np.float32)
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    @property
+    def action_space(self) -> gym.Space:
+        return gym.spaces.Discrete(3)
+
+    def _obs(self, s: jax.Array) -> jax.Array:
+        return jnp.stack(
+            [jnp.cos(s[0]), jnp.sin(s[0]), jnp.cos(s[1]), jnp.sin(s[1]), s[2], s[3]]
+        ).astype(jnp.float32)
+
+    def reset(self, key: jax.Array) -> Tuple[AcrobotState, jax.Array]:
+        physics = jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1, dtype=jnp.float32)
+        return AcrobotState(physics=physics, t=jnp.zeros((), jnp.int32)), self._obs(physics)
+
+    def _dsdt(self, s: jax.Array, torque: jax.Array) -> jax.Array:
+        m1, m2 = self.link_mass_1, self.link_mass_2
+        l1 = self.link_length_1
+        lc1, lc2 = self.link_com_pos_1, self.link_com_pos_2
+        i1 = i2 = self.link_moi
+        g = self.gravity
+        theta1, theta2, dtheta1, dtheta2 = s[0], s[1], s[2], s[3]
+        d1 = m1 * lc1**2 + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(theta2)) + i1 + i2
+        d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(theta2)) + i2
+        phi2 = m2 * lc2 * g * jnp.cos(theta1 + theta2 - jnp.pi / 2.0)
+        phi1 = (
+            -m2 * l1 * lc2 * dtheta2**2 * jnp.sin(theta2)
+            - 2 * m2 * l1 * lc2 * dtheta2 * dtheta1 * jnp.sin(theta2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(theta1 - jnp.pi / 2)
+            + phi2
+        )
+        # "book" dynamics (gymnasium default)
+        ddtheta2 = (
+            torque + d2 / d1 * phi1 - m2 * l1 * lc2 * dtheta1**2 * jnp.sin(theta2) - phi2
+        ) / (m2 * lc2**2 + i2 - d2**2 / d1)
+        ddtheta1 = -(d2 * ddtheta2 + phi1) / d1
+        return jnp.stack([dtheta1, dtheta2, ddtheta1, ddtheta2])
+
+    def step(
+        self, state: AcrobotState, action: jax.Array
+    ) -> Tuple[AcrobotState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        torque = jnp.asarray(self.avail_torque, dtype=jnp.float32)[action.astype(jnp.int32)]
+        # rk4 over a single [0, dt] interval, exactly like gymnasium
+        # (the torque is the constant augmented component, derivative 0)
+        y0 = state.physics
+        dt, dt2 = self.dt, self.dt / 2.0
+        k1 = self._dsdt(y0, torque)
+        k2 = self._dsdt(y0 + dt2 * k1, torque)
+        k3 = self._dsdt(y0 + dt2 * k2, torque)
+        k4 = self._dsdt(y0 + dt * k3, torque)
+        ns = y0 + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+
+        ns = jnp.stack(
+            [
+                _wrap(ns[0], -jnp.pi, jnp.pi),
+                _wrap(ns[1], -jnp.pi, jnp.pi),
+                jnp.clip(ns[2], -self.max_vel_1, self.max_vel_1),
+                jnp.clip(ns[3], -self.max_vel_2, self.max_vel_2),
+            ]
+        ).astype(jnp.float32)
+
+        t = state.t + 1
+        terminated = (-jnp.cos(ns[0]) - jnp.cos(ns[1] + ns[0])) > 1.0
+        truncated = t >= self.max_episode_steps
+        done = terminated | truncated
+        reward = jnp.where(terminated, 0.0, -1.0).astype(jnp.float32)
+        info = {"terminated": terminated, "truncated": truncated}
+        return AcrobotState(physics=ns, t=t), self._obs(ns), reward, done, info

@@ -224,7 +224,18 @@ def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) ->
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     env.close()
 
-    policy = builder(fabric, cfg, observation_space, action_space, state["agent"])
+    # builders that declare a `full_state` parameter get the whole loaded
+    # checkpoint (e.g. the population builder reads `best_member` from it
+    # instead of deserializing the stacked checkpoint a second time)
+    import inspect
+
+    builder_kwargs = {}
+    try:
+        if "full_state" in inspect.signature(builder).parameters:
+            builder_kwargs["full_state"] = state
+    except (TypeError, ValueError):  # pragma: no cover - exotic callables
+        pass
+    policy = builder(fabric, cfg, observation_space, action_space, state["agent"], **builder_kwargs)
     serve_cfg = dict(cfg.get("serve", {}))
     watch_dir = None
     if serve_cfg.get("watch"):

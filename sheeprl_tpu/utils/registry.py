@@ -22,6 +22,7 @@ _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
     "sheeprl_tpu.algos.ppo.ppo",
     "sheeprl_tpu.algos.ppo.ppo_anakin",
+    "sheeprl_tpu.algos.ppo.ppo_anakin_population",
     "sheeprl_tpu.algos.ppo.ppo_decoupled",
     "sheeprl_tpu.algos.ppo.ppo_sebulba",
     "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",

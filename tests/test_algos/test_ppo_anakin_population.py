@@ -1,0 +1,826 @@
+"""Vmapped population training on the Anakin path.
+
+Coverage mirrors the PR acceptance criteria:
+
+- P=1 population block is BIT-identical to the plain ``make_anakin_block``
+  (same seed, same hparams — the size-1 vmap is unrolled so XLA emits the
+  exact single-run program);
+- P=4 dry runs through the real CLI on 1/2 devices (envs sharded under the
+  population axis), plus the ``algo=ppo_anakin algo.population.size=P``
+  trigger route;
+- sweep-spec resolution: grid order/product, random determinism per seed,
+  per-hparam stream independence, every rejection path;
+- PBT truncation step: determinism under a fixed key,
+  all-members-identical stays identical, copy/perturb/clamp semantics;
+- population checkpoint → SIGKILL mid-save → ``resume_from=latest`` round
+  trip (params, hparams and every RNG stream restored — proven by resuming
+  under a DIFFERENT seed, which would re-draw a random sweep if the driver
+  re-resolved instead of restoring);
+- block-length regression: a run with a remainder block compiles the
+  population block at most twice (body + remainder) with P>1;
+- slow lane: best-of-population CartPole trailing return clears the
+  single-run threshold.
+"""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.config import compose
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FAST = [
+    "env=gym",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+    "checkpoint.save_last=False",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def _args(tmp_path, *extra, devices=1, dry=True):
+    args = [
+        "exp=ppo_anakin_population",
+        *FAST,
+        f"fabric.devices={devices}",
+        f"log_root={tmp_path}/logs",
+    ]
+    if dry:
+        args.append("dry_run=True")
+    args.extend(extra)
+    return args
+
+
+# --------------------------------------------------------------------------- #
+# P=1 bit-parity vs the plain fused block
+# --------------------------------------------------------------------------- #
+
+
+def _parity_cfg():
+    return compose(
+        [
+            "exp=ppo_anakin",
+            *FAST,
+            "fabric.devices=1",
+        ]
+    )
+
+
+def _fresh_inputs(cfg, fabric, params_np, tx, benv):
+    """Rebuild the block inputs from fixed keys (block args are donated, so
+    every dispatch needs its own buffers)."""
+    params = jax.tree.map(jnp.asarray, params_np)
+    opt_state = tx.init(params)
+    env_state, obs = jax.jit(benv.reset)(jax.random.PRNGKey(5))
+    num_envs = int(cfg.env.num_envs)
+    ep_ret = jnp.zeros((num_envs,), jnp.float32)
+    ep_len = jnp.zeros((num_envs,), jnp.int32)
+    env_keys = jax.random.split(jax.random.PRNGKey(6), fabric.world_size)
+    train_key = jax.random.PRNGKey(7)
+    return params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key
+
+
+def _run_parity_check():
+    """The P=1 population dispatch (traced hparams, member axis, fitness
+    ferry) must produce BIT-identical params / optimizer state / env state /
+    losses to the plain single-run block under the same keys and hparams.
+
+    Executed in a FRESH subprocess (see the test below): bit-parity across
+    two *different* XLA programs is only well-defined when both compile
+    under identical compiler state. In-process suite history — warm tracing
+    caches from earlier runs, persistent-cache AOT loads (XLA:CPU's
+    serialize/load path codegens the shared core differently than the
+    in-process JIT, the same cpu_aot_loader wobble PR 3 documented) —
+    perturbs one program's codegen at ulp level, and two training
+    iterations of action *sampling* amplify one flipped logit ulp into a
+    fully divergent trajectory. A clean process compiles both programs side
+    by side, which is exactly the invariant the production driver relies
+    on: the P=1 program IS the single-run program."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo_anakin import make_anakin_block
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import _base_hparams, make_population_block
+    from sheeprl_tpu.envs.jax_envs import BatchedJaxEnv, make_jax_env
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.parallel import Fabric
+
+    cfg = _parity_cfg()
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(42)
+    jenv = make_jax_env("CartPole-v1")
+    obs_key = "state"
+    obs_space = gym.spaces.Dict({obs_key: jenv.observation_space})
+    agent, params, _ = build_agent(fabric, (2,), False, cfg, obs_space, None)
+    params_np = jax.device_get(params)
+
+    lr0 = float(cfg.algo.optimizer.lr)
+    tx = optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=lr0)
+
+    num_envs = int(cfg.env.num_envs)
+    benv = BatchedJaxEnv(jenv, num_envs)
+    iters = 2
+    clip0 = float(cfg.algo.clip_coef)
+    ent0 = float(cfg.algo.ent_coef)
+
+    # -- single-run fused block ------------------------------------------- #
+    block = make_anakin_block(
+        agent, tx, cfg, fabric.mesh, benv, num_envs, iters, obs_key, ferry_episodes=True, guard=False
+    )
+    sp, so, ss, sob, sret, slen, skeys, tkey = _fresh_inputs(cfg, fabric, params_np, tx, benv)
+    s_params, s_opt, s_env, s_obs, s_ret, s_len, _, s_metrics = block(
+        sp, so, ss, sob, sret, slen, skeys, tkey,
+        jnp.asarray(clip0, jnp.float32), jnp.asarray(ent0, jnp.float32),
+    )
+    s_params = jax.device_get(s_params)
+    s_metrics = jax.device_get(s_metrics)
+    s_obs = np.asarray(s_obs)
+
+    # -- P=1 population dispatch over the SAME inputs ---------------------- #
+    pblock = make_population_block(
+        agent, tx, cfg, fabric.mesh, benv, num_envs, iters, obs_key,
+        pop_size=1, ferry_episodes=True, guard=False, pbt=None,
+    )
+    pp, po, ps, pob, pret, plen, pkeys, tkey = _fresh_inputs(cfg, fabric, params_np, tx, benv)
+    stack = lambda tree: jax.tree.map(lambda x: x[None], tree)
+    hparams = {k: jnp.full((1,), v, jnp.float32) for k, v in _base_hparams(cfg).items()}
+    p_params, p_opt, p_env, p_obs, p_ret, p_len, _, p_hparams, p_fit, p_metrics = pblock(
+        stack(pp), stack(po), stack(ps), stack(pob), stack(pret), stack(plen), stack(pkeys),
+        tkey[None], hparams, jnp.ones((3,), jnp.float32), jnp.asarray(False), jax.random.PRNGKey(0),
+    )
+    p_params = jax.device_get(p_params)
+    p_metrics = jax.device_get(p_metrics)
+
+    for a, b in zip(jax.tree.leaves(s_params), jax.tree.leaves(p_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[0])
+    np.testing.assert_array_equal(s_obs, np.asarray(p_obs)[0])
+    np.testing.assert_array_equal(np.asarray(s_ret), np.asarray(p_ret)[0])
+    np.testing.assert_array_equal(np.asarray(s_len), np.asarray(p_len)[0])
+    for k in ("pg", "v", "ent"):
+        np.testing.assert_array_equal(np.asarray(s_metrics[k]), np.asarray(p_metrics[k])[0])
+    np.testing.assert_array_equal(np.asarray(s_metrics["ep_done"]), np.asarray(p_metrics["ep_done"])[0])
+    np.testing.assert_array_equal(np.asarray(s_metrics["ep_ret"]), np.asarray(p_metrics["ep_ret"])[0])
+    # the hparams ride through unchanged without PBT, fitness is finite
+    for k, v in hparams.items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(p_hparams[k]))
+    assert np.isfinite(np.asarray(p_fit)).all() and np.asarray(p_fit).shape == (1,)
+    print("PARITY_OK")
+
+
+def test_population_block_p1_bit_parity_with_single_block():
+    """Run the bit-parity check in a fresh subprocess (no persistent XLA
+    cache, no warm tracing caches) — see :func:`_run_parity_check` for why
+    cross-program BIT-parity demands a clean compiler state."""
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0 and "PARITY_OK" in proc.stdout, (
+        proc.stdout[-3000:],
+        proc.stderr[-3000:],
+    )
+
+
+# --------------------------------------------------------------------------- #
+# CLI dry runs — envs sharded under the population axis
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_population_dry_run(tmp_path, devices):
+    run(_args(tmp_path, "algo.population.size=4", "algo.population.hparams={}", devices=devices))
+
+
+def test_population_acrobot_dry_run(tmp_path):
+    """The third dynamics regime of the zoo (underactuated double pendulum,
+    sparse cost) trains through the population path: obs dim 6, 3 actions,
+    truncation bootstrap in-graph."""
+    run(
+        _args(
+            tmp_path,
+            "env.id=Acrobot-v1",
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+        )
+    )
+
+
+def test_population_grid_sweep_dry_run(tmp_path):
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=4",
+            "algo.population.hparams={lr: [1e-3, 5e-4], ent_coef: [0.0, 0.01]}",
+        )
+    )
+
+
+def test_population_trigger_from_anakin_main(tmp_path):
+    """`algo=ppo_anakin algo.population.size=P` routes into the population
+    driver and stamps the population algo name (so eval/serve/resume resolve
+    the population-aware entry points)."""
+    run(
+        [
+            "exp=ppo_anakin",
+            *FAST,
+            "fabric.devices=1",
+            f"log_root={tmp_path}/logs",
+            "dry_run=True",
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+        ]
+    )
+    assert glob.glob(str(tmp_path / "logs/ppo_anakin_population/CartPole-v1/*"))
+
+
+def test_population_rejects_host_env(tmp_path):
+    with pytest.raises(ValueError, match="pure-JAX"):
+        run(
+            _args(
+                tmp_path,
+                "env.id=discrete_dummy",
+                "algo.population.size=2",
+                "algo.population.hparams={}",
+            )
+        )
+
+
+# --------------------------------------------------------------------------- #
+# Sweep-spec resolution
+# --------------------------------------------------------------------------- #
+
+
+def _sweep_cfg(*extra):
+    return compose(["exp=ppo_anakin", *FAST, "fabric.devices=1", *extra])
+
+
+def test_sweep_grid_order_and_broadcast():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_sweep
+
+    cfg = _sweep_cfg(
+        "algo.population.sweep=grid",
+        "algo.population.hparams={lr: [1e-3, 5e-4], ent_coef: [0.0, 0.01]}",
+    )
+    hp, swept = resolve_sweep(cfg, 4, seed=0)
+    # cartesian product in HPARAM_KEYS order: lr is the outer axis
+    np.testing.assert_allclose(hp["lr"], [1e-3, 1e-3, 5e-4, 5e-4], rtol=1e-6)
+    np.testing.assert_allclose(hp["ent_coef"], [0.0, 0.01, 0.0, 0.01], rtol=1e-6)
+    # unswept keys broadcast the run config's scalar
+    np.testing.assert_allclose(hp["gamma"], np.full(4, float(cfg.algo.gamma)), rtol=1e-6)
+    assert swept == ("lr", "ent_coef")
+    # grid is seed-independent
+    hp2, _ = resolve_sweep(cfg, 4, seed=99)
+    for k in hp:
+        np.testing.assert_array_equal(hp[k], hp2[k])
+
+
+def test_sweep_random_deterministic_per_seed():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_sweep
+
+    cfg = _sweep_cfg(
+        "algo.population.sweep=random",
+        "algo.population.hparams={lr: {low: 1e-4, high: 1e-2, log: true}, ent_coef: {choices: [0.0, 0.01, 0.1]}}",
+    )
+    hp1, swept = resolve_sweep(cfg, 16, seed=3)
+    hp2, _ = resolve_sweep(cfg, 16, seed=3)
+    hp3, _ = resolve_sweep(cfg, 16, seed=4)
+    assert swept == ("lr", "ent_coef")
+    for k in hp1:
+        np.testing.assert_array_equal(hp1[k], hp2[k])
+    assert not np.array_equal(hp1["lr"], hp3["lr"])
+    assert ((hp1["lr"] >= 1e-4) & (hp1["lr"] <= 1e-2)).all()
+    assert np.isin(hp1["ent_coef"], np.asarray([0.0, 0.01, 0.1], np.float32)).all()
+
+
+def test_sweep_random_streams_are_per_hparam():
+    """Adding a second swept hparam must not reshuffle the first one's draws
+    (streams are keyed by (seed, name))."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_sweep
+
+    lone = _sweep_cfg(
+        "algo.population.sweep=random",
+        "algo.population.hparams={lr: {low: 1e-4, high: 1e-2, log: true}}",
+    )
+    both = _sweep_cfg(
+        "algo.population.sweep=random",
+        "algo.population.hparams={lr: {low: 1e-4, high: 1e-2, log: true}, gamma: {low: 0.9, high: 0.999}}",
+    )
+    hp_lone, _ = resolve_sweep(lone, 8, seed=5)
+    hp_both, _ = resolve_sweep(both, 8, seed=5)
+    np.testing.assert_array_equal(hp_lone["lr"], hp_both["lr"])
+
+
+def test_sweep_rejections():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_sweep
+
+    with pytest.raises(ValueError, match="cartesian product"):
+        resolve_sweep(_sweep_cfg("algo.population.hparams={lr: [1e-3, 5e-4]}"), 3, seed=0)
+    with pytest.raises(ValueError, match="cannot expand the range"):
+        resolve_sweep(
+            _sweep_cfg("algo.population.hparams={lr: {low: 1e-4, high: 1e-2}}"), 4, seed=0
+        )
+    with pytest.raises(ValueError, match="Unknown population hparam"):
+        resolve_sweep(_sweep_cfg("algo.population.hparams={vf_coef: [0.5, 1.0]}"), 2, seed=0)
+    with pytest.raises(ValueError, match="low > 0"):
+        resolve_sweep(
+            _sweep_cfg(
+                "algo.population.sweep=random",
+                "algo.population.hparams={lr: {low: 0.0, high: 1e-2, log: true}}",
+            ),
+            2,
+            seed=0,
+        )
+    with pytest.raises(ValueError, match="grid' or 'random"):
+        resolve_sweep(_sweep_cfg("algo.population.sweep=bayes"), 2, seed=0)
+
+
+# --------------------------------------------------------------------------- #
+# PBT truncation selection
+# --------------------------------------------------------------------------- #
+
+
+def _pbt_fixture(pop=4, value_per_member=None):
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import HPARAM_KEYS
+
+    base = np.arange(pop, dtype=np.float32) if value_per_member is None else value_per_member
+    params = {"w": jnp.asarray(base)[:, None] * jnp.ones((1, 3), jnp.float32)}
+    opt = {"mu": jnp.asarray(base) * 10.0}
+    hparams = {k: jnp.asarray(base + 1.0 + i, jnp.float32) for i, k in enumerate(HPARAM_KEYS)}
+    return params, opt, hparams
+
+
+def test_pbt_step_deterministic_and_truncates():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import PBTConfig, make_pbt_step
+
+    pbt = PBTConfig(num_copy=1, perturb=("lr",), factors=(0.8, 1.25))
+    step = jax.jit(make_pbt_step(4, pbt))
+    params, opt, hparams = _pbt_fixture()
+    fitness = jnp.asarray([3.0, 1.0, 2.0, 0.0])  # member 0 best, member 3 worst
+    key = jax.random.PRNGKey(12)
+
+    out1 = jax.device_get(step((params, opt, hparams, fitness, key)))
+    out2 = jax.device_get(step((params, opt, hparams, fitness, key)))
+    for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(out2)):
+        np.testing.assert_array_equal(a, b)
+
+    new_params, new_opt, new_hparams = out1
+    # the worst member copied the best member's params + optimizer state
+    np.testing.assert_array_equal(new_params["w"][3], np.asarray(params["w"])[0])
+    np.testing.assert_array_equal(new_opt["mu"][3], np.asarray(opt["mu"])[0])
+    # survivors untouched, bitwise
+    for m in (0, 1, 2):
+        np.testing.assert_array_equal(new_params["w"][m], np.asarray(params["w"])[m])
+        np.testing.assert_array_equal(new_opt["mu"][m], np.asarray(opt["mu"])[m])
+        for k in hparams:
+            np.testing.assert_array_equal(new_hparams[k][m], np.asarray(hparams[k])[m])
+    # the replaced member inherited the source lr times a perturb factor...
+    src_lr = float(np.asarray(hparams["lr"])[0])
+    assert np.isclose(float(new_hparams["lr"][3]), [0.8 * src_lr, 1.25 * src_lr], rtol=1e-6).any()
+    # ...and the un-perturbed hparams verbatim
+    for k in hparams:
+        if k == "lr":
+            continue
+        np.testing.assert_array_equal(new_hparams[k][3], np.asarray(hparams[k])[0])
+
+
+def test_pbt_all_identical_stays_identical():
+    """Equal fitness + identical members: stable ranking maps the population
+    onto itself — params/optimizer stay bitwise identical, and with an empty
+    perturb set the hparams do too."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import PBTConfig, make_pbt_step
+
+    pbt = PBTConfig(num_copy=1, perturb=(), factors=(0.8, 1.25))
+    step = jax.jit(make_pbt_step(4, pbt))
+    params, opt, hparams = _pbt_fixture(value_per_member=np.zeros(4, np.float32))
+    fitness = jnp.zeros((4,))
+    out = jax.device_get(step((params, opt, hparams, fitness, jax.random.PRNGKey(0))))
+    new_params, new_opt, new_hparams = out
+    np.testing.assert_array_equal(new_params["w"], np.asarray(params["w"]))
+    np.testing.assert_array_equal(new_opt["mu"], np.asarray(opt["mu"]))
+    for k in hparams:
+        np.testing.assert_array_equal(new_hparams[k], np.asarray(hparams[k]))
+
+
+def test_pbt_perturb_clamps_discount_hparams():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import PBTConfig, make_pbt_step
+
+    pbt = PBTConfig(num_copy=1, perturb=("gamma",), factors=(1.25,))
+    step = jax.jit(make_pbt_step(2, pbt))
+    params = {"w": jnp.zeros((2, 1))}
+    opt = {"mu": jnp.zeros((2,))}
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import HPARAM_KEYS
+
+    hparams = {k: jnp.full((2,), 0.5, jnp.float32) for k in HPARAM_KEYS}
+    hparams["gamma"] = jnp.asarray([0.999, 0.999], jnp.float32)
+    fitness = jnp.asarray([1.0, 0.0])
+    _, _, new_hparams = jax.device_get(step((params, opt, hparams, fitness, jax.random.PRNGKey(1))))
+    assert float(new_hparams["gamma"][1]) <= 0.9999  # 0.999 * 1.25 clamped
+
+
+def test_resolve_pbt_validation():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_pbt
+
+    on = ("algo.population.pbt.enabled=True",)
+    pbt, every = resolve_pbt(_sweep_cfg(*on), 8, swept=("lr",))
+    assert pbt is not None and pbt.num_copy == 2 and pbt.perturb == ("lr",) and every == 1
+    assert resolve_pbt(_sweep_cfg(), 8, swept=()) == (None, 0)
+    with pytest.raises(ValueError, match="size >= 2"):
+        resolve_pbt(_sweep_cfg(*on), 1, swept=())
+    with pytest.raises(ValueError, match="truncation_frac"):
+        resolve_pbt(_sweep_cfg(*on, "algo.population.pbt.truncation_frac=0.7"), 8, swept=())
+    with pytest.raises(ValueError, match="Unknown pbt.perturb"):
+        resolve_pbt(_sweep_cfg(*on, "algo.population.pbt.perturb=[vf_coef]"), 8, swept=())
+    with pytest.raises(ValueError, match="positive multipliers"):
+        resolve_pbt(_sweep_cfg(*on, "algo.population.pbt.perturb_factors=[-1.0]"), 8, swept=("lr",))
+
+
+def test_pbt_e2e_run(tmp_path):
+    """PBT-enabled population run through the real CLI: multiple blocks, the
+    gate fires every block, run completes."""
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=4",
+            "algo.population.hparams={lr: {low: 1e-4, high: 1e-2, log: true}}",
+            "algo.population.sweep=random",
+            "algo.population.pbt.enabled=True",
+            "algo.total_steps=64",
+            "checkpoint.every=16",
+            dry=False,
+        )
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Block-length compile regression (the get_block_fn / ferry-bound small fix)
+# --------------------------------------------------------------------------- #
+
+
+def test_population_block_compiles_at_most_twice_across_lengths(tmp_path):
+    """total_iters=3 with iters_per_block=2 dispatches a 2-iteration body and
+    a 1-iteration remainder: the population block must compile exactly twice
+    (once per length) and never again — the compile cache keys by length with
+    P>1 and traced hparams exactly as it does for scalar hparams."""
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
+
+    tracecheck.reset()
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+            "algo.rollout_steps=4",
+            "algo.total_steps=24",  # 3 iterations of 4 steps x 2 envs
+            "checkpoint.every=16",  # -> iters_per_block=2: blocks of 2 then 1
+            dry=False,
+        )
+    )
+    rep = tracecheck.report()["ppo_anakin_pop.block"]
+    assert rep["calls"] == 2, rep
+    assert rep["compiles"] == 2, rep
+    assert rep["post_warmup_compiles"] == 0, rep
+
+
+def test_ferry_bound_divides_by_population_size():
+    """The metric-ferry budget covers P x the episode arrays of a single run:
+    a wide population must shrink iters_per_block accordingly."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin import FERRY_ELEMS_BOUND, resolve_iters_per_block
+
+    cfg = _sweep_cfg("metric.log_every=100000000", "checkpoint.every=0", "metric.log_level=1")
+    T = int(cfg.algo.rollout_steps)
+    num_envs = int(cfg.env.num_envs)
+    total_iters = 10**9
+    single = resolve_iters_per_block(cfg, total_iters, T * num_envs, ferry_episodes=True)
+    pop = resolve_iters_per_block(
+        cfg, total_iters, T * num_envs, ferry_episodes=True, population_size=64
+    )
+    assert single == max(1, FERRY_ELEMS_BOUND // (T * num_envs))
+    assert pop == max(1, FERRY_ELEMS_BOUND // (T * num_envs * 64))
+    assert pop <= single // 64 + 1
+
+
+# --------------------------------------------------------------------------- #
+# Checkpoint → SIGKILL → resume_from=latest
+# --------------------------------------------------------------------------- #
+
+POP_KILL_ARGS = [
+    "exp=ppo_anakin_population",
+    "env=gym",
+    "env.id=CartPole-v1",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.total_steps=48",
+    "algo.population.size=3",
+    "algo.population.sweep=random",
+    "algo.population.hparams={lr: {low: 0.0001, high: 0.01, log: true}}",
+    "checkpoint.every=16",
+    "checkpoint.save_last=True",
+    "seed=11",
+    "log_root=logs",
+]
+
+
+def _launch(tmp_path, extra_args=(), extra_env=None):
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("SHEEPRL_FAULT_KILL", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", *POP_KILL_ARGS, *extra_args],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+@pytest.mark.fault
+def test_population_checkpoint_kill_resume_from_latest(tmp_path):
+    """Checkpoint → SIGKILL mid-save → ``resume_from=latest`` restores the
+    WHOLE population: member-stacked params, the per-member hparams (resumed
+    under a DIFFERENT seed — a re-resolved random sweep would draw different
+    values, so equality proves restore), every member RNG stream and the
+    population key, and the counters continue monotonically."""
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint, latest_complete
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    proc = _launch(tmp_path, extra_env={"SHEEPRL_FAULT_KILL": "checkpoint.pre_commit:2"})
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    ckpt_dirs = glob.glob(
+        str(tmp_path / "logs/ppo_anakin_population/CartPole-v1/*/version_*/checkpoint")
+    )
+    assert len(ckpt_dirs) == 1
+    first_complete = latest_complete(ckpt_dirs[0])
+    assert first_complete is not None and first_complete.name.startswith("ckpt_16")
+    pre = load_state(first_complete)
+    assert int(pre["population_size"]) == 3
+    pre_hparams = {k: np.asarray(v) for k, v in pre["hparams"].items()}
+    pre_rngs = np.asarray(pre["rng"])
+    assert pre_rngs.shape[0] == 3
+
+    # resume under a different seed: restored state must win over re-derivation
+    proc2 = _launch(tmp_path, extra_args=["checkpoint.resume_from=latest", "seed=123"])
+    assert proc2.returncode == 0, (proc2.stdout[-2000:], proc2.stderr[-2000:])
+    assert "checkpoint.resume_from=latest ->" in proc2.stdout
+
+    final = find_latest_run_checkpoint(tmp_path / "logs/ppo_anakin_population/CartPole-v1")
+    state = load_state(final)
+    assert int(os.path.basename(str(final)).split("_")[1]) >= 48
+    assert state["iter_num"] >= 6
+    assert int(state["population_size"]) == 3
+    # every member's params restored and trained on: leading axis 3, finite
+    for leaf in jax.tree.leaves(state["agent"]):
+        arr = np.asarray(leaf)
+        assert arr.shape[0] == 3
+        assert np.isfinite(arr).all()
+    # hparams survived the kill (random sweep under seed=123 would differ)
+    for k, v in state["hparams"].items():
+        np.testing.assert_array_equal(np.asarray(v), pre_hparams[k])
+    # member RNG streams continued from the restored values, not reseeded:
+    # every member key advanced past the first checkpoint's snapshot
+    post_rngs = np.asarray(state["rng"])
+    assert post_rngs.shape == pre_rngs.shape
+    assert not np.array_equal(post_rngs, pre_rngs)
+    # the population (PBT/perturbation) stream rode along too
+    assert state.get("pop_key") is not None
+    assert state.get("fitness") is not None and np.asarray(state["fitness"]).shape == (3,)
+
+
+def test_population_resume_conflicting_size_uses_checkpoint_population(tmp_path):
+    """A population checkpoint only resumes as the SAME population. Through
+    the CLI, ``resume_from`` merges the checkpoint run's saved config over
+    the command line, so a conflicting ``algo.population.size`` is OVERRIDDEN
+    and the run continues with the checkpointed members."""
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+            "algo.rollout_steps=4",
+            "algo.total_steps=16",
+            "checkpoint.every=8",
+            "checkpoint.save_last=True",
+            dry=False,
+        )
+    )
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=4",  # ignored: the checkpoint's size=2 wins
+            "algo.population.hparams={}",
+            "algo.rollout_steps=4",
+            "algo.total_steps=32",
+            "checkpoint.resume_from=latest",
+            "checkpoint.save_last=True",
+            dry=False,
+        )
+    )
+    final = find_latest_run_checkpoint(tmp_path / "logs/ppo_anakin_population/CartPole-v1")
+    state = load_state(final)
+    assert int(state["population_size"]) == 2
+    for leaf in jax.tree.leaves(state["agent"]):
+        assert np.asarray(leaf).shape[0] == 2
+
+
+def test_population_resume_size_mismatch_guard(tmp_path):
+    """The in-driver guard (defense in depth for resume paths that bypass the
+    CLI config merge, e.g. a direct ``population_main`` embedding or a
+    hand-edited saved config) rejects a size-mismatched resume outright."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import population_main
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.parallel import Fabric
+
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+            "algo.rollout_steps=4",
+            "algo.total_steps=16",
+            "checkpoint.every=0",
+            "checkpoint.save_last=True",
+            dry=False,
+        )
+    )
+    ckpt = find_latest_run_checkpoint(tmp_path / "logs/ppo_anakin_population/CartPole-v1")
+    cfg = compose(
+        _args(
+            tmp_path,
+            "algo.population.size=4",
+            "algo.population.hparams={}",
+            f"checkpoint.resume_from={ckpt}",
+        )
+    )
+    with pytest.raises(ValueError, match="population of 2"):
+        population_main(Fabric(devices=1, accelerator="cpu"), cfg)
+
+
+# --------------------------------------------------------------------------- #
+# Eval from a population checkpoint (best member)
+# --------------------------------------------------------------------------- #
+
+
+def test_population_serve_builder_slices_best_member_and_hot_swaps(tmp_path):
+    """The serve policy builder must hand SINGLE-member params to the AOT
+    engine — at construction AND on every hot swap: a watched population run
+    keeps publishing member-STACKED ``state["agent"]`` trees, so
+    ``params_from_state`` has to slice the served member before rebuilding
+    (stacked ``(P, ...)`` leaves would break every compiled dispatch)."""
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.utils.registry import get_entrypoint, resolve_policy_builder
+
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=3",
+            "algo.population.hparams={}",
+            "algo.rollout_steps=4",
+            "algo.total_steps=16",
+            "checkpoint.every=0",
+            "checkpoint.save_last=True",
+            dry=False,
+        )
+    )
+    ckpt = find_latest_run_checkpoint(tmp_path / "logs/ppo_anakin_population/CartPole-v1")
+    state = load_state(ckpt)
+    best = int(state["best_member"])
+    cfg = compose(_args(tmp_path, "algo.population.size=3", "algo.population.hparams={}"))
+    cfg["checkpoint_path"] = str(ckpt)
+
+    fabric = Fabric(devices=1, accelerator="cpu")
+    env = make_env(cfg, 0, 0, None, "serve", vector_env_idx=0)()
+    builder = get_entrypoint(resolve_policy_builder("ppo_anakin_population"))
+    policy = builder(fabric, cfg, env.observation_space, env.action_space, state["agent"], full_state=state)
+    env.close()
+
+    # construction sliced the checkpointed best member
+    for leaf, stacked in zip(jax.tree.leaves(policy.params), jax.tree.leaves(state["agent"])):
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(stacked)[best])
+    # the hot-swap path receives a STACKED tree (what a CheckpointWatcher
+    # publishes) and must rebuild single-member params with matching avals
+    swapped = policy.params_from_state(state["agent"])
+    for new, old in zip(jax.tree.leaves(swapped), jax.tree.leaves(policy.params)):
+        assert np.asarray(new).shape == np.asarray(old).shape
+        np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+
+def test_population_eval_from_checkpoint(tmp_path, capsys):
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+            "algo.rollout_steps=4",
+            "algo.total_steps=16",
+            "checkpoint.every=0",
+            "checkpoint.save_last=True",
+            dry=False,
+        )
+    )
+    ckpts = glob.glob(
+        str(tmp_path / "logs/ppo_anakin_population/CartPole-v1/*/version_*/checkpoint/*.ckpt")
+    )
+    assert ckpts
+    from sheeprl_tpu.cli import evaluation
+
+    evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu", "env.capture_video=False"])
+    out = capsys.readouterr().out
+    assert "Test - Reward:" in out
+
+
+# --------------------------------------------------------------------------- #
+# Slow lane: the population actually learns
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_population_best_member_learns_cartpole(tmp_path):
+    """Best-of-population CartPole: the headline Rewards/rew_avg stream (the
+    best member's completed episodes) must clear the single-run threshold
+    (PR 1: trailing-20 mean >= 475 for the single Anakin run)."""
+    sys.path.insert(0, REPO_ROOT)
+    from benchmarks.learning_bench import capture_returns
+
+    returns = capture_returns(
+        [
+            "exp=ppo_anakin_population",
+            "env=gym",
+            "env.id=CartPole-v1",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "fabric.devices=1",
+            "metric.log_level=1",
+            "metric.log_every=2048",
+            "algo.run_test=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.total_steps=65536",
+            "algo.population.size=4",
+            "algo.population.hparams={lr: [0.0005, 0.001, 0.002, 0.003]}",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            f"log_root={tmp_path}/logs",
+            "seed=5",
+        ]
+    )
+    assert len(returns) >= 20, f"too few finished episodes: {len(returns)}"
+    trailing = returns[-20:]
+    assert sum(trailing) / len(trailing) >= 475, (
+        f"best-of-population trailing-20 mean {sum(trailing) / len(trailing):.1f} < 475 "
+        f"(n={len(returns)})"
+    )
+
+
+if __name__ == "__main__":
+    _run_parity_check()

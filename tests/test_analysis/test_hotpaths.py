@@ -1,7 +1,7 @@
 """Tier-1 e2e dry-runs under the trace-hygiene fixture: strict retrace
 budgets + steady-state ``jax.transfer_guard("disallow")`` + tracer-leak
 checking, through the real CLI. The acceptance bar: 0 post-warmup retraces on
-the ppo / ppo_anakin / sac / ppo_sebulba hot paths, and a deliberately
+the ppo / ppo_anakin / ppo_anakin_population / sac / ppo_sebulba hot paths, and a deliberately
 planted host sync must be CAUGHT (proving the guard actually polices the
 steady state)."""
 
@@ -76,6 +76,59 @@ def test_ppo_steady_state_clean(tmp_path, trace_hygiene):
 def test_ppo_anakin_dry_run_clean(tmp_path, trace_hygiene):
     run(_args(tmp_path, "ppo_anakin", env="gym", extra=PPO_FAST))
     _assert_quiet(trace_hygiene, ["ppo_anakin.block"])
+
+
+def test_ppo_anakin_steady_state_clean(tmp_path, trace_hygiene):
+    """Multiple fused-block dispatches (NOT a dry run): the second call is
+    fed by the first call's donated outputs, so this pins the sharding-level
+    cache stability of the block program (out_shardings pinned to the
+    driver's staging sharding — a canonicalized-but-equivalent output
+    placement recompiles without any abstract-signature drift)."""
+    run(
+        _args(tmp_path, "ppo_anakin", env="gym", extra=PPO_FAST)
+        + [
+            "dry_run=False",
+            "algo.total_steps=64",
+            "checkpoint.every=16",
+            "checkpoint.save_last=False",
+            # the annealing staircase rewrites lr (inside the donated opt
+            # state) and the loss coefficients every block — values change,
+            # the program must not
+            "algo.anneal_lr=True",
+            "algo.anneal_clip_coef=True",
+            "algo.anneal_ent_coef=True",
+        ]
+    )
+    report = trace_hygiene.report()["ppo_anakin.block"]
+    assert report["calls"] >= 2, report
+    _assert_quiet(trace_hygiene, ["ppo_anakin.block"])
+
+
+def test_ppo_anakin_population_steady_state_clean(tmp_path, trace_hygiene):
+    """Population block beyond warmup, PBT enabled: multiple block dispatches
+    with the lax.cond selection gate toggling, under strict budgets and the
+    steady-state transfer guard. In particular this pins the two bugs the
+    population path is prone to: a PBT gate flip must not retrace (the gate
+    is a traced bool), and the dispatch's env-carried outputs must feed the
+    next call without a sharding-level cache miss (out_shardings are pinned
+    to the driver's staging sharding for exactly this reason)."""
+    run(
+        _args(tmp_path, "ppo_anakin_population", env="gym", extra=PPO_FAST)
+        + [
+            "dry_run=False",
+            "algo.total_steps=64",
+            "checkpoint.every=16",
+            "checkpoint.save_last=False",
+            "algo.population.size=3",
+            "algo.population.sweep=random",
+            "algo.population.hparams={lr: {low: 0.0001, high: 0.01, log: true}}",
+            "algo.population.pbt.enabled=True",
+            "algo.population.pbt.every_blocks=2",
+        ]
+    )
+    report = trace_hygiene.report()["ppo_anakin_pop.block"]
+    assert report["calls"] >= 2, report  # steady-state calls actually happened
+    _assert_quiet(trace_hygiene, ["ppo_anakin_pop.block"])
 
 
 def test_sac_dry_run_clean(tmp_path, trace_hygiene):

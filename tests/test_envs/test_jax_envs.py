@@ -15,7 +15,9 @@ import numpy as np
 import pytest
 
 from sheeprl_tpu.envs.jax_envs import (
+    JAX_ENV_REGISTRY,
     BatchedJaxEnv,
+    JaxAcrobot,
     JaxCartPole,
     JaxPendulum,
     is_jax_env,
@@ -26,12 +28,26 @@ TRACE_STEPS = 200
 
 
 def test_registry():
-    assert is_jax_env("CartPole-v1") and is_jax_env("Pendulum-v1")
+    assert is_jax_env("CartPole-v1") and is_jax_env("Pendulum-v1") and is_jax_env("Acrobot-v1")
     assert not is_jax_env("MsPacmanNoFrameskip-v4")
     assert isinstance(make_jax_env("CartPole-v1"), JaxCartPole)
     assert isinstance(make_jax_env("Pendulum-v1"), JaxPendulum)
+    assert isinstance(make_jax_env("Acrobot-v1"), JaxAcrobot)
     with pytest.raises(ValueError, match="No pure-JAX environment"):
         make_jax_env("Walker2d-v4")
+
+
+def test_register_jax_env_auto_discovery():
+    """Adding an env is one ``@register_jax_env`` decorated module in the
+    package: the package ``__init__`` auto-imports siblings and re-exports
+    every registered class (no hand-maintained import list)."""
+    import sheeprl_tpu.envs.jax_envs as pkg
+
+    assert set(JAX_ENV_REGISTRY) >= {"CartPole-v1", "Pendulum-v1", "Acrobot-v1"}
+    for cls in JAX_ENV_REGISTRY.values():
+        # every registered env class is re-exported from the package
+        assert getattr(pkg, cls.__name__) is cls
+        assert cls.__name__ in pkg.__all__
 
 
 def _sync_cartpole(genv, state):
@@ -125,6 +141,78 @@ def test_pendulum_single_step_parity_tight():
     genv.close()
 
 
+def _sync_acrobot(genv, state):
+    genv.unwrapped.state = np.asarray(state.physics, dtype=np.float64)
+
+
+def test_acrobot_trace_parity():
+    """Seeded trace: obs/reward/termination match gymnasium with state
+    re-sync at episode starts only. The double pendulum is chaotic, so f32
+    vs f64 drift grows exponentially along an episode — the trace is kept
+    short of the horizon where roundoff noise dominates, and the tolerance
+    is looser than the single-step check below."""
+    jenv = JaxAcrobot()
+    genv = gym.make("Acrobot-v1")
+    genv.reset(seed=0)
+    key = jax.random.PRNGKey(6)
+    key, sub = jax.random.split(key)
+    state, obs = jenv.reset(sub)
+    _sync_acrobot(genv, state)
+    rng = np.random.RandomState(6)
+    for t in range(60):
+        a = int(rng.randint(3))
+        state, jobs, jr, jdone, jinfo = jenv.step(state, jnp.asarray(a))
+        gobs, gr, gterm, gtrunc, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, atol=2e-2, rtol=2e-2)
+        assert float(jr) == float(gr)
+        assert bool(jinfo["terminated"]) == gterm
+        assert bool(jdone) == (gterm or gtrunc)
+        if jdone:
+            key, sub = jax.random.split(key)
+            state, obs = jenv.reset(sub)
+            genv.reset()
+            _sync_acrobot(genv, state)
+    genv.close()
+
+
+def test_acrobot_single_step_parity_tight():
+    """Dynamics-exact check: re-sync every step so no drift accumulates —
+    one RK4 step in float32 must match gymnasium's float64 step tightly."""
+    jenv = JaxAcrobot()
+    genv = gym.make("Acrobot-v1")
+    genv.reset(seed=0)
+    state, _ = jenv.reset(jax.random.PRNGKey(8))
+    rng = np.random.RandomState(8)
+    for t in range(50):
+        _sync_acrobot(genv, state)
+        a = int(rng.randint(3))
+        state, jobs, jr, jdone, _ = jenv.step(state, jnp.asarray(a))
+        gobs, gr, gterm, _, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, atol=1e-4, rtol=1e-4)
+        assert float(jr) == float(gr)
+        assert bool(jdone) == bool(gterm)  # no truncation inside 50 steps
+        if jdone:
+            state, _ = jenv.reset(jax.random.PRNGKey(200 + t))
+            genv.reset()
+            _sync_acrobot(genv, state)
+    genv.close()
+
+
+def test_acrobot_truncation_and_termination_reward():
+    """-1 per step, 0 on the terminating step; the 500-step limit raises
+    truncated, not terminated."""
+    jenv = JaxAcrobot(max_episode_steps=5)
+    state, _ = jenv.reset(jax.random.PRNGKey(0))
+    for t in range(5):
+        state, _, rew, done, info = jenv.step(state, jnp.asarray(1))
+        if bool(info["terminated"]):
+            assert float(rew) == 0.0
+            pytest.skip("episode terminated before the tiny time limit")
+        assert float(rew) == -1.0
+        assert bool(info["truncated"]) == (t == 4)
+        assert bool(done) == (t == 4)
+
+
 def test_truncation_flag_cartpole():
     """A time-limited CartPole sets truncated (not terminated) at the limit,
     mirroring gymnasium's TimeLimit."""
@@ -183,7 +271,7 @@ def test_batched_autoreset_matches_manual_key_stream():
 
 
 def test_batched_shapes_and_spaces():
-    for env_id, n in [("CartPole-v1", 3), ("Pendulum-v1", 2)]:
+    for env_id, n in [("CartPole-v1", 3), ("Pendulum-v1", 2), ("Acrobot-v1", 2)]:
         raw = make_jax_env(env_id)
         benv = BatchedJaxEnv(raw, n)
         assert benv.single_observation_space == raw.observation_space
