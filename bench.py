@@ -256,12 +256,16 @@ def _lane_population() -> None:
             # algo default's lr grid through deep-merge at any BENCH_POP_SIZE
             extra=[f"algo.population.size={pop_size}", "algo.population.hparams={}"],
         )
-        block = tracecheck.report().get("ppo_anakin_pop.block", {})
+        block_name = "ppo_anakin_pop.block"
     else:
         elapsed = 0.0
         for member in range(pop_size):
             elapsed += _run_cli("ppo_anakin_benchmarks", total_steps, extra=[f"seed={42 + member}"])
-        block = tracecheck.report().get("ppo_anakin.block", {})
+        block_name = "ppo_anakin.block"
+    # compile counts come from the tracecheck dump payload — the SAME
+    # artifact CI/`analysis tracecheck` read — not from scraping run logs
+    ledger = tracecheck.dump(os.environ.get("BENCH_TRACECHECK_DUMP") or None)
+    block = ledger["entries"].get(block_name, {})
     aggregate_steps = pop_size * total_steps
     # per-member rate = each member's own training rate: the vmapped members
     # share the whole wall-clock, a sequential member only its elapsed/P slice

@@ -49,40 +49,11 @@ V5E_ICI_BYTES_PER_S = 200e9
 MEASURED_STEP_S = {"dreamer_v3": 35.23e-3, "ppo": 16.0e-3 / 20}
 
 
-_TUPLE_ELEM_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3": 1, "f8e5m2": 1,
-    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
-}
-
-
-def _shape_bytes(dtype: str, dims: str) -> int:
-    n = 1
-    for d in dims.split(","):
-        if d:
-            n *= int(d)
-    return n * _DTYPE_BYTES.get(dtype, 4)
-
-
-def account_collectives(hlo_text: str) -> dict:
-    """Per-collective-op byte totals from optimized HLO text."""
-    out: dict = {}
-    for line in hlo_text.splitlines():
-        m = re.search(r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)(?:-start)?\(", line)
-        if not m:
-            continue
-        op = m.group(1)
-        rhs_sig = line.split("=", 1)[1] if "=" in line else line
-        # the result signature precedes the op name: f32[...] or a tuple
-        sig = rhs_sig[: m.start() - len(line.split("=", 1)[0]) - 1] if "=" in line else rhs_sig
-        elems = _TUPLE_ELEM_RE.findall(sig)
-        nbytes = sum(_shape_bytes(t, d) for t, d in elems if t in _DTYPE_BYTES)
-        if nbytes == 0:
-            continue
-        slot = out.setdefault(op, {"count": 0, "bytes": 0})
-        slot["count"] += 1
-        slot["bytes"] += nbytes
-    return out
+# ONE lowering/HLO-walk path shared with the graft-audit gate
+# (sheeprl_tpu/analysis/hlo.py): the bench's byte accounting and the audit's
+# collective budgets can never drift apart.
+sys.path.insert(0, _REPO_ROOT)
+from sheeprl_tpu.analysis.hlo import account_collectives  # noqa: E402
 
 
 def _analyze_body(algo: str, n_devices: int, reduce_dtype: str = "float32") -> None:

@@ -189,7 +189,17 @@ def make_train_step(agent, tx, cfg, mesh, local_batch: int, donate: bool = True,
     # The decoupled topology disables donation: the player thread still reads
     # the previous params snapshot while the trainer steps (see
     # ppo_decoupled.py), and donated buffers would be deleted under it.
-    return jax.jit(shard_train, donate_argnums=(0, 1) if donate else ())
+    # Output placements are pinned (everything here is replicated): params and
+    # opt_state feed the next call, and a compiler-chosen equivalent placement
+    # keys a fresh C++ jit-cache entry — the PR 8 silent-recompile class
+    # (checked by graft-audit AUD002 on every fed-back output).
+    from jax.sharding import NamedSharding
+
+    return jax.jit(
+        shard_train,
+        donate_argnums=(0, 1) if donate else (),
+        out_shardings=NamedSharding(mesh, P()),
+    )
 
 
 @register_algorithm()
@@ -549,3 +559,164 @@ def main(fabric, cfg: Dict[str, Any]):
 
         register_model(fabric, log_models, cfg, {"agent": params})
     logger.close()
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+def _abstract_like(tree, sharding=None):
+    """ShapeDtypeStruct twin of a pytree carrying the sharding the driver
+    stages the real values with (``sharding=None`` keeps each leaf's OWN
+    committed sharding, e.g. a DeviceReplayBuffer ring with mixed placements)
+    — the audit lowers against these, so the compiled artifact is inspected
+    WITHOUT materializing anything."""
+
+    def leaf(x):
+        sh = sharding if sharding is not None else getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x), sharding=sh)
+
+    return jax.tree.map(leaf, tree)
+
+
+def audit_setup(spec: AuditMesh):
+    """Tiny discrete-control PPO program context on the audit mesh — shared
+    by the ``ppo.*`` and ``ppo_sebulba.*`` registrations (the two paths run
+    the SAME train-step program, donation aside)."""
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent
+
+    mesh = spec.build()
+    num_envs = 2 * spec.devices
+    cfg = compose(
+        [
+            "exp=ppo",
+            f"env.num_envs={num_envs}",
+            "algo.rollout_steps=16",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+        ]
+    )
+    agent = PPOAgent(
+        actions_dim=(2,),
+        is_continuous=False,
+        cnn_keys=(),
+        mlp_keys=("state",),
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+    )
+    params = agent.init(jax.random.PRNGKey(0), {"state": jnp.zeros((num_envs, 4), jnp.float32)})
+    tx = optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=float(cfg.algo.optimizer.lr))
+    opt_state = tx.init(params)
+    B = int(cfg.algo.rollout_steps) * num_envs
+    from jax.sharding import NamedSharding
+
+    rep = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("dp"))
+    data = {
+        "state": jax.ShapeDtypeStruct((B, 4), jnp.float32, sharding=shard),
+        "actions": jax.ShapeDtypeStruct((B, 2), jnp.float32, sharding=shard),
+        "logprobs": jax.ShapeDtypeStruct((B, 1), jnp.float32, sharding=shard),
+        "values": jax.ShapeDtypeStruct((B, 1), jnp.float32, sharding=shard),
+        "returns": jax.ShapeDtypeStruct((B, 1), jnp.float32, sharding=shard),
+        "advantages": jax.ShapeDtypeStruct((B, 1), jnp.float32, sharding=shard),
+        "rewards": jax.ShapeDtypeStruct((B, 1), jnp.float32, sharding=shard),
+        "dones": jax.ShapeDtypeStruct((B, 1), jnp.uint8, sharding=shard),
+    }
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+    scalar = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+    return {
+        "cfg": cfg,
+        "agent": agent,
+        "params": params,
+        "tx": tx,
+        "opt_state": opt_state,
+        "mesh": mesh,
+        "rep": rep,
+        "B": B,
+        "num_envs": num_envs,
+        "data": data,
+        "key": key,
+        "scalar": scalar,
+    }
+
+
+def audit_train_step_program(spec: AuditMesh, name: str, donate: bool):
+    """The (shared) PPO train-step audit program; ``donate=False`` is the
+    Sebulba learner's variant (the player thread still reads old snapshots)."""
+    s = audit_setup(spec)
+    fn = make_train_step(
+        s["agent"], s["tx"], s["cfg"], s["mesh"], s["B"] // spec.devices, donate=donate, guard=True
+    )
+    return AuditProgram(
+        name=name,
+        fn=fn,
+        args=(
+            _abstract_like(s["params"], s["rep"]),
+            _abstract_like(s["opt_state"], s["rep"]),
+            s["data"],
+            s["key"],
+            s["scalar"],
+            s["scalar"],
+        ),
+        source=__name__ if name.startswith("ppo.") else "sheeprl_tpu.algos.ppo.ppo_sebulba",
+        donate_argnums=(0, 1) if donate else (),
+        feedback_outputs=(0, 1),
+        out_decl={0: P(), 1: P()},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )
+
+
+def audit_gae_program(spec: AuditMesh, name: str, num_envs: int = 4, T: int = 16):
+    """The jitted GAE scan (single-device: GAE runs where the rollout lands)."""
+    cfg_gamma, cfg_lambda = 0.99, 0.95
+    fn = jax.jit(partial(gae_op, gamma=cfg_gamma, gae_lambda=cfg_lambda))
+    shp = (T, num_envs, 1)
+    return AuditProgram(
+        name=name,
+        fn=fn,
+        args=(
+            jax.ShapeDtypeStruct(shp, jnp.float32),
+            jax.ShapeDtypeStruct(shp, jnp.float32),
+            jax.ShapeDtypeStruct(shp, jnp.uint8),
+            jax.ShapeDtypeStruct((num_envs, 1), jnp.float32),
+        ),
+        source=__name__ if name.startswith("ppo.") else "sheeprl_tpu.algos.ppo.ppo_sebulba",
+        check_input_shardings=False,
+    )
+
+
+@register_audit_programs("ppo.train_step", "ppo.gae", "ppo.rollout_step")
+def _audit_programs(spec: AuditMesh):
+    from sheeprl_tpu.algos.ppo.agent import PPOPlayer
+
+    yield audit_train_step_program(spec, "ppo.train_step", donate=True)
+    yield audit_gae_program(spec, "ppo.gae")
+
+    s = audit_setup(spec)
+    player = PPOPlayer(s["agent"], cnn_keys=(), mlp_keys=("state",))
+    yield AuditProgram(
+        name="ppo.rollout_step",
+        # the tracecheck wrapper is transparent; lower the jitted fn under it
+        fn=player._rollout_step.__wrapped__,
+        args=(
+            _abstract_like(s["params"], s["rep"]),
+            s["key"],
+            # obs arrive as HOST arrays by contract (prepare_obs) — no
+            # declared placement, and input-sharding checks stay off
+            {"state": jax.ShapeDtypeStruct((s["num_envs"], 4), jnp.float32)},
+        ),
+        source=__name__,
+        mesh=s["mesh"],
+        check_input_shardings=False,
+    )

@@ -260,7 +260,12 @@ def make_anakin_block(
     from jax.sharding import NamedSharding
 
     env_out = NamedSharding(mesh, env_sharded)
-    out_shardings = (None, None, env_out, env_out, env_out, env_out, env_out, None)
+    # params/opt_state are fed back too: pin their (replicated) placement as
+    # well, so NO fed-back output's cache key is ever compiler-chosen (the
+    # graft-audit AUD002 contract; metrics are consumed on host and stay
+    # unconstrained)
+    rep_out = NamedSharding(mesh, P())
+    out_shardings = (rep_out, rep_out, env_out, env_out, env_out, env_out, env_out, None)
     return jax.jit(shard_block, donate_argnums=(0, 1, 2, 3, 4, 5, 6), out_shardings=out_shardings)
 
 
@@ -623,3 +628,120 @@ def main(fabric, cfg: Dict[str, Any]):
 
         register_model(fabric, log_models, cfg, {"agent": params})
     logger.close()
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+def audit_anakin_setup(spec: AuditMesh, pop_size: int = 1):
+    """Tiny CartPole Anakin context on the audit mesh: agent + env avals
+    staged EXACTLY like the driver (envs sharded over ``dp`` — under the
+    member axis when ``pop_size > 1``). Shared with the population twin."""
+    import optax as _optax
+
+    from sheeprl_tpu.algos.ppo.agent import PPOAgent
+    from sheeprl_tpu.algos.ppo.ppo import _abstract_like
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from jax.sharding import NamedSharding
+
+    mesh = spec.build()
+    num_envs = 2 * spec.devices
+    cfg = compose(
+        [
+            "exp=ppo_anakin",
+            "env.id=CartPole-v1",
+            f"env.num_envs={num_envs}",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+        ]
+    )
+    agent = PPOAgent(
+        actions_dim=(2,),
+        is_continuous=False,
+        cnn_keys=(),
+        mlp_keys=("state",),
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+    )
+    params = agent.init(jax.random.PRNGKey(0), {"state": jnp.zeros((num_envs, 4), jnp.float32)})
+    tx = _optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=float(cfg.algo.optimizer.lr))
+    opt_state = tx.init(params)
+
+    jenv = make_jax_env("CartPole-v1")
+    benv = BatchedJaxEnv(jenv, num_envs)
+    rep = NamedSharding(mesh, P())
+    if pop_size > 1:
+        env_sh = NamedSharding(mesh, P(None, "dp"))
+        env_state_avals, obs_avals = jax.eval_shape(
+            jax.vmap(benv.reset), jax.random.split(jax.random.PRNGKey(1), pop_size)
+        )
+        stack = lambda x: jax.ShapeDtypeStruct((pop_size, *jnp.shape(x)), jnp.result_type(x), sharding=rep)
+        params_a = jax.tree.map(stack, params)
+        opt_a = jax.tree.map(stack, opt_state)
+        ep_ret = jax.ShapeDtypeStruct((pop_size, num_envs), jnp.float32, sharding=env_sh)
+        ep_len = jax.ShapeDtypeStruct((pop_size, num_envs), jnp.int32, sharding=env_sh)
+        env_keys = jax.ShapeDtypeStruct((pop_size, spec.devices, 2), jnp.uint32, sharding=env_sh)
+    else:
+        env_sh = NamedSharding(mesh, P("dp"))
+        env_state_avals, obs_avals = jax.eval_shape(benv.reset, jax.random.PRNGKey(1))
+        params_a = _abstract_like(params, rep)
+        opt_a = _abstract_like(opt_state, rep)
+        ep_ret = jax.ShapeDtypeStruct((num_envs,), jnp.float32, sharding=env_sh)
+        ep_len = jax.ShapeDtypeStruct((num_envs,), jnp.int32, sharding=env_sh)
+        env_keys = jax.ShapeDtypeStruct((spec.devices, 2), jnp.uint32, sharding=env_sh)
+    reshard = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=env_sh)
+    return {
+        "cfg": cfg,
+        "agent": agent,
+        "tx": tx,
+        "mesh": mesh,
+        "benv": benv,
+        "num_envs": num_envs,
+        "local_envs": num_envs // spec.devices,
+        "rep": rep,
+        "env_sh": env_sh,
+        "params": params_a,
+        "opt_state": opt_a,
+        "env_state": jax.tree.map(reshard, env_state_avals),
+        "obs": jax.tree.map(reshard, obs_avals),
+        "ep_ret": ep_ret,
+        "ep_len": ep_len,
+        "env_keys": env_keys,
+    }
+
+
+@register_audit_programs("ppo_anakin.block")
+def _audit_programs(spec: AuditMesh):
+    s = audit_anakin_setup(spec)
+    iters = 2
+    fn = make_anakin_block(
+        s["agent"], s["tx"], s["cfg"], s["mesh"], s["benv"], s["local_envs"], iters,
+        "state", ferry_episodes=True, guard=True,
+    )
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=s["rep"])
+    scalar = jax.ShapeDtypeStruct((), jnp.float32, sharding=s["rep"])
+    yield AuditProgram(
+        name="ppo_anakin.block",
+        fn=fn,
+        args=(
+            s["params"], s["opt_state"], s["env_state"], s["obs"], s["ep_ret"], s["ep_len"],
+            s["env_keys"], key, scalar, scalar,
+        ),
+        source=__name__,
+        donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+        feedback_outputs=(0, 1, 2, 3, 4, 5, 6),
+        out_decl={0: P(), 1: P(), 2: P("dp"), 3: P("dp"), 4: P("dp"), 5: P("dp"), 6: P("dp")},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )

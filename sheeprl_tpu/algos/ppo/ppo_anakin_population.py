@@ -398,7 +398,13 @@ def make_population_block(
     from jax.sharding import NamedSharding
 
     env_out = NamedSharding(mesh, env_sharded)
-    out_shardings = (None, None, env_out, env_out, env_out, env_out, env_out, None, None, None)
+    # fed-back replicated outputs (params/opt/hparams) are pinned too — no
+    # fed-back output may carry a compiler-chosen cache key (graft-audit
+    # AUD002); fitness/metrics are host-consumed and stay unconstrained
+    rep_out = NamedSharding(mesh, P())
+    out_shardings = (
+        rep_out, rep_out, env_out, env_out, env_out, env_out, env_out, rep_out, None, None,
+    )
     return jax.jit(dispatch, donate_argnums=(0, 1, 2, 3, 4, 5, 6), out_shardings=out_shardings)
 
 
@@ -822,3 +828,47 @@ def population_main(fabric, cfg: Dict[str, Any]):
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     return population_main(fabric, cfg)
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs("ppo_anakin_pop.block")
+def _audit_programs(spec: AuditMesh):
+    from sheeprl_tpu.algos.ppo.ppo_anakin import audit_anakin_setup
+
+    pop_size = 2
+    s = audit_anakin_setup(spec, pop_size=pop_size)
+    fn = make_population_block(
+        s["agent"], s["tx"], s["cfg"], s["mesh"], s["benv"], s["local_envs"], 1,
+        "state", pop_size, ferry_episodes=True, guard=True, pbt=None,
+    )
+    rep = s["rep"]
+    train_keys = jax.ShapeDtypeStruct((pop_size, 2), jnp.uint32, sharding=rep)
+    hparams = {
+        k: jax.ShapeDtypeStruct((pop_size,), jnp.float32, sharding=rep) for k in HPARAM_KEYS
+    }
+    anneal = jax.ShapeDtypeStruct((3,), jnp.float32, sharding=rep)
+    gate = jax.ShapeDtypeStruct((), jnp.bool_, sharding=rep)
+    pbt_key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+    yield AuditProgram(
+        name="ppo_anakin_pop.block",
+        fn=fn,
+        args=(
+            s["params"], s["opt_state"], s["env_state"], s["obs"], s["ep_ret"], s["ep_len"],
+            s["env_keys"], train_keys, hparams, anneal, gate, pbt_key,
+        ),
+        source=__name__,
+        donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+        feedback_outputs=(0, 1, 2, 3, 4, 5, 6, 7),
+        out_decl={
+            0: P(), 1: P(), 2: P(None, "dp"), 3: P(None, "dp"), 4: P(None, "dp"),
+            5: P(None, "dp"), 6: P(None, "dp"), 7: P(),
+        },
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )

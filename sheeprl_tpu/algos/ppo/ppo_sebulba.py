@@ -75,7 +75,46 @@ from sheeprl_tpu.utils.metric import MetricAggregator, build_aggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
-__all__ = ["main"]
+__all__ = ["main", "make_act_step", "make_traj_step"]
+
+
+def make_act_step(agent, is_continuous: bool, n_heads: int):
+    """Actor-side per-step program: forward + sample ONLY, returning the env
+    action. Per-step keys are pre-split on the host once per rollout, so the
+    graph carries no key state — what makes a 1-env actor thread cheap enough
+    to pipeline. Module-level so the graft-audit registry lowers the SAME
+    program the actor threads dispatch."""
+
+    def _act(p, key, obs):
+        actor_outs, _ = agent.apply(p, obs)
+        dists = _dists(actor_outs, is_continuous)
+        if is_continuous:
+            return dists[0].sample(key)  # (B, dim): the env action
+        if n_heads == 1:
+            return dists[0].sample(key).argmax(-1)[..., None]  # (B, 1)
+        keys = jax.random.split(key, n_heads)
+        return jnp.stack([d.sample(k).argmax(-1) for d, k in zip(dists, keys)], axis=-1)
+
+    return _act
+
+
+def make_traj_step(agent, cnn_keys, mlp_keys, is_continuous: bool, n_heads: int, head_split):
+    """Whole-trajectory logprob/value recomputation under ONE params snapshot
+    (identical math to the train minibatch's normalization) — ~T× less
+    per-step graph execution than the host player's fused 5-output step."""
+
+    def _traj_outs(p, obs_flat, actions_flat):
+        # normalization mirrors make_local_train's minibatch_step exactly
+        obs = {k: obs_flat[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
+        obs.update({k: obs_flat[k].astype(jnp.float32) for k in mlp_keys})
+        if is_continuous or n_heads == 1:
+            actions = [actions_flat]
+        else:
+            actions = jnp.split(actions_flat, head_split, axis=-1)
+        logprob, _entropy, values = forward_with_actions(agent, p, obs, actions)
+        return logprob, values
+
+    return _traj_outs
 
 
 @register_algorithm(decoupled=True)
@@ -261,43 +300,23 @@ def main(fabric, cfg: Dict[str, Any]):
     # -- actor-side jitted programs ------------------------------------------
     # The env feedback loop only needs the ACTION each step; logprobs and
     # values are pure functions of (params, obs, action) and are recomputed
-    # for the WHOLE trajectory in one batched forward at rollout end —
-    # identical math (same snapshot, same normalization as the train
-    # minibatch), ~T× less per-step graph execution than the host player's
-    # fused 5-output step. This is what makes a 1-env actor thread cheap
-    # enough to pipeline.
-    def _act(p, key, obs):
-        # per-step keys are pre-split on the host once per rollout, so the
-        # graph is just forward + sample — no in-graph key carry
-        actor_outs, _ = agent.apply(p, obs)
-        dists = _dists(actor_outs, is_continuous)
-        if is_continuous:
-            return dists[0].sample(key)  # (B, dim): the env action
-        if n_heads == 1:
-            return dists[0].sample(key).argmax(-1)[..., None]  # (B, 1)
-        keys = jax.random.split(key, n_heads)
-        return jnp.stack([d.sample(k).argmax(-1) for d, k in zip(dists, keys)], axis=-1)
-
-    # actor-side entry points keep host-array inputs by contract (obs via
+    # for the WHOLE trajectory in one batched forward at rollout end (see
+    # make_act_step / make_traj_step — module-level so graft-audit lowers the
+    # same programs the actor threads dispatch).
+    # Actor-side entry points keep host-array inputs by contract (obs via
     # prepare_obs, host-pre-split keys): transfer_guard=False. Warmup covers
     # the first call of every concurrently-starting actor thread.
     act_fn = tracecheck.instrument(
-        jax.jit(_act), name="ppo_sebulba.act", warmup=num_actors + 1, transfer_guard=False
+        jax.jit(make_act_step(agent, is_continuous, n_heads)),
+        name="ppo_sebulba.act", warmup=num_actors + 1, transfer_guard=False,
     )
-
-    def _traj_outs(p, obs_flat, actions_flat):
-        # normalization mirrors make_local_train's minibatch_step exactly
-        obs = {k: obs_flat[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
-        obs.update({k: obs_flat[k].astype(jnp.float32) for k in cfg.algo.mlp_keys.encoder})
-        if is_continuous or n_heads == 1:
-            actions = [actions_flat]
-        else:
-            actions = jnp.split(actions_flat, head_split, axis=-1)
-        logprob, _entropy, values = forward_with_actions(agent, p, obs, actions)
-        return logprob, values
-
     traj_fn = tracecheck.instrument(
-        jax.jit(_traj_outs), name="ppo_sebulba.traj", warmup=num_actors + 1, transfer_guard=False
+        jax.jit(
+            make_traj_step(
+                agent, cnn_keys, cfg.algo.mlp_keys.encoder, is_continuous, n_heads, head_split
+            )
+        ),
+        name="ppo_sebulba.traj", warmup=num_actors + 1, transfer_guard=False,
     )
     eye_rows = [np.eye(int(d), dtype=np.float32) for d in actions_dim] if not is_continuous else None
 
@@ -599,3 +618,56 @@ def main(fabric, cfg: Dict[str, Any]):
 
         register_model(fabric, log_models, cfg, {"agent": params_live})
     logger.close()
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs(
+    "ppo_sebulba.train_step", "ppo_sebulba.gae", "ppo_sebulba.act", "ppo_sebulba.traj"
+)
+def _audit_programs(spec: AuditMesh):
+    from sheeprl_tpu.algos.ppo.ppo import (
+        _abstract_like,
+        audit_gae_program,
+        audit_setup,
+        audit_train_step_program,
+    )
+
+    # the learner runs the SAME fused train program as host-loop PPO, with
+    # donation off (actors hold published params across updates)
+    yield audit_train_step_program(spec, "ppo_sebulba.train_step", donate=False)
+    yield audit_gae_program(spec, "ppo_sebulba.gae")
+
+    s = audit_setup(spec)
+    num_envs = s["num_envs"]
+    act_fn = jax.jit(make_act_step(s["agent"], is_continuous=False, n_heads=1))
+    traj_fn = jax.jit(make_traj_step(s["agent"], (), ("state",), False, 1, []))
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    obs = {"state": jax.ShapeDtypeStruct((num_envs, 4), jnp.float32)}
+    T = int(s["cfg"].algo.rollout_steps)
+    # actor-side programs take HOST inputs by contract — no placement decls
+    yield AuditProgram(
+        name="ppo_sebulba.act",
+        fn=act_fn,
+        args=(_abstract_like(s["params"], s["rep"]), key, obs),
+        source=__name__,
+        mesh=s["mesh"],
+        check_input_shardings=False,
+    )
+    yield AuditProgram(
+        name="ppo_sebulba.traj",
+        fn=traj_fn,
+        args=(
+            _abstract_like(s["params"], s["rep"]),
+            {"state": jax.ShapeDtypeStruct((T * num_envs, 4), jnp.float32)},
+            jax.ShapeDtypeStruct((T * num_envs, 2), jnp.float32),
+        ),
+        source=__name__,
+        mesh=s["mesh"],
+        check_input_shardings=False,
+    )

@@ -163,7 +163,15 @@ def make_train_step(agent: SACAgent, actor_tx, critic_tx, alpha_tx, cfg, mesh, d
         check_vma=False,
     )
     # See ppo.make_train_step: the decoupled player still reads old snapshots.
-    return jax.jit(shard_train, donate_argnums=(0, 1, 2, 3) if donate else ())
+    # Output placements pinned (all replicated) — fed-back train state must
+    # never carry a compiler-chosen cache key (graft-audit AUD002 / PR 8).
+    from jax.sharding import NamedSharding
+
+    return jax.jit(
+        shard_train,
+        donate_argnums=(0, 1, 2, 3) if donate else (),
+        out_shardings=NamedSharding(mesh, P()),
+    )
 
 
 def make_burst_train_step(
@@ -550,7 +558,15 @@ def make_resident_train_step(
             new_state = {"storage": storage, "pos": new_pos, "valid": new_vld, "key": state_key}
             return params, aopt, copt, lopt, new_state, qf, al, ll, skipped
 
-        return jax.jit(packed_pre, donate_argnums=(0, 1, 2, 3, 4) if donate else (4,))
+        # Everything here is replicated (this branch requires an unsharded
+        # ring); pin the fed-back outputs' placements — graft-audit AUD002.
+        from jax.sharding import NamedSharding
+
+        return jax.jit(
+            packed_pre,
+            donate_argnums=(0, 1, 2, 3, 4) if donate else (4,),
+            out_shardings=NamedSharding(mesh, P()),
+        )
 
     def local_train(params, aopt, copt, lopt, storage, pos, vld, state_key, tree, max_p,
                     staged, count, flags, valid, beta):
@@ -617,7 +633,27 @@ def make_resident_train_step(
             new_state["max_p"] = max_p
         return params, aopt, copt, lopt, new_state, qf, al, ll, skipped
 
-    return jax.jit(packed, donate_argnums=(0, 1, 2, 3, 4) if donate else (4,))
+    # Pin every fed-back output's placement — the env-sharded ring storage is
+    # EXACTLY the PR 8 shape (donated, sharded, fed back every step): left to
+    # inference, jit may canonicalize it to an equivalent placement with a
+    # different C++ jit-cache key and silently recompile on the next dispatch
+    # (graft-lint GL008 / graft-audit AUD002).
+    from jax.sharding import NamedSharding
+
+    rep_out = NamedSharding(mesh, P())
+    state_out: Dict[str, Any] = {
+        "storage": NamedSharding(mesh, storage_spec),
+        "pos": rep_out,
+        "valid": rep_out,
+        "key": rep_out,
+    }
+    if prioritized:
+        state_out.update(tree=rep_out, max_p=rep_out)
+    return jax.jit(
+        packed,
+        donate_argnums=(0, 1, 2, 3, 4) if donate else (4,),
+        out_shardings=(rep_out, rep_out, rep_out, rep_out, state_out) + (rep_out,) * 4,
+    )
 
 
 @register_algorithm()
@@ -1272,3 +1308,153 @@ def main(fabric, cfg: Dict[str, Any]):
 
         register_model(fabric, log_models, cfg, {"agent": params})
     logger.close()
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+def audit_sac_setup(spec: AuditMesh, stage_rows: int = 1, grad_max: int = 2):
+    """Tiny continuous-control SAC context on the audit mesh (shared with the
+    ``sac_sebulba.*`` registrations): agent + optimizers + an env-sharded
+    DeviceReplayBuffer, all with the driver's staging shardings."""
+    from sheeprl_tpu.algos.ppo.ppo import _abstract_like
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.replay import DeviceReplayBuffer
+
+    num_envs = 2 * spec.devices
+    cfg = compose(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"env.num_envs={num_envs}",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=8",
+        ]
+    )
+    fabric = Fabric(devices=spec.devices, accelerator="cpu")
+    obs_dim, act_dim = 4, 2
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (obs_dim,), np.float32)})
+    act_space = gym.spaces.Box(-1.0, 1.0, (act_dim,), np.float32)
+    agent, params, player = build_agent(fabric, cfg, obs_space, act_space, None)
+    actor_tx = build_optimizer(cfg.algo.actor.optimizer)
+    critic_tx = build_optimizer(cfg.algo.critic.optimizer)
+    alpha_tx = build_optimizer(cfg.algo.alpha.optimizer)
+    aopt = actor_tx.init(params["actor"])
+    copt = critic_tx.init(params["critic"])
+    lopt = alpha_tx.init(params["log_alpha"])
+    resident_specs = {
+        "observations": ((obs_dim,), jnp.float32),
+        "next_observations": ((obs_dim,), jnp.float32),
+        "actions": ((act_dim,), jnp.float32),
+        "rewards": ((1,), jnp.float32),
+        "terminated": ((1,), jnp.float32),
+    }
+    drb = DeviceReplayBuffer(
+        fabric,
+        resident_specs,
+        16,
+        num_envs,
+        shard_envs=True,
+        stage_rows=stage_rows,
+        extra_spec=[
+            ("__flags__", (grad_max,), np.float32),
+            ("__valid__", (grad_max,), np.float32),
+            ("__beta__", (), np.float32),
+        ],
+        seed=29,
+    )
+    rep = fabric.replicated
+    return {
+        "cfg": cfg,
+        "fabric": fabric,
+        "mesh": fabric.mesh,
+        "agent": agent,
+        "player": player,
+        "params": _abstract_like(params, rep),
+        "aopt": _abstract_like(aopt, rep),
+        "copt": _abstract_like(copt, rep),
+        "lopt": _abstract_like(lopt, rep),
+        "txs": (actor_tx, critic_tx, alpha_tx),
+        "drb": drb,
+        "grad_max": grad_max,
+        "num_envs": num_envs,
+        "obs_dim": obs_dim,
+        "act_dim": act_dim,
+        "rep": rep,
+        # ring state avals keep each leaf's OWN committed sharding (storage
+        # env-sharded, heads/key replicated)
+        "rb_state": _abstract_like(drb.state),
+        "key": jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+        "scalar": jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
+    }
+
+
+@register_audit_programs("sac.train_step", "sac.resident_step", "sac.rollout_step")
+def _audit_programs(spec: AuditMesh):
+    s = audit_sac_setup(spec)
+    actor_tx, critic_tx, alpha_tx = s["txs"]
+    G, B = 2, 8 * spec.devices
+    data_sh = NamedSharding(s["mesh"], P(None, "dp"))
+    data = {
+        "observations": jax.ShapeDtypeStruct((G, B, s["obs_dim"]), jnp.float32, sharding=data_sh),
+        "next_observations": jax.ShapeDtypeStruct((G, B, s["obs_dim"]), jnp.float32, sharding=data_sh),
+        "actions": jax.ShapeDtypeStruct((G, B, s["act_dim"]), jnp.float32, sharding=data_sh),
+        "rewards": jax.ShapeDtypeStruct((G, B, 1), jnp.float32, sharding=data_sh),
+        "terminated": jax.ShapeDtypeStruct((G, B, 1), jnp.float32, sharding=data_sh),
+    }
+    train_fn = make_train_step(
+        s["agent"], actor_tx, critic_tx, alpha_tx, s["cfg"], s["mesh"], donate=True, guard=True
+    )
+    yield AuditProgram(
+        name="sac.train_step",
+        fn=train_fn,
+        args=(s["params"], s["aopt"], s["copt"], s["lopt"], data, s["key"], s["scalar"]),
+        source=__name__,
+        donate_argnums=(0, 1, 2, 3),
+        feedback_outputs=(0, 1, 2, 3),
+        out_decl={0: P(), 1: P(), 2: P(), 3: P()},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )
+
+    resident_fn = make_resident_train_step(
+        s["agent"], actor_tx, critic_tx, alpha_tx, s["cfg"], s["mesh"], s["drb"], s["grad_max"],
+        guard=True, donate=True, append=True,
+    )
+    blob = jax.ShapeDtypeStruct((s["drb"].layout.nbytes,), jnp.uint8, sharding=s["rep"])
+    yield AuditProgram(
+        name="sac.resident_step",
+        fn=resident_fn,
+        args=(s["params"], s["aopt"], s["copt"], s["lopt"], s["rb_state"], blob),
+        source=__name__,
+        donate_argnums=(0, 1, 2, 3, 4),
+        # the ring state (output 4) carries MIXED placements (env-sharded
+        # storage + replicated heads): the pin check covers it, the uniform
+        # out_decl placement check covers the train state
+        feedback_outputs=(0, 1, 2, 3, 4),
+        out_decl={0: P(), 1: P(), 2: P(), 3: P()},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )
+
+    yield AuditProgram(
+        name="sac.rollout_step",
+        fn=s["player"]._sample.__wrapped__,
+        args=(
+            # the player samples on the ACTOR subtree of the params snapshot;
+            # obs arrive as HOST arrays by contract (prepare_obs)
+            s["params"]["actor"],
+            jax.ShapeDtypeStruct((s["num_envs"], s["obs_dim"]), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        ),
+        source=__name__,
+        mesh=s["mesh"],
+        check_input_shardings=False,
+    )

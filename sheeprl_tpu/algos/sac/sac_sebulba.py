@@ -83,7 +83,18 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
-__all__ = ["main"]
+__all__ = ["main", "make_act_step"]
+
+
+def make_act_step(agent):
+    """Actor-side per-block program: forward + squashed-Gaussian sample ONLY,
+    on the published actor subtree — module-level so the graft-audit registry
+    lowers the SAME program the actor threads dispatch."""
+
+    def _act(actor_params, obs, key):
+        return agent.sample_action(actor_params, obs, key)[0]
+
+    return _act
 
 
 @register_algorithm(decoupled=True)
@@ -324,12 +335,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # -- actor-side jitted program -------------------------------------------
     # forward + squashed-Gaussian sample ONLY; per-step keys are pre-split on
-    # the host once per block, so the graph carries no key state
-    def _act(actor_params, obs, key):
-        return agent.sample_action(actor_params, obs, key)[0]
-
+    # the host once per block, so the graph carries no key state (module-level
+    # builder so graft-audit lowers the same program the actors dispatch)
     act_fn = tracecheck.instrument(
-        jax.jit(_act), name="sac_sebulba.act", warmup=num_actors + 1, transfer_guard=False
+        jax.jit(make_act_step(agent)), name="sac_sebulba.act",
+        warmup=num_actors + 1, transfer_guard=False,
     )
 
     def actor_fn(aid: int, envs) -> None:
@@ -593,3 +603,72 @@ def main(fabric, cfg: Dict[str, Any]):
 
         register_model(fabric, log_models, cfg, {"agent": params_live})
     logger.close()
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs("sac_sebulba.train_step", "sac_sebulba.act", "sac_sebulba.append")
+def _audit_programs(spec: AuditMesh):
+    from sheeprl_tpu.algos.sac.sac import audit_sac_setup
+
+    block = 4
+    s = audit_sac_setup(spec, stage_rows=block)
+    actor_tx, critic_tx, alpha_tx = s["txs"]
+    drb = s["drb"]
+
+    # learner: append-free train variant over the device-resident ring
+    # (donate=False on the train state — ParamServer publishes references the
+    # actors keep pulling; the ring state is still donated in place)
+    train_fn = make_resident_train_step(
+        s["agent"], actor_tx, critic_tx, alpha_tx, s["cfg"], s["mesh"], drb, s["grad_max"],
+        guard=True, donate=False, append=False,
+    )
+    ctl_blob = jax.ShapeDtypeStruct((drb.ctl_layout.nbytes,), jnp.uint8, sharding=s["rep"])
+    yield AuditProgram(
+        name="sac_sebulba.train_step",
+        fn=train_fn,
+        args=(s["params"], s["aopt"], s["copt"], s["lopt"], s["rb_state"], ctl_blob),
+        source=__name__,
+        donate_argnums=(4,),
+        feedback_outputs=(0, 1, 2, 3, 4),
+        out_decl={0: P(), 1: P(), 2: P(), 3: P()},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )
+
+    # ring writer: the donated multi-row append scatter
+    append_fn = drb.make_append_step()
+    append_blob = jax.ShapeDtypeStruct((drb.append_layout.nbytes,), jnp.uint8, sharding=s["rep"])
+    yield AuditProgram(
+        name="sac_sebulba.append",
+        fn=append_fn,
+        args=(s["rb_state"], append_blob),
+        source=__name__,
+        donate_argnums=(0,),
+        feedback_outputs=(0,),
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )
+
+    # actor: squashed-Gaussian sample on the published actor subtree (host
+    # obs/keys by contract)
+    act_fn = jax.jit(make_act_step(s["agent"]))
+    yield AuditProgram(
+        name="sac_sebulba.act",
+        fn=act_fn,
+        args=(
+            s["params"]["actor"],
+            jax.ShapeDtypeStruct((s["num_envs"], s["obs_dim"]), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        ),
+        source=__name__,
+        mesh=s["mesh"],
+        check_input_shardings=False,
+    )

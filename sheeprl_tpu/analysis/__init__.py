@@ -14,13 +14,23 @@ correctness):
     disable=GLxxx`` suppressions and a checked-in baseline so pre-existing
     findings don't block CI. Run it as ``python -m sheeprl_tpu.analysis``.
 
+:mod:`sheeprl_tpu.analysis.audit` (+ ``programs``, ``budgets``, ``hlo``)
+    The compiled-program tier: every registered hot-path program AOT-lowered
+    with abstract inputs on a configurable mesh (no execution) and checked
+    against its declared contract — donation actually aliased, compiled
+    shardings matching the registration (incl. the PR 8 canonicalization
+    class on fed-back outputs), dtype policy, baked-constant ceilings, and
+    the checked-in per-program budget manifest (rules AUD001-AUD005). Run it
+    as ``python -m sheeprl_tpu.analysis audit``.
+
 :mod:`sheeprl_tpu.analysis.tracecheck`
-    Runtime sentinel for what the static pass can't see: registered jit entry
-    points record compilations per (function, abstract signature) and fail
-    when a hot path retraces past its budget after warmup; post-warmup calls
-    can additionally run under ``jax.transfer_guard("disallow")`` so an
+    Runtime sentinel for what the static passes can't see: registered jit
+    entry points record compilations per (function, abstract signature) and
+    fail when a hot path retraces past its budget after warmup; post-warmup
+    calls can additionally run under ``jax.transfer_guard("disallow")`` so an
     accidental implicit host->device transfer (a numpy leaf sneaking into a
-    fused step) is an error, not a silent sync. The Podracer line (Sebulba /
+    fused step) is an error, not a silent sync. The ledger exports as a JSON
+    artifact (``SHEEPRL_TPU_TRACECHECK_DUMP``). The Podracer line (Sebulba /
     Anakin, arXiv:2104.06272) attributes its throughput to exactly these
     invariants holding in the steady state.
 """
@@ -36,4 +46,6 @@ __all__ = [
     "RetraceError",
     "TraceCheck",
     "tracecheck",
+    # audit tier (imported lazily — pulls jax + the algo registry):
+    # sheeprl_tpu.analysis.audit / .programs / .budgets / .hlo
 ]

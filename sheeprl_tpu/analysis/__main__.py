@@ -1,15 +1,22 @@
-"""``python -m sheeprl_tpu.analysis`` — the graft-lint CLI.
+"""``python -m sheeprl_tpu.analysis`` — the graft-lint / graft-audit CLI.
 
-Exit-code contract (CI relies on it):
+Three subcommands, one exit-code contract (CI relies on it):
 
-- ``0`` — no findings after baseline/suppression filtering (clean tree);
-- ``1`` — at least one new finding;
-- ``2`` — usage or internal error (unknown rule, unreadable baseline, ...).
+- ``lint`` (the default — bare paths keep working): AST rules GL001-GL008;
+- ``audit``: AOT-lower every registered hot-path program on a virtual mesh
+  and check donation aliasing, sharding declarations, dtype policy, baked
+  constants, and the checked-in budget manifest (rules AUD001-AUD005);
+- ``tracecheck``: validate a runtime trace-event dump
+  (``SHEEPRL_TPU_TRACECHECK_DUMP``) — post-warmup retraces are findings.
 
-Formats: ``text`` (one finding per line, summary to stderr), ``json``
-(machine-readable report incl. the rule catalog), ``github`` (workflow
-annotations — ``::error file=...,line=...`` — so findings land inline on the
-PR diff).
+Exit codes: ``0`` clean, ``1`` at least one finding, ``2`` usage/internal
+error. Formats: ``text``, ``json``, ``github`` (workflow annotations that
+land inline on the PR diff).
+
+``audit`` re-executes itself in a worker subprocess with
+``JAX_PLATFORMS=cpu`` and ``--xla_force_host_platform_device_count`` set
+BEFORE JAX initializes — the mesh width is a process-boot property, and the
+audit must run on a chip-less CPU sandbox.
 """
 
 from __future__ import annotations
@@ -17,8 +24,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+import subprocess
 import sys
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from sheeprl_tpu.analysis.lint import (
     RULES,
@@ -84,10 +93,10 @@ def _emit_json(findings: List[Finding], baselined: int, out) -> None:
     out.write("\n")
 
 
-def main(argv: Optional[List[str]] = None) -> int:
+def lint_main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sheeprl_tpu.analysis",
-        description="graft-lint: JAX/TPU-aware static analysis (rules GL001-GL007).",
+        description="graft-lint: JAX/TPU-aware static analysis (rules GL001-GL008).",
     )
     parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/dirs to analyze")
     parser.add_argument("--format", choices=("text", "json", "github"), default="text")
@@ -158,6 +167,313 @@ def main(argv: Optional[List[str]] = None) -> int:
     summary = f"graft-lint: {len(findings)} finding(s)" + (f", {baselined} baselined" if baselined else "")
     print(summary, file=sys.stderr)
     return 1 if findings else 0
+
+
+# --------------------------------------------------------------------------- #
+# audit subcommand
+# --------------------------------------------------------------------------- #
+
+
+def _parse_mesh(spec: str):
+    from sheeprl_tpu.analysis.programs import AuditMesh
+
+    m = re.fullmatch(r"([a-z_][a-z0-9_]*)=(\d+)", spec.strip())
+    if not m:
+        raise SystemExit2(f"--mesh must look like 'dp=2', got {spec!r}")
+    return AuditMesh(devices=int(m.group(2)), axes=(m.group(1),))
+
+
+def _source_to_path(source: str, fallback: str) -> str:
+    return source.replace(".", "/") + ".py" if source else fallback
+
+
+def _audit_emit_github(findings, budgets_path: str, out) -> None:
+    for f in findings:
+        msg = f.message.replace("%", "%25").replace("\r", "").replace("\n", "%0A")
+        anchor = budgets_path if f.rule == "AUD005" else _source_to_path(f.source, budgets_path)
+        print(
+            f"::error file={anchor},line=1,title=graft-audit {f.rule}::[{f.program}] {msg}",
+            file=out,
+        )
+
+
+def _audit_worker(args) -> int:
+    """Runs with the virtual mesh env already set by the parent: lower every
+    selected program, judge budgets, print ONE json document."""
+    import jax
+
+    # the sandbox's sitecustomize can register an accelerator PJRT plugin at
+    # interpreter start; force CPU via the config API before backend init
+    # (same pattern as __graft_entry__ / collective_analysis workers)
+    jax.config.update("jax_platforms", "cpu")
+    # The persistent compilation cache is DISABLED for audits: an executable
+    # loaded from the cache reports zeroed memory_analysis() (alias/temp
+    # sizes) — the donation check and every budget measurement would read
+    # garbage on warm runs. Cold compiles keep the measurements reproducible.
+    jax.config.update("jax_enable_compilation_cache", False)
+
+    from sheeprl_tpu.analysis.audit import run_audit
+    from sheeprl_tpu.analysis.budgets import load_manifest
+    from sheeprl_tpu.parallel.comm import set_grad_reduce_dtype
+
+    mesh = _parse_mesh(args.mesh)
+    # the wire dtype the drivers resolve on this mesh (grad_reduce_dtype=auto)
+    set_grad_reduce_dtype(mesh.wire_dtype, fresh_run=True)
+
+    select = [s.strip() for s in args.select.split(",")] if args.select else None
+    manifest = None
+    missing_manifest = False
+    if not args.no_budgets and not args.write_budgets:
+        if os.path.exists(args.budgets):
+            manifest = load_manifest(args.budgets)
+            if args.tolerance is not None:
+                manifest["tolerance"] = float(args.tolerance)
+        else:
+            missing_manifest = True
+    findings, measurements = run_audit(mesh, select=select, manifest=manifest)
+    if missing_manifest:
+        from sheeprl_tpu.analysis.audit import AuditFinding
+
+        findings.append(
+            AuditFinding(
+                "AUD005",
+                "<manifest>",
+                f"budget manifest {args.budgets} not found — generate it with --write-budgets "
+                "(every registered hot path must carry checked-in budgets)",
+            )
+        )
+    json.dump(
+        {
+            "mesh": mesh.spec,
+            "findings": [
+                {"rule": f.rule, "program": f.program, "message": f.message, "source": f.source}
+                for f in findings
+            ],
+            "measurements": measurements,
+            "budgets_checked": manifest is not None,
+        },
+        sys.stdout,
+    )
+    sys.stdout.write("\n")
+    return 0
+
+
+def audit_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis audit",
+        description="graft-audit: compiled-program static analysis (rules AUD001-AUD005).",
+    )
+    parser.add_argument("--mesh", default="dp=2", help="virtual mesh, e.g. dp=2 (default) or dp=8")
+    parser.add_argument("--select", help="comma-separated program names/globs (default: all registered)")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
+    parser.add_argument(
+        "--budgets",
+        default=None,
+        help="budget manifest path (default: .graft-audit-budgets.json, searched upward from cwd)",
+    )
+    parser.add_argument("--no-budgets", action="store_true", help="skip the AUD005 manifest check")
+    parser.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        help="override the manifest's budget tolerance (e.g. 0.10 for the CI drift lane)",
+    )
+    parser.add_argument(
+        "--write-budgets",
+        action="store_true",
+        help="measure every selected program and (re)write the budget manifest, exit 0",
+    )
+    parser.add_argument("--list-programs", action="store_true", help="print the registered program inventory")
+    parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    from sheeprl_tpu.analysis.budgets import (
+        DEFAULT_BUDGETS_PATH,
+        manifest_from_measurements,
+        write_manifest,
+    )
+
+    if args.budgets is None:
+        # search upward so the CLI works from any checkout subdirectory
+        d = os.getcwd()
+        args.budgets = DEFAULT_BUDGETS_PATH
+        while True:
+            cand = os.path.join(d, DEFAULT_BUDGETS_PATH)
+            if os.path.exists(cand):
+                args.budgets = cand
+                break
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+
+    try:
+        mesh = _parse_mesh(args.mesh)
+    except SystemExit2 as e:
+        print(f"graft-audit: {e}", file=sys.stderr)
+        return 2
+
+    if args.worker:
+        return _audit_worker(args)
+
+    if args.list_programs:
+        from sheeprl_tpu.analysis.programs import registered_names
+
+        for name in registered_names():
+            print(name)
+        return 0
+
+    # Re-exec in a worker with the virtual device width fixed pre-JAX-init.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={mesh.devices}").strip()
+    worker_argv = [sys.executable, "-m", "sheeprl_tpu.analysis", "audit", "--worker", "--mesh", args.mesh]
+    if args.select:
+        worker_argv += ["--select", args.select]
+    worker_argv += ["--budgets", args.budgets]
+    if args.tolerance is not None:
+        worker_argv += ["--tolerance", str(args.tolerance)]
+    if args.no_budgets or args.write_budgets:
+        worker_argv += ["--no-budgets"]
+    proc = subprocess.run(worker_argv, env=env, capture_output=True, text=True, timeout=3600)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        print(f"graft-audit: worker failed (rc={proc.returncode})", file=sys.stderr)
+        return 2
+    try:
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError) as e:
+        sys.stderr.write(proc.stderr[-2000:])
+        print(f"graft-audit: unreadable worker output: {e}", file=sys.stderr)
+        return 2
+
+    from sheeprl_tpu.analysis.audit import AuditFinding
+
+    findings = [AuditFinding(f["rule"], f["program"], f["message"], f.get("source", "")) for f in payload["findings"]]
+    measurements: Dict[str, Dict[str, Any]] = payload["measurements"]
+
+    if args.select and not measurements and not findings:
+        print(
+            f"graft-audit: --select {args.select!r} matched no registered program "
+            "(see --list-programs) — refusing to report an empty selection as clean",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_budgets:
+        if findings:
+            for f in findings:
+                print(f.render(), file=sys.stderr)
+            print(
+                f"graft-audit: refusing to write budgets over {len(findings)} live finding(s) — "
+                "fix the programs first",
+                file=sys.stderr,
+            )
+            return 1
+        manifest = manifest_from_measurements(measurements, payload["mesh"])
+        if args.select and os.path.exists(args.budgets):
+            # a SELECTED re-baseline merges into the existing manifest — a
+            # wholesale rewrite would delete every unselected program's row
+            from sheeprl_tpu.analysis.budgets import load_manifest
+
+            try:
+                existing = load_manifest(args.budgets)
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                print(f"graft-audit: unreadable manifest {args.budgets}: {e}", file=sys.stderr)
+                return 2
+            existing["programs"].update(manifest["programs"])
+            manifest = existing
+        try:
+            write_manifest(args.budgets, manifest)
+        except OSError as e:
+            print(f"graft-audit: cannot write {args.budgets}: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"graft-audit: wrote budgets for {len(measurements)} program(s) to {args.budgets}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.format == "json":
+        from sheeprl_tpu.analysis.audit import AUDIT_RULES
+
+        json.dump(
+            {
+                "tool": "graft-audit",
+                "mesh": payload["mesh"],
+                "rules": AUDIT_RULES,
+                "budgets_checked": payload["budgets_checked"],
+                "findings": [
+                    {"rule": f.rule, "program": f.program, "message": f.message, "source": f.source}
+                    for f in findings
+                ],
+                "measurements": measurements,
+            },
+            sys.stdout,
+            indent=2,
+        )
+        sys.stdout.write("\n")
+    elif args.format == "github":
+        _audit_emit_github(findings, os.path.relpath(args.budgets), sys.stdout)
+    else:
+        for f in findings:
+            print(f.render())
+    print(
+        f"graft-audit: {len(findings)} finding(s) over {len(measurements)} program(s) "
+        f"(mesh {payload['mesh']}, budgets {'checked' if payload['budgets_checked'] else 'skipped'})",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+# --------------------------------------------------------------------------- #
+# tracecheck-dump subcommand
+# --------------------------------------------------------------------------- #
+
+
+def tracecheck_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis tracecheck",
+        description=(
+            "Validate a tracecheck dump artifact (SHEEPRL_TPU_TRACECHECK_DUMP): "
+            "post-warmup retraces on any registered hot path are findings."
+        ),
+    )
+    parser.add_argument("dump", help="path to the JSON dump a run exported")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.dump, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        entries = payload["entries"]
+    except (OSError, json.JSONDecodeError, KeyError) as e:
+        print(f"tracecheck: unreadable dump {args.dump}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    for name, rep in sorted(entries.items()):
+        retraces = int(rep.get("post_warmup_compiles", 0))
+        line = (
+            f"{name}: {rep.get('calls', 0)} calls, {rep.get('compiles', 0)} compiles, "
+            f"{retraces} post-warmup"
+        )
+        if retraces > int(rep.get("budget", 0)):
+            print(f"RETRACE {line}")
+            bad += 1
+        else:
+            print(f"ok      {line}")
+    print(f"tracecheck: {bad} hot path(s) over budget", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "audit":
+        return audit_main(argv[1:])
+    if argv and argv[0] == "tracecheck":
+        return tracecheck_main(argv[1:])
+    if argv and argv[0] == "lint":
+        argv = argv[1:]
+    return lint_main(argv)
 
 
 if __name__ == "__main__":

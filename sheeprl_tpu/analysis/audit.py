@@ -1,0 +1,390 @@
+"""graft-audit: static analysis of COMPILED programs.
+
+graft-lint (AST) and tracecheck (runtime) bracket the Python layer; this pass
+audits what sits below both — the lowered/compiled artifact every registered
+hot path turns into. Each program in the audit registry
+(:mod:`sheeprl_tpu.analysis.programs`) is AOT-lowered with abstract inputs on
+a configurable mesh (no execution, works on the CPU sandbox) and held to its
+declared contract:
+
+AUD001  Donation not honored: a ``donate_argnums`` buffer XLA did not alias
+        into an output. Silent today — the program runs, with the donated
+        tree resident TWICE (2x HBM on TPU for params+optimizer trees).
+AUD002  Sharding drift: a compiled input/output placement that does not
+        normalize to the registered declaration — or a FED-BACK output whose
+        placement the compiler chose (``allow_spmd_sharding_propagation_to_
+        output``), the PR 8 class: an equivalent placement with a different
+        C++ jit-cache key, recompiling the whole program on call 2 with no
+        tracing-cache miss to warn anyone.
+AUD003  Dtype leak: f64 anywhere in the lowered program, or f32 collective
+        traffic beyond the slack budget under a declared bf16 wire policy
+        (read from StableHLO — XLA:CPU promotes bf16 host collectives back
+        to f32 during optimization, so the optimized text lies about wires).
+AUD004  Baked-in constant over budget: a weight folded into the executable
+        breaks graft-serve hot swap and bloats every program copy.
+AUD005  Budget breach: peak-HBM estimate / per-axis collective bytes /
+        executable size beyond the checked-in manifest's tolerance, a
+        registered program with no manifest entry, or a stale manifest row.
+
+``python -m sheeprl_tpu.analysis audit`` runs the registry end to end with
+the same 0/1/2 exit contract and output formats as graft-lint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import warnings as _warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from sheeprl_tpu.analysis import hlo as hlo_mod
+from sheeprl_tpu.analysis.budgets import check_budgets
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram
+
+__all__ = [
+    "AUDIT_RULES",
+    "AuditFinding",
+    "sharding_fingerprint",
+    "sharding_cache_fingerprint",
+    "audit_program",
+    "run_audit",
+]
+
+AUDIT_RULES: Dict[str, str] = {
+    "AUD000": "program failed to lower/compile (the audit could not inspect it)",
+    "AUD001": "declared buffer donation not honored by the compiled executable",
+    "AUD002": "compiled sharding drifts from the registered declaration / fed-back output not pinned",
+    "AUD003": "dtype leak: f64 in the lowered program or f32 collectives under a bf16 wire policy",
+    "AUD004": "constant baked into the executable exceeds the size budget",
+    "AUD005": "compiled-footprint budget breach or budget-manifest drift",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditFinding:
+    rule: str
+    program: str
+    message: str
+    source: str = ""  # module that registered the program (annotation anchor)
+
+    def render(self) -> str:
+        return f"{self.program}: {self.rule} {self.message}"
+
+
+# --------------------------------------------------------------------------- #
+# sharding fingerprints
+# --------------------------------------------------------------------------- #
+
+
+def sharding_fingerprint(sharding: Any, ndim: int) -> Tuple[str, str]:
+    """NORMALIZED placement identity: two shardings that lay the same data on
+    the same devices fingerprint equal regardless of how they are spelled
+    (``NamedSharding(P(None, 'dp'))`` vs the GSPMD form XLA hands back).
+    Built on the XLA HloSharding canonical form, which is exactly the
+    equivalence jit canonicalization moves within."""
+    if sharding is None:
+        return ("unspecified", "")
+    try:
+        hlo_repr = str(sharding._to_xla_hlo_sharding(ndim))
+    except Exception:  # pragma: no cover - exotic sharding types
+        hlo_repr = repr(sharding)
+    return (hlo_repr, str(getattr(sharding, "memory_kind", None)))
+
+
+def sharding_cache_fingerprint(sharding: Any, ndim: int) -> Tuple[str, str, str]:
+    """CACHE-KEY-grade identity: the normalized fingerprint plus the concrete
+    sharding TYPE. The PR 8 bug lived precisely in the gap between the two —
+    avals equal, placements equivalent, C++ jit-cache keys distinct."""
+    return (type(sharding).__name__,) + sharding_fingerprint(sharding, ndim)
+
+
+def _leaf_nbytes(leaf: Any) -> int:
+    import numpy as np
+
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+    return int(math.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+
+
+def _leaf_device_nbytes(leaf: Any, mesh_devices: int) -> int:
+    """Per-device bytes of a leaf given its (known) sharding — replicated
+    leaves cost full size per device, axis-sharded leaves 1/devices."""
+    nbytes = _leaf_nbytes(leaf)
+    sh = getattr(leaf, "sharding", None)
+    if sh is None:
+        return nbytes
+    try:
+        spec = getattr(sh, "spec", None)
+        if spec is not None and any(p is not None for p in spec):
+            return max(1, nbytes // max(1, mesh_devices))
+    except TypeError:  # pragma: no cover - non-iterable specs
+        pass
+    return nbytes
+
+
+def _flat_leaves(tree: Any) -> List[Any]:
+    import jax
+
+    return jax.tree.flatten(tree, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))[0]
+
+
+def _flat_shardings(tree: Any) -> List[Any]:
+    """``input_shardings``/``output_shardings`` come back as a PYTREE mirroring
+    the program's args/outputs — flatten with Sharding leaves."""
+    import jax
+
+    return jax.tree.flatten(tree, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))[0]
+
+
+def _out_ranges(out_info: Any) -> List[Tuple[int, int]]:
+    """Flat-leaf (start, stop) range of every TOP-LEVEL output. A single
+    (non-tuple) output is one range covering everything."""
+    tops = out_info if isinstance(out_info, (tuple, list)) else (out_info,)
+    ranges: List[Tuple[int, int]] = []
+    off = 0
+    for top in tops:
+        n = len(_flat_leaves(top))
+        ranges.append((off, off + n))
+        off += n
+    return ranges
+
+
+# --------------------------------------------------------------------------- #
+# per-program audit
+# --------------------------------------------------------------------------- #
+
+
+def audit_program(prog: AuditProgram) -> Tuple[List[AuditFinding], Dict[str, Any]]:
+    """Lower + compile one registered program and run checks AUD001-AUD004;
+    returns the findings plus the budget measurement row (AUD005 is judged
+    against the manifest by :func:`run_audit`)."""
+    findings: List[AuditFinding] = []
+
+    def report(rule: str, message: str) -> None:
+        findings.append(AuditFinding(rule, prog.name, message, prog.source))
+
+    try:
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            lowered = prog.fn.lower(*prog.args)
+            compiled = lowered.compile()
+    except Exception as e:  # one broken program must not hide the others
+        report("AUD000", f"lower/compile failed: {type(e).__name__}: {e}")
+        return findings, {}
+
+    stablehlo = lowered.as_text()
+    hlo_text = compiled.as_text()
+    donation_warnings = [
+        str(w.message) for w in caught if "donated buffers were not usable" in str(w.message).lower()
+    ]
+
+    mesh_devices = int(getattr(prog.mesh, "size", 1) or 1)
+
+    # ---- AUD001: donation honored ---------------------------------------- #
+    ma = None
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # pragma: no cover - backend without memory analysis
+        ma = None
+    if prog.donate_argnums:
+        donated_leaves: List[Any] = []
+        for argnum in prog.donate_argnums:
+            donated_leaves.extend(_flat_leaves(prog.args[argnum]))
+        donated_dev_bytes = sum(_leaf_device_nbytes(x, mesh_devices) for x in donated_leaves)
+        aliased = len(parse_aliases := hlo_mod.parse_input_output_aliases(hlo_text))
+        alias_bytes = int(getattr(ma, "alias_size_in_bytes", 0) or 0) if ma is not None else None
+        if donation_warnings:
+            report(
+                "AUD001",
+                "XLA reports unusable donated buffers: " + "; ".join(donation_warnings)[:400],
+            )
+        elif alias_bytes is not None and alias_bytes + prog.donation_slack_bytes < donated_dev_bytes:
+            report(
+                "AUD001",
+                f"declared donation covers ~{donated_dev_bytes} B/device across "
+                f"{len(donated_leaves)} leaves but the executable aliases only {alias_bytes} B "
+                f"({aliased} aliased parameters) — the un-aliased remainder is resident twice "
+                "per dispatch",
+            )
+
+    # ---- AUD002: sharding declaration ------------------------------------ #
+    if prog.check_input_shardings:
+        arg_leaves = _flat_leaves((prog.args, {}))
+        try:
+            in_shardings = _flat_shardings(compiled.input_shardings)
+        except Exception:  # pragma: no cover
+            in_shardings = []
+        if in_shardings and len(in_shardings) == len(arg_leaves):
+            for i, (leaf, got) in enumerate(zip(arg_leaves, in_shardings)):
+                staged = getattr(leaf, "sharding", None)
+                if staged is None:
+                    continue
+                ndim = len(getattr(leaf, "shape", ()) or ())
+                if sharding_fingerprint(staged, ndim) != sharding_fingerprint(got, ndim):
+                    report(
+                        "AUD002",
+                        f"input leaf {i} compiled for placement {got} but the driver stages "
+                        f"{staged} — every dispatch reshards this argument",
+                    )
+
+    out_info = getattr(lowered, "out_info", None)
+    if (prog.out_decl or prog.feedback_outputs) and out_info is not None:
+        import jax
+        from jax.sharding import NamedSharding
+
+        ranges = _out_ranges(out_info)
+        out_leaves = _flat_leaves(out_info)
+        try:
+            out_shardings = _flat_shardings(compiled.output_shardings)
+        except Exception:  # pragma: no cover
+            out_shardings = []
+        pin_flags = hlo_mod.parse_output_pinning(hlo_text)
+        if pin_flags is not None and len(pin_flags) == 1 and len(out_leaves) > 1:
+            pin_flags = pin_flags * len(out_leaves)
+
+        for top_idx, spec in sorted(prog.out_decl.items()):
+            if top_idx >= len(ranges):
+                report("AUD002", f"out_decl names output {top_idx} but the program has {len(ranges)}")
+                continue
+            lo_i, hi_i = ranges[top_idx]
+            want = NamedSharding(prog.mesh, spec) if prog.mesh is not None else None
+            for flat in range(lo_i, hi_i):
+                if flat >= len(out_shardings) or want is None:
+                    break
+                ndim = len(getattr(out_leaves[flat], "shape", ()) or ())
+                if sharding_fingerprint(want, ndim) != sharding_fingerprint(out_shardings[flat], ndim):
+                    report(
+                        "AUD002",
+                        f"output {top_idx} (flat leaf {flat}) compiled to placement "
+                        f"{out_shardings[flat]} but is declared {spec} — sharding drift on a "
+                        "program output",
+                    )
+                    break
+
+        for top_idx in prog.feedback_outputs:
+            if top_idx >= len(ranges):
+                report("AUD002", f"feedback_outputs names output {top_idx} but the program has {len(ranges)}")
+                continue
+            lo_i, hi_i = ranges[top_idx]
+            if pin_flags is None or hi_i > len(pin_flags):
+                continue
+            unpinned = [flat for flat in range(lo_i, hi_i) if not pin_flags[flat]]
+            if unpinned:
+                report(
+                    "AUD002",
+                    f"output {top_idx} is fed back into the next dispatch but its placement is "
+                    f"compiler-chosen ({len(unpinned)} of {hi_i - lo_i} leaves unpinned) — the "
+                    "PR 8 class: an equivalent canonicalized placement keys a fresh C++ jit-cache "
+                    "entry and silently recompiles the program on call 2. Pin out_shardings.",
+                )
+
+    # ---- AUD003: dtype policy --------------------------------------------- #
+    if not prog.allow_f64:
+        n64 = hlo_mod.find_dtype(stablehlo, "f64")
+        if n64:
+            report(
+                "AUD003",
+                f"f64 appears in {n64} lowered tensor type(s) — double precision on TPU is an "
+                "emulated order-of-magnitude slowdown; this repo's programs are f32/bf16 by policy",
+            )
+    coll_records = hlo_mod.stablehlo_collectives(stablehlo)
+    if prog.wire_dtype == "bfloat16":
+        f32_bytes = sum(int(r["bytes"]) for r in coll_records if "f32" in str(r["dtype"]))
+        if f32_bytes > prog.f32_collective_budget:
+            ops = sorted({str(r["op"]) for r in coll_records if "f32" in str(r["dtype"])})
+            report(
+                "AUD003",
+                f"{f32_bytes} B of f32 collective traffic per dispatch ({', '.join(ops)}) under "
+                f"the declared bfloat16 wire policy (slack budget {prog.f32_collective_budget} B) "
+                "— a promotion at a collective boundary is doubling the wire bytes",
+            )
+    f64_coll = [r for r in coll_records if "f64" in str(r["dtype"])]
+    if f64_coll:
+        report("AUD003", f"{len(f64_coll)} collective(s) move f64 on the wire")
+
+    # ---- AUD004: baked constants ------------------------------------------ #
+    big = hlo_mod.large_constants(hlo_text, prog.constant_budget)
+    for c in big[:3]:
+        report(
+            "AUD004",
+            f"constant {c['dtype']}[{c['shape']}] ({c['bytes']} B) baked into the executable "
+            f"exceeds the {prog.constant_budget} B budget — folded weights break hot swap and "
+            "ship in every program copy",
+        )
+
+    # ---- measurement row (AUD005 judged against the manifest upstream) ---- #
+    collective_axis: Dict[str, int] = {}
+    axis_by_width = {}
+    if prog.mesh is not None:
+        axis_by_width = {int(prog.mesh.shape[a]): str(a) for a in prog.mesh.axis_names}
+    for r in coll_records:
+        axis = axis_by_width.get(int(r["group_size"]), "other")
+        collective_axis[axis] = collective_axis.get(axis, 0) + int(r["bytes"])
+    executable_bytes = 0
+    executable_src = "hlo_text"
+    try:
+        from jax.experimental import serialize_executable
+
+        payload = serialize_executable.serialize(compiled)
+        executable_bytes = len(payload[0]) if isinstance(payload, tuple) else len(payload)
+        executable_src = "serialized"
+    except Exception:
+        executable_bytes = len(hlo_text)
+    measurement: Dict[str, Any] = {
+        "peak_hbm_bytes": 0,
+        "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0) or 0) if ma else 0,
+        "output_bytes": int(getattr(ma, "output_size_in_bytes", 0) or 0) if ma else 0,
+        "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0) or 0) if ma else 0,
+        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0) or 0) if ma else 0,
+        "collective_bytes": collective_axis,
+        "collective_count": len(coll_records),
+        "executable_bytes": executable_bytes,
+        "executable_bytes_source": executable_src,
+        "largest_constant_bytes": int(big[0]["bytes"]) if big else 0,
+    }
+    measurement["peak_hbm_bytes"] = max(
+        0,
+        measurement["argument_bytes"]
+        + measurement["output_bytes"]
+        + measurement["temp_bytes"]
+        - measurement["alias_bytes"],
+    )
+    return findings, measurement
+
+
+# --------------------------------------------------------------------------- #
+# registry-wide run
+# --------------------------------------------------------------------------- #
+
+
+def run_audit(
+    mesh: AuditMesh,
+    select: Optional[Sequence[str]] = None,
+    manifest: Optional[Dict[str, Any]] = None,
+) -> Tuple[List[AuditFinding], Dict[str, Dict[str, Any]]]:
+    """Audit the selected registry slice; when a ``manifest`` is given, judge
+    the measurements against it (AUD005). The stale-manifest-entry check arms
+    itself only on UNSELECTED runs — those see the full program inventory, a
+    ``--select`` slice cannot (and program construction is the expensive
+    setup half of an audit, so the registry is built exactly once)."""
+    from sheeprl_tpu.analysis.programs import collect_programs
+
+    programs = collect_programs(mesh, select)
+    findings: List[AuditFinding] = []
+    measurements: Dict[str, Dict[str, Any]] = {}
+    for prog in programs:
+        f, m = audit_program(prog)
+        findings.extend(f)
+        if m:
+            measurements[prog.name] = m
+    if manifest is not None:
+        sources = {p.name: p.source for p in programs}
+        for name, message in check_budgets(
+            measurements,
+            manifest,
+            audited=[p.name for p in programs if p.name in measurements],
+            all_registered=[p.name for p in programs] if select is None else None,
+        ):
+            findings.append(AuditFinding("AUD005", name, message, sources.get(name, "")))
+    findings.sort(key=lambda f: (f.program, f.rule, f.message))
+    return findings, measurements

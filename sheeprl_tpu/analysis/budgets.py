@@ -1,0 +1,162 @@
+"""The checked-in per-program budget manifest (``.graft-audit-budgets.json``).
+
+Every registered hot-path program carries three headline budgets measured at
+audit time from the compiled artifact:
+
+- ``peak_hbm_bytes`` — arguments + outputs + temps − aliased bytes from
+  ``compiled.memory_analysis()`` (the steady-state footprint one dispatch
+  pins; donation honored == the aliased bytes actually subtract);
+- ``collective_bytes`` — per-mesh-axis interconnect traffic per dispatch,
+  accounted from the LOWERED (StableHLO) collectives so the wire dtype is
+  the one the program traced with;
+- ``executable_bytes`` — serialized executable size (baked-in constants show
+  up here long before they hit the per-constant AUD004 ceiling).
+
+The audit fails (AUD005) when a program exceeds its budget by more than the
+manifest's ``tolerance``, when a registered program has NO entry, or when the
+manifest carries entries for programs that no longer exist — so the manifest
+must be regenerated (``--write-budgets``) in the same PR that changes a
+program's footprint, and a new hot path cannot ship ungoverned.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_BUDGETS_PATH",
+    "DEFAULT_TOLERANCE",
+    "BUDGET_KEYS",
+    "load_manifest",
+    "write_manifest",
+    "check_budgets",
+    "manifest_from_measurements",
+]
+
+DEFAULT_BUDGETS_PATH = ".graft-audit-budgets.json"
+#: headroom before a measured value fails its budget — absorbs compiler
+#: version wobble and host-dependent codegen without hiding a real regression
+DEFAULT_TOLERANCE = 0.25
+BUDGET_KEYS = ("peak_hbm_bytes", "collective_bytes", "executable_bytes")
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "programs" not in data or not isinstance(data["programs"], dict):
+        raise ValueError(f"malformed budget manifest: {path}")
+    return data
+
+
+def manifest_from_measurements(
+    measurements: Dict[str, Dict[str, Any]],
+    mesh_spec: str,
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> Dict[str, Any]:
+    programs: Dict[str, Any] = {}
+    for name in sorted(measurements):
+        m = measurements[name]
+        programs[name] = {
+            "peak_hbm_bytes": int(m.get("peak_hbm_bytes", 0)),
+            "collective_bytes": {k: int(v) for k, v in sorted((m.get("collective_bytes") or {}).items())},
+            "executable_bytes": int(m.get("executable_bytes", 0)),
+        }
+    return {
+        "comment": (
+            "graft-audit budget manifest: per-program compiled-footprint ceilings "
+            "(peak HBM estimate, collective bytes per mesh axis, executable size), "
+            "checked at lower time by `python -m sheeprl_tpu.analysis audit`. "
+            "Regenerate with `--write-budgets` in the SAME PR that changes a program."
+        ),
+        "version": 1,
+        "mesh": mesh_spec,
+        "tolerance": tolerance,
+        "programs": programs,
+    }
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _over(measured: float, budget: float, tol: float) -> bool:
+    return measured > budget * (1.0 + tol)
+
+
+def check_budgets(
+    measurements: Dict[str, Dict[str, Any]],
+    manifest: Dict[str, Any],
+    audited: Optional[Sequence[str]] = None,
+    all_registered: Optional[Sequence[str]] = None,
+) -> List[Tuple[str, str]]:
+    """``(program, message)`` pairs for every budget violation.
+
+    ``audited`` limits the missing-entry check to the programs this pass
+    actually lowered (a ``--select`` run must not report unselected programs
+    as missing); ``all_registered`` enables the stale-entry check — manifest
+    rows naming programs nobody registers anymore are drift, not headroom.
+    """
+    tol = float(manifest.get("tolerance", DEFAULT_TOLERANCE))
+    rows: Dict[str, Any] = manifest.get("programs", {})
+    out: List[Tuple[str, str]] = []
+    names = list(audited) if audited is not None else sorted(measurements)
+    for name in names:
+        m = measurements.get(name)
+        if m is None:
+            continue
+        row = rows.get(name)
+        if row is None:
+            out.append(
+                (
+                    name,
+                    "no budget-manifest entry — a new hot path must land with its budgets "
+                    "(`python -m sheeprl_tpu.analysis audit --write-budgets`)",
+                )
+            )
+            continue
+        for key in ("peak_hbm_bytes", "executable_bytes"):
+            measured = float(m.get(key, 0))
+            budget = float(row.get(key, 0))
+            if _over(measured, budget, tol):
+                out.append(
+                    (
+                        name,
+                        f"{key} {int(measured)} exceeds budget {int(budget)} by more than "
+                        f"{tol:.0%} — regenerate the manifest in the PR that grew this program "
+                        "if the growth is intentional",
+                    )
+                )
+        mcoll = m.get("collective_bytes") or {}
+        bcoll = row.get("collective_bytes") or {}
+        for axis in sorted(set(mcoll) | set(bcoll)):
+            measured = float(mcoll.get(axis, 0))
+            budget = float(bcoll.get(axis, 0))
+            if measured > 0 and budget == 0:
+                out.append((name, f"collective traffic appeared on mesh axis '{axis}' "
+                                  f"({int(measured)} B/dispatch) with no budget for it"))
+            elif _over(measured, budget, tol):
+                out.append(
+                    (
+                        name,
+                        f"collective_bytes[{axis}] {int(measured)} exceeds budget {int(budget)} "
+                        f"by more than {tol:.0%}",
+                    )
+                )
+    if all_registered is not None:
+        live = set(all_registered)
+        for name in sorted(rows):
+            if name not in live:
+                out.append(
+                    (
+                        name,
+                        "stale budget-manifest entry: no registered program by this name — "
+                        "remove it (or restore the program's audit registration)",
+                    )
+                )
+    return out

@@ -1,0 +1,227 @@
+"""Text-level parsers over lowered (StableHLO) and compiled (optimized HLO)
+program artifacts — the ONE place the repo reads compiler output.
+
+Two tiers of text, two sets of facts:
+
+- ``lowered.as_text()`` (StableHLO) is what JAX *asked for*: collective ops
+  still carry the wire dtype the program was traced with (XLA:CPU later
+  promotes bf16 host collectives back to f32 during optimization, so dtype-
+  at-collective-boundary checks MUST read this tier), and donated parameters
+  carry ``tf.aliasing_output`` attributes.
+- ``compiled.as_text()`` (optimized HLO) is what XLA *delivered*: the
+  ``input_output_alias`` map records which donations were actually honored,
+  ``allow_spmd_sharding_propagation_to_output`` records per-output whether
+  the caller pinned the placement or left it to the compiler (the PR 8
+  silent-recompile class), and ``constant(...)`` instructions record what got
+  baked into the executable.
+
+Consumers: :mod:`sheeprl_tpu.analysis.audit` (the graft-audit gate) and
+``benchmarks/collective_analysis.py`` (the scaling-roofline bench) — both
+walk HLO through these helpers so the gate and the bench can never drift.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "DTYPE_BYTES",
+    "shape_bytes",
+    "account_collectives",
+    "stablehlo_collectives",
+    "parse_input_output_aliases",
+    "parse_output_pinning",
+    "large_constants",
+    "find_dtype",
+]
+
+#: HLO short dtype -> bytes per element (unknown dtypes default to 4 at the
+#: call sites that need a number; the parsers below keep them symbolic)
+DTYPE_BYTES: Dict[str, int] = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+_TUPLE_ELEM_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def shape_bytes(dtype: str, dims: str) -> int:
+    """Bytes of one HLO shape, e.g. ``("f32", "16,128") -> 8192``."""
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * DTYPE_BYTES.get(dtype, 4)
+
+
+_HLO_COLLECTIVE_RE = re.compile(
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)(?:-start)?\("
+)
+
+
+def account_collectives(hlo_text: str) -> dict:
+    """Per-collective-op byte totals from optimized HLO text.
+
+    Accounts the RESULT signature of every collective instruction (the bytes
+    that ride the interconnect per step, up to the ring factor the roofline
+    applies). Caveat inherited by every caller: on XLA:CPU, bf16 collectives
+    are promoted back to f32 during optimization — read the StableHLO tier
+    (:func:`stablehlo_collectives`) when the wire dtype is the question.
+    """
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        rhs_sig = line.split("=", 1)[1] if "=" in line else line
+        # the result signature precedes the op name: f32[...] or a tuple
+        sig = rhs_sig[: m.start() - len(line.split("=", 1)[0]) - 1] if "=" in line else rhs_sig
+        elems = _TUPLE_ELEM_RE.findall(sig)
+        nbytes = sum(shape_bytes(t, d) for t, d in elems if t in DTYPE_BYTES)
+        if nbytes == 0:
+            continue
+        slot = out.setdefault(op, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+    return out
+
+
+_SHLO_COLLECTIVE_RE = re.compile(
+    r"stablehlo\.(all_reduce|all_gather|reduce_scatter|collective_permute|all_to_all)"
+)
+_SHLO_GROUPS_RE = re.compile(r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<(\d+)x(\d+)xi64>")
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*?)x?(f64|f32|f16|bf16|s64|u64|s32|u32|s16|u16|s8|u8|i64|i32|i16|i8|i1)>")
+_SHLO_DTYPE_ALIASES = {"i64": "s64", "i32": "s32", "i16": "s16", "i8": "s8", "i1": "pred"}
+
+
+def _tensor_bytes(sig: str) -> List[Tuple[str, int]]:
+    """``(dtype, bytes)`` for every tensor type in a StableHLO signature."""
+    out: List[Tuple[str, int]] = []
+    for dims, dt in _TENSOR_RE.findall(sig):
+        dt = _SHLO_DTYPE_ALIASES.get(dt, dt)
+        n = 1
+        for d in dims.split("x"):
+            if d:
+                n *= int(d)
+        out.append((dt, n * DTYPE_BYTES.get(dt, 4)))
+    return out
+
+
+def stablehlo_collectives(stablehlo_text: str) -> List[Dict[str, object]]:
+    """Collective ops from the LOWERED (StableHLO) text, with the dtype the
+    program was traced with — the ground truth for wire-dtype policy checks.
+
+    Returns one record per op: ``{"op", "dtype", "bytes", "group_size"}``
+    where ``bytes`` accounts the result tensors and ``group_size`` is the
+    replica-group width (== the size of the mesh axis the op rides for the
+    1-axis meshes this repo builds today; multi-axis meshes disambiguate by
+    matching group width against axis sizes).
+    """
+    lines = stablehlo_text.splitlines()
+    records: List[Dict[str, object]] = []
+    for i, line in enumerate(lines):
+        m = _SHLO_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        gm = _SHLO_GROUPS_RE.search(line)
+        group_size = int(gm.group(2)) if gm else 0
+        # The type signature `... : (tensor<...>) -> tensor<...>` sits on the
+        # op line for region-free ops (all_gather) or on the region-closing
+        # `}) : (...) -> ...` line for ops with a reduction body.
+        sig_line: Optional[str] = None
+        for j in range(i, min(i + 64, len(lines))):
+            if ") -> " in lines[j]:
+                sig_line = lines[j]
+                break
+        if sig_line is None:
+            continue
+        result_sig = sig_line.split(") -> ", 1)[1]
+        tensors = _tensor_bytes(result_sig)
+        nbytes = sum(b for _, b in tensors)
+        dtypes = sorted({t for t, _ in tensors})
+        records.append(
+            {"op": op, "dtype": ",".join(dtypes) or "unknown", "bytes": nbytes, "group_size": group_size}
+        )
+    return records
+
+
+_ALIAS_ENTRY_RE = re.compile(r"\{([0-9,\s]*)\}:\s*\((\d+)")
+
+
+def parse_input_output_aliases(compiled_hlo_text: str) -> List[Tuple[Tuple[int, ...], int]]:
+    """``[(output_tuple_index, parameter_number), ...]`` from the optimized
+    HLO module header's ``input_output_alias`` map — the donations XLA
+    actually honored. Empty list when nothing aliased."""
+    # the alias map nests one level of braces per entry; grab the header
+    # region between 'input_output_alias={' and the matching close brace
+    start = compiled_hlo_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    depth = 0
+    end = start
+    for k in range(start + len("input_output_alias="), len(compiled_hlo_text)):
+        ch = compiled_hlo_text[k]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                end = k
+                break
+    block = compiled_hlo_text[start:end]
+    out: List[Tuple[Tuple[int, ...], int]] = []
+    for m in _ALIAS_ENTRY_RE.finditer(block):
+        idx = tuple(int(x) for x in m.group(1).replace(" ", "").split(",") if x != "")
+        out.append((idx, int(m.group(2))))
+    return out
+
+
+_PIN_RE = re.compile(r"allow_spmd_sharding_propagation_to_output=\{([a-z,]*)\}")
+
+
+def parse_output_pinning(compiled_hlo_text: str) -> Optional[List[bool]]:
+    """Per-flat-output ``True`` = the caller PINNED the placement
+    (``out_shardings``), ``False`` = the compiler chose it (the PR 8
+    silent-recompile class: an equivalent-but-differently-keyed placement on
+    a fed-back output recompiles the whole program on call 2).
+
+    Returns None when the module header carries no propagation flags (single
+    unpartitioned executables). A single flag broadcasts over all outputs.
+    """
+    m = _PIN_RE.search(compiled_hlo_text)
+    if not m:
+        return None
+    flags = [tok == "false" for tok in m.group(1).split(",") if tok]
+    return flags or None
+
+
+_CONST_RE = re.compile(r"=\s*([a-z0-9]+)\[([0-9,]*)\]\S*\s+constant\(")
+
+
+def large_constants(compiled_hlo_text: str, min_bytes: int) -> List[Dict[str, object]]:
+    """Constants baked into the optimized executable at or above
+    ``min_bytes`` — weights folded into a program break hot swap (graft-serve)
+    and bloat every copy of the executable."""
+    out: List[Dict[str, object]] = []
+    for line in compiled_hlo_text.splitlines():
+        m = _CONST_RE.search(line)
+        if not m:
+            continue
+        dtype, dims = m.group(1), m.group(2)
+        if dtype not in DTYPE_BYTES:
+            continue
+        nbytes = shape_bytes(dtype, dims)
+        if nbytes >= min_bytes:
+            out.append({"dtype": dtype, "shape": dims or "scalar", "bytes": nbytes})
+    out.sort(key=lambda r: -int(r["bytes"]))  # type: ignore[arg-type]
+    return out
+
+
+def find_dtype(stablehlo_text: str, dtype: str) -> int:
+    """Occurrences of ``dtype`` (HLO/StableHLO short name, e.g. ``f64``) in
+    tensor types of the lowered text — 0 means the program never touches it."""
+    return len(re.findall(rf"tensor<(?:[0-9x]+x)?{re.escape(dtype)}>", stablehlo_text))

@@ -28,6 +28,13 @@ GL007  ``jax.random.PRNGKey``/``jax.random.key`` created inside a loop body:
        fresh keys from a (usually constant) seed per iteration either repeat
        the stream or hide a host->device transfer per step; derive from a
        carried key with ``split``/``fold_in`` instead.
+GL008  ``jax.jit`` that BOTH donates buffers AND returns mesh-axis-sharded
+       ``shard_map`` outputs WITHOUT pinned ``out_shardings`` — the exact
+       PR 8 bug shape: jit canonicalizes the sharded output placement to an
+       EQUIVALENT layout with a different C++ jit-cache key, so the next call
+       (fed by this call's donated outputs) silently recompiles the whole
+       program — one abstract signature, two compiles, no tracing-cache miss
+       to warn anyone. Pin ``out_shardings`` on every fed-back output.
 
 Jit-reachability is computed per module by walking (a) ``@jax.jit`` /
 ``@partial(jax.jit, ...)`` decorators, (b) function names passed to
@@ -76,6 +83,7 @@ RULES: Dict[str, str] = {
     "GL005": "read of a donated buffer after the donating call",
     "GL006": "dict-ordering-sensitive pytree construction",
     "GL007": "PRNGKey created inside a loop body",
+    "GL008": "donating jit over sharded shard_map outputs without pinned out_shardings",
 }
 
 # jax.random callables that SPEND the key passed as their first argument.
@@ -886,6 +894,169 @@ def _collect_donate_sites(
 
 
 # --------------------------------------------------------------------------- #
+# GL008: donating jit over sharded shard_map outputs without pinned
+# out_shardings (module-wide pre-pass, like the donation-site collection)
+# --------------------------------------------------------------------------- #
+
+
+def _contains_sharded_p(ctx: _ModuleContext, expr: ast.AST, sharded_names: Set[str]) -> bool:
+    """Does ``expr`` plausibly denote a MESH-AXIS-SHARDED PartitionSpec —
+    a ``P(...)``/``PartitionSpec(...)`` call with a string axis argument, or
+    a name bound to one anywhere in the module (covers the
+    ``spec = P(None, "dp") if cond else P()`` conditional idiom)?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and _tail(ctx.resolve(node.func)) in ("P", "PartitionSpec"):
+            if any(isinstance(a, ast.Constant) and isinstance(a.value, str) for a in node.args):
+                return True
+        if isinstance(node, ast.Name) and node.id in sharded_names:
+            return True
+    return False
+
+
+def _iter_ordered_assigns(fn: ast.AST) -> Iterable[ast.Assign]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            yield node
+
+
+def _gl008_donates(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return True  # conditional donation (`(0, 1) if donate else ()`)
+            if val not in ((), [], None):
+                return True
+    return False
+
+
+def _check_gl008(
+    ctx: _ModuleContext,
+    tree: ast.Module,
+    funcs: Dict[int, "_FunctionInfo"],
+    findings: Set[Finding],
+) -> None:
+    """Per-FRAME analysis: shard_map bindings, spec names, and wrapper
+    functions are all factory-local by idiom (every ``make_*`` builds its own
+    ``shard_train``), so name maps must not leak across frames — a sharded
+    ``shard_train`` in one factory must not indict the replicated one next
+    door."""
+    frames: List[Tuple[str, ast.AST]] = [("<module>", tree)]
+    for info in funcs.values():
+        if isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            frames.append((info.qualname, info.node))
+
+    for qualname, frame in frames:
+        own = list(_own_frame_nodes(frame))
+        # (1) frame-local names bound to sharded P specs
+        sharded_names: Set[str] = set()
+        for node in own:
+            if isinstance(node, ast.Assign) and _contains_sharded_p(ctx, node.value, set()):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        sharded_names.add(t.id)
+        # (2) frame-local shard_map bindings with out_specs shardedness
+        shardmaps: Dict[str, bool] = {}
+        for node in own:
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _tail(ctx.resolve(node.value.func)) == "shard_map"
+            ):
+                sharded = False
+                for kw in node.value.keywords:
+                    if kw.arg == "out_specs":
+                        sharded = _contains_sharded_p(ctx, kw.value, sharded_names)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        shardmaps[t.id] = sharded
+        if not shardmaps:
+            continue
+        # (3) child wrapper functions whose return values data-flow from a
+        # frame-local shard_map call (the `packed(...)` idiom: unpack the
+        # tuple, restructure into dicts, return) — a two-pass propagation
+        # over the child's assignments covers rebuilt containers
+        wrappers: Dict[str, bool] = {}
+        for child in own:
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            assigned_from: Dict[str, bool] = {}
+            for _ in range(2):
+                for node in _iter_ordered_assigns(child):
+                    value = node.value
+                    sharded2: Optional[bool] = None
+                    if (
+                        isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Name)
+                        and value.func.id in shardmaps
+                    ):
+                        sharded2 = shardmaps[value.func.id]
+                    else:
+                        hits = [
+                            assigned_from[x.id]
+                            for x in ast.walk(value)
+                            if isinstance(x, ast.Name) and x.id in assigned_from
+                        ]
+                        if hits:
+                            sharded2 = any(hits)
+                    if sharded2 is None:
+                        continue
+                    for t in node.targets:
+                        for x in ast.walk(t):
+                            if isinstance(x, ast.Name):
+                                assigned_from[x.id] = assigned_from.get(x.id, False) or sharded2
+            for node in ast.walk(child):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    for x in ast.walk(node.value):
+                        if isinstance(x, ast.Name) and x.id in assigned_from:
+                            wrappers[child.name] = wrappers.get(child.name, False) or assigned_from[x.id]
+                        if (
+                            isinstance(x, ast.Call)
+                            and isinstance(x.func, ast.Name)
+                            and x.func.id in shardmaps
+                        ):
+                            wrappers[child.name] = wrappers.get(child.name, False) or shardmaps[x.func.id]
+        # (4) the hazard: a frame-local jit(target, donate_argnums=...,
+        # <no out_shardings>) whose target returns sharded shard_map outputs
+        for node in own:
+            if not isinstance(node, ast.Call) or _tail(ctx.resolve(node.func)) != "jit":
+                continue
+            if not _gl008_donates(node):
+                continue
+            if any(kw.arg == "out_shardings" for kw in node.keywords):
+                continue
+            target = node.args[0] if node.args else None
+            sharded = False
+            target_name = None
+            if isinstance(target, ast.Name):
+                target_name = target.id
+                sharded = shardmaps.get(target.id, False) or wrappers.get(target.id, False)
+            elif isinstance(target, ast.Call) and _tail(ctx.resolve(target.func)) == "shard_map":
+                target_name = "<inline shard_map>"
+                for kw in target.keywords:
+                    if kw.arg == "out_specs":
+                        sharded = _contains_sharded_p(ctx, kw.value, sharded_names)
+            if not sharded:
+                continue
+            if ctx.is_suppressed("GL008", node.lineno):
+                continue
+            findings.add(
+                Finding(
+                    "GL008",
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset + 1,
+                    f"jit donates buffers and returns `{target_name}`'s mesh-axis-sharded shard_map "
+                    "outputs without pinned out_shardings — a canonicalized (equivalent) output "
+                    "placement keys a fresh C++ jit cache entry and silently recompiles the program "
+                    "when the outputs are fed back; pin out_shardings on every fed-back output",
+                    qualname,
+                )
+            )
+
+
+# --------------------------------------------------------------------------- #
 # public API
 # --------------------------------------------------------------------------- #
 
@@ -919,6 +1090,7 @@ def analyze_source(
     _FnAnalysis(ctx, module_info_frame, findings, donate_sites).run()
     for info in funcs.values():
         _FnAnalysis(ctx, info, findings, donate_sites).run()
+    _check_gl008(ctx, tree, funcs, findings)
 
     out = [
         f

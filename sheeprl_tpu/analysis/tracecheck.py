@@ -122,6 +122,15 @@ class TraceCheck:
         if self.mode not in ("off", "warn", "strict"):
             self.mode = "warn"
         self.transfer_guard: bool = False
+        # SHEEPRL_TPU_TRACECHECK_DUMP=path: export the ledger as a JSON
+        # artifact at process exit — bench lanes and `python -m
+        # sheeprl_tpu.analysis tracecheck <path>` assert compile counts from
+        # this ONE source instead of scraping run logs
+        dump_path = os.environ.get("SHEEPRL_TPU_TRACECHECK_DUMP", "").strip()
+        if dump_path:
+            import atexit
+
+            atexit.register(self.dump, dump_path)
 
     # -- configuration ------------------------------------------------------ #
 
@@ -267,6 +276,37 @@ class TraceCheck:
             for name, rep in self.report().items()
             if rep["post_warmup_compiles"] > 0
         }
+
+    def dump(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """The full ledger as one JSON-serializable payload — per-entry
+        merged counters, the hot paths currently over budget, and the generic
+        trace events (values stringified; they are free-form). Writes
+        atomically to ``path`` when given (tmp + rename: a killed run leaves
+        the previous artifact intact, not a torn one) and ALWAYS returns the
+        payload, so in-process consumers (bench lanes) and artifact consumers
+        (CI, the ``analysis tracecheck`` CLI) read the same truth."""
+        with self._lock:
+            events = {tag: [repr(v) for v in vals] for tag, vals in self._events.items()}
+        payload: Dict[str, Any] = {
+            "tool": "tracecheck",
+            "mode": self.mode,
+            "transfer_guard": self.transfer_guard,
+            "entries": self.report(),
+            "post_warmup_retraces": self.post_warmup_retraces(),
+            "events": events,
+        }
+        if path:
+            import json
+
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(payload, fh, indent=2)
+                    fh.write("\n")
+                os.replace(tmp, path)
+            except OSError as e:  # pragma: no cover - exit-path best effort
+                warnings.warn(f"tracecheck: could not write dump {path}: {e}", RuntimeWarning)
+        return payload
 
     # -- trace-event ledger --------------------------------------------------- #
 

@@ -423,7 +423,25 @@ class DeviceReplayBuffer:
                 new_state["max_p"] = max_p
             return new_state
 
-        return jax.jit(packed_append, donate_argnums=(0,) if donate else ())
+        # Pin the fed-back ring state's placements: the (possibly env-
+        # sharded) storage is donated and fed back EVERY append — left to
+        # inference, jit may canonicalize it to an equivalent placement with
+        # a different C++ jit-cache key and silently recompile on the next
+        # dispatch (graft-lint GL008 / graft-audit AUD002, the PR 8 class).
+        from jax.sharding import NamedSharding
+
+        rep_out = NamedSharding(self.fabric.mesh, P())
+        state_out = {
+            "storage": NamedSharding(self.fabric.mesh, storage_spec),
+            "pos": rep_out,
+            "valid": rep_out,
+            "key": rep_out,
+        }
+        if prioritized:
+            state_out.update(tree=rep_out, max_p=rep_out)
+        return jax.jit(
+            packed_append, donate_argnums=(0,) if donate else (), out_shardings=state_out
+        )
 
     def note_dispatch_latency(self, seconds: float) -> None:
         """Wall time of the fused append+sample+train dispatch (the whole

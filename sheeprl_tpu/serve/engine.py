@@ -41,7 +41,7 @@ from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.parallel.pipeline import DoubleBufferedStager
 from sheeprl_tpu.serve.policy import ServePolicy
 
-__all__ = ["BucketEngine", "JitEngine", "default_buckets"]
+__all__ = ["BucketEngine", "JitEngine", "default_buckets", "bucket_program"]
 
 
 def default_buckets() -> Tuple[int, ...]:
@@ -50,6 +50,23 @@ def default_buckets() -> Tuple[int, ...]:
 
 def _shape_struct(tree: Any) -> Any:
     return jax.tree.map(lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), tree)
+
+
+def bucket_program(policy: ServePolicy, bucket: int, greedy: bool):
+    """The ONE lowering path for a padded-bucket policy program: the jitted
+    callable plus its abstract call signature (params avals + a ``bucket``-row
+    obs slab + the sample key for the stochastic program). The engine
+    AOT-compiles these pairs at construction; the graft-audit registry lowers
+    the SAME pairs, so the gate can never drift from what serving runs."""
+    params_struct = _shape_struct(policy.params)
+    obs_struct = {
+        k: jax.ShapeDtypeStruct((bucket, *shape), np.dtype(dtype))
+        for k, (shape, dtype) in policy.obs_spec.items()
+    }
+    if greedy:
+        return jax.jit(policy.greedy_fn), (params_struct, obs_struct)
+    key_struct = _shape_struct(jax.random.PRNGKey(0))
+    return jax.jit(policy.sample_fn), (params_struct, obs_struct, key_struct)
 
 
 class BucketEngine:
@@ -96,17 +113,11 @@ class BucketEngine:
         # CURRENT params avals — any swapped-in tree must match them
         self._programs: Dict[Tuple[int, bool], Any] = {}
         self._key_aval = jax.random.PRNGKey(0)
-        params_struct = _shape_struct(policy.params)
         modes = {"greedy": (True,), "sample": (False,), "both": (True, False)}[mode]
         for b in buckets:
-            obs_struct = {
-                k: jax.ShapeDtypeStruct((b, *shape), np.dtype(dtype)) for k, (shape, dtype) in policy.obs_spec.items()
-            }
             for greedy in modes:
-                if greedy:
-                    compiled = jax.jit(policy.greedy_fn).lower(params_struct, obs_struct).compile()
-                else:
-                    compiled = jax.jit(policy.sample_fn).lower(params_struct, obs_struct, _shape_struct(self._key_aval)).compile()
+                jit_fn, avals = bucket_program(policy, b, greedy)
+                compiled = jit_fn.lower(*avals).compile()
                 tag = "greedy" if greedy else "sample"
                 self._programs[(b, greedy)] = tracecheck.instrument(
                     compiled,
@@ -268,3 +279,53 @@ class JitEngine:
                 "padded_rows": 0,
                 "batch_fill_ratio": 1.0 if self.rows else 0.0,
             }
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs(
+    "serve.bucket[1].greedy", "serve.bucket[8].greedy", "serve.bucket[8].sample"
+)
+def _audit_programs(spec: AuditMesh):
+    """A real PPO policy through the registered builder, lowered at a small
+    ladder slice via :func:`bucket_program` — the serving tier's constant
+    budget is the strictest in the repo: ANY weight folded into a bucket
+    executable breaks the zero-recompile hot-swap contract."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo.evaluate import serve_policy_ppo
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    cfg = compose(
+        [
+            "exp=ppo",
+            "env=gym",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(42)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    act_space = gym.spaces.Discrete(2)
+    policy = serve_policy_ppo(fabric, cfg, obs_space, act_space, None)
+    # serving runs per-request on ONE device: constants and dtype are the
+    # audit surface (a 64 KiB budget — bucket programs must stay weight-free)
+    for bucket, greedy in ((1, True), (8, True), (8, False)):
+        jit_fn, avals = bucket_program(policy, bucket, greedy)
+        yield AuditProgram(
+            name=f"serve.bucket[{bucket}].{'greedy' if greedy else 'sample'}",
+            fn=jit_fn,
+            args=avals,
+            source=__name__,
+            constant_budget=64 * 1024,
+            check_input_shardings=False,
+        )

@@ -631,3 +631,175 @@ def test_scan_body_reachable_without_jit():
         """
     )
     assert rules_of(fs) == ["GL003"]
+
+
+# --------------------------------------------------------------------------- #
+# GL008 — donating jit over sharded shard_map outputs without pinned
+# out_shardings (the PR 8 silent-recompile shape)
+# --------------------------------------------------------------------------- #
+
+
+def test_gl008_fires_on_direct_sharded_donating_jit():
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh):
+            def body(x, p):
+                return x * 2, p
+
+            st = shard_map(body, mesh=mesh, in_specs=(P("dp"), P()), out_specs=(P("dp"), P()))
+            return jax.jit(st, donate_argnums=(0,))
+        """
+    )
+    assert rules_of(fs) == ["GL008"]
+
+
+def test_gl008_fires_through_wrapper_and_conditional_spec():
+    # the resident-ring idiom: spec = P(None, "dp") if cond else P(); a
+    # wrapper unpacks the shard_map tuple, rebuilds a dict, and returns it
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh, shard_envs):
+            spec = P(None, "dp") if shard_envs else P()
+
+            def body(s, b):
+                return s, b.sum()
+
+            st = shard_map(body, mesh=mesh, in_specs=(spec, P()), out_specs=(spec, P()))
+
+            def packed(state, blob):
+                storage, tot = st(state["storage"], blob)
+                new_state = {"storage": storage}
+                return new_state, tot
+
+            return jax.jit(packed, donate_argnums=(0,))
+        """
+    )
+    assert rules_of(fs) == ["GL008"]
+
+
+def test_gl008_fires_on_conditional_donation():
+    # `donate_argnums=(0,) if donate else ()` must be treated as donating
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh, donate):
+            def body(x):
+                return x * 2
+
+            st = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+            return jax.jit(st, donate_argnums=(0,) if donate else ())
+        """
+    )
+    assert rules_of(fs) == ["GL008"]
+
+
+def test_gl008_quiet_on_replicated_out_specs():
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh):
+            def body(x, p):
+                return x.sum(), p
+
+            st = shard_map(body, mesh=mesh, in_specs=(P("dp"), P()), out_specs=(P(), P()))
+            return jax.jit(st, donate_argnums=(0,))
+        """
+    )
+    assert rules_of(fs) == []
+
+
+def test_gl008_quiet_when_pinned():
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh):
+            def body(x, p):
+                return x * 2, p
+
+            st = shard_map(body, mesh=mesh, in_specs=(P("dp"), P()), out_specs=(P("dp"), P()))
+            out = NamedSharding(mesh, P("dp"))
+            return jax.jit(st, donate_argnums=(0,), out_shardings=(out, None))
+        """
+    )
+    assert rules_of(fs) == []
+
+
+def test_gl008_quiet_without_donation():
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh):
+            def body(x, p):
+                return x * 2, p
+
+            st = shard_map(body, mesh=mesh, in_specs=(P("dp"), P()), out_specs=(P("dp"), P()))
+            return jax.jit(st)
+        """
+    )
+    assert rules_of(fs) == []
+
+
+def test_gl008_sharded_factory_does_not_indict_replicated_neighbor():
+    # name maps are frame-scoped: `st` sharded in one factory must not make
+    # the other factory's replicated `st` fire
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make_sharded(mesh):
+            def body(x):
+                return x * 2
+
+            st = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+            out = __import__("jax").sharding.NamedSharding(mesh, P("dp"))
+            return jax.jit(st, donate_argnums=(0,), out_shardings=out)
+
+        def make_replicated(mesh):
+            def body(x):
+                return x.sum()
+
+            st = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P())
+            return jax.jit(st, donate_argnums=(0,))
+        """
+    )
+    assert rules_of(fs) == []
+
+
+def test_gl008_suppressible():
+    fs = lint(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def make(mesh):
+            def body(x):
+                return x * 2
+
+            st = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+            return jax.jit(st, donate_argnums=(0,))  # graft-lint: disable=GL008
+        """
+    )
+    assert rules_of(fs) == []

@@ -211,3 +211,81 @@ def test_comm_wire_guard_rides_the_ledger():
     with pytest.warns(UserWarning, match="grad_reduce_dtype changed"):
         set_grad_reduce_dtype("float32")  # mid-run flip
     set_grad_reduce_dtype("float32", fresh_run=True)  # leave clean state
+
+
+# --------------------------------------------------------------------------- #
+# JSON dump artifact (SHEEPRL_TPU_TRACECHECK_DUMP / bench lanes / the
+# `python -m sheeprl_tpu.analysis tracecheck <path>` validator)
+# --------------------------------------------------------------------------- #
+
+
+def test_dump_payload_and_file_round_trip(tc, tmp_path):
+    import json
+
+    f = tc.instrument(jax.jit(lambda x: x * 2), name="hot", warmup=1, budget=0)
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)))
+    tc.record_event("wire_dtype", "bfloat16")
+    path = tmp_path / "ledger.json"
+    payload = tc.dump(str(path))
+    assert payload["entries"]["hot"]["compiles"] == 1
+    assert payload["post_warmup_retraces"] == {}
+    assert payload["events"]["wire_dtype"] == ["'bfloat16'"]
+    on_disk = json.loads(path.read_text())
+    assert on_disk == payload
+
+
+def test_dump_cli_validator_exit_contract(tc, tmp_path):
+    import subprocess
+    import sys
+
+    clean = tc.instrument(jax.jit(lambda x: x * 2), name="clean", warmup=2, budget=0)
+    clean(jnp.ones((4,)))
+    path = tmp_path / "ok.json"
+    tc.dump(str(path))
+    r = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.analysis", "tracecheck", str(path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    # a hot path over its post-warmup budget must fail the validator
+    tc.configure(mode="warn")
+    bad = tc.instrument(jax.jit(lambda x: x * 3), name="bad", warmup=1, budget=0)
+    with pytest.warns(RuntimeWarning):
+        bad(jnp.ones((4,)))
+        bad(jnp.ones((5,)))  # post-warmup retrace
+    path2 = tmp_path / "bad.json"
+    tc.dump(str(path2))
+    r2 = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.analysis", "tracecheck", str(path2)],
+        capture_output=True, text=True,
+    )
+    assert r2.returncode == 1
+    assert "RETRACE bad" in r2.stdout
+
+
+def test_dump_env_var_registers_atexit_export(tmp_path):
+    import json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    path = tmp_path / "exit.json"
+    code = textwrap.dedent(
+        """
+        import jax, jax.numpy as jnp
+        # the process-wide singleton reads the env at construction and
+        # registers the atexit export (a fresh TraceCheck would register a
+        # SECOND atexit dump to the same path and race it)
+        from sheeprl_tpu.analysis.tracecheck import tracecheck
+        f = tracecheck.instrument(jax.jit(lambda x: x + 1), name="exit_hot")
+        f(jnp.ones((2,)))
+        """
+    )
+    env = {**os.environ, "SHEEPRL_TPU_TRACECHECK_DUMP": str(path), "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    payload = json.loads(path.read_text())
+    assert payload["entries"]["exit_hot"]["calls"] == 1
