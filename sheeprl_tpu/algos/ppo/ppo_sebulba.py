@@ -39,6 +39,18 @@ already nondeterministic across runs because queue interleaving is), and the
 in-graph divergence sentinel skips/rolls back exactly as in ``ppo``, with a
 forced re-publish after a rollback so actors never keep acting on diverged
 params.
+
+The actor pool runs SUPERVISED (:class:`~sheeprl_tpu.fault.supervisor.
+Supervisor`, ``fault.supervisor.*``): per-step heartbeat leases detect hangs,
+crashed actors are restarted on FRESH envs (bounded, exponential backoff;
+the replacement pulls a fresh ``ParamServer`` snapshot and reuses the SAME
+compiled ``act``/``traj`` programs — an actor restart costs zero retraces),
+exhausted budgets degrade the pool to the survivors
+(``Pipeline/actor_deaths`` / ``Pipeline/actors_live``), zero survivors abort
+with a typed error, the learner's queue reads are deadline-guarded, and
+shutdown joins under the supervisor's budget naming any abandoned hung
+actor. Chaos points ``ppo_sebulba.actor{N}.step`` make all of it provable
+(``pytest -m chaos``).
 """
 
 from __future__ import annotations
@@ -46,7 +58,6 @@ from __future__ import annotations
 import copy
 import os
 import queue as _queue
-import threading
 import warnings
 from functools import partial
 from typing import Any, Dict, List
@@ -62,6 +73,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_train_step
 from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.fault.inject import arm_from_cfg, fault_point
 from sheeprl_tpu.ops import gae as gae_op
 from sheeprl_tpu.parallel.pipeline import (
     DoubleBufferedStager,
@@ -69,6 +81,7 @@ from sheeprl_tpu.parallel.pipeline import (
     PipelineStats,
     RolloutQueue,
     staleness_bound,
+    supervised_actor_pool,
 )
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, build_aggregator
@@ -287,8 +300,10 @@ def main(fabric, cfg: Dict[str, Any]):
     rollout_q = RolloutQueue(queue_depth, stats=stats)
     param_server = ParamServer(params, publish_every=publish_every, stats=stats)
     param_server.publish(params)  # version 1 = the initial/restored weights
-    stop_event = threading.Event()
-    actor_errors: List[BaseException] = []
+    supervisor, _handoff_deadline = supervised_actor_pool(
+        (cfg.get("fault") or {}).get("supervisor"), "ppo-sebulba-actors", stats
+    )
+    arm_from_cfg(cfg)  # deterministic chaos drills (no-op unless fault.chaos armed)
     # in-flight items per actor = env_groups (a rollout slices into that many)
     bound = staleness_bound(queue_depth, num_actors * env_groups, publish_every)
 
@@ -320,7 +335,9 @@ def main(fabric, cfg: Dict[str, Any]):
     )
     eye_rows = [np.eye(int(d), dtype=np.float32) for d in actions_dim] if not is_continuous else None
 
-    def actor_fn(aid: int, envs) -> None:
+    def actor_fn(aid: int, ctx) -> None:
+        envs = actor_envs[aid]  # slot re-homed with FRESH envs before a restart
+        chaos_point = f"ppo_sebulba.actor{aid}.step"  # hoisted off the step loop
         try:
             device = actor_devs[aid % len(actor_devs)]
             # ring must cover every slab that can be live at once: queued
@@ -341,7 +358,9 @@ def main(fabric, cfg: Dict[str, Any]):
             for k in obs_keys:
                 space = observation_space[k]
                 template[k] = ((T, num_envs, *space.shape), space.dtype)
-            rng = jax.random.fold_in(actor_rng_base, aid)
+            # fold the generation in so a restarted actor explores a fresh
+            # stream instead of replaying its predecessor's draws
+            rng = jax.random.fold_in(jax.random.fold_in(actor_rng_base, aid), ctx.generation)
             # filter reset obs to the encoder keys — extra keys would give the
             # first act_fn dispatch its own one-off compiled signature
             reset_obs = envs.reset(seed=cfg.seed + aid * batch_envs)[0]
@@ -349,7 +368,7 @@ def main(fabric, cfg: Dict[str, Any]):
             groups = [(g * num_envs, (g + 1) * num_envs) for g in range(env_groups)]
 
             local_iter = 0
-            while not stop_event.is_set():
+            while not ctx.cancelled:
                 local_iter += 1
                 version, p_snapshot = param_server.pull(device)
                 slabs = [stager.acquire(template) for _ in range(env_groups)]
@@ -359,6 +378,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 _keys = jax.device_get(jax.random.split(rng, T + 1))
                 rng, _step_keys = _keys[0], _keys[1:]
                 for t in range(T):
+                    if ctx.cancelled:
+                        # a superseded (hung-then-woken) generation must exit
+                        # mid-rollout, never finish and ship stale data next
+                        # to its replacement's
+                        return
+                    ctx.beat()  # renew the heartbeat lease: silent == hung
+                    fault_point(chaos_point)  # chaos: kill/hang-at-step
                     for g, (lo, hi) in enumerate(groups):
                         for k in obs_keys:
                             slabs[g][k][t] = next_obs[k][lo:hi]
@@ -414,6 +440,10 @@ def main(fabric, cfg: Dict[str, Any]):
                             for e in np.nonzero(mask)[0]:
                                 ep_infos[int(e) // num_envs].append((float(rews[e]), float(lens[e])))
 
+                if ctx.cancelled:
+                    # cancelled at the rollout boundary: the queue's fast path
+                    # would accept a stale item — never ship one
+                    return
                 # Per group: ONE batched trajectory forward recomputes
                 # logprobs/values for all T*N transitions under the SAME
                 # snapshot the rollout acted with, then GAE — on the actor
@@ -439,25 +469,33 @@ def main(fabric, cfg: Dict[str, Any]):
                     if nan_injector:
                         nan_injector.poison(flat_data, "advantages", local_iter)
                     staged = stager.ship(flat_data)
+                    # ctx doubles as the stop flag; beat while back-pressured
+                    # so a stalled-but-healthy actor is never called hung
                     if not rollout_q.put(
                         {"actor_id": aid, "data": staged, "ep_infos": ep_infos[g], "version": version},
-                        stop_event=stop_event,
+                        stop_event=ctx,
+                        beat=ctx.beat,
                     ):
                         return
-        except BaseException as e:  # surface crashes to the learner
-            actor_errors.append(e)
-        finally:
+        finally:  # crashes propagate to the supervisor (restart/degrade/abort)
             try:
                 envs.close()
             except Exception:
                 pass
 
-    actor_threads = [
-        threading.Thread(target=actor_fn, args=(a, actor_envs[a]), name=f"sebulba-actor-{a}", daemon=True)
-        for a in range(num_actors)
-    ]
-    for t in actor_threads:
-        t.start()
+    def _rehome_actor(aid: int, ctx) -> None:
+        # State re-homing before a restart: the replacement gets FRESH envs
+        # (the dead generation's are closed or wedged) and builds its own
+        # stager ring inside actor_fn; it pulls a fresh ParamServer snapshot
+        # at its loop top and reuses the SAME compiled act/traj programs.
+        actor_envs[aid] = vectorize_env(env_cfg, cfg.seed + aid * batch_envs, rank, None, prefix="train")
+
+    for a in range(num_actors):
+        supervisor.spawn(
+            name=f"sebulba-actor-{a}",
+            target=partial(actor_fn, a),
+            on_restart=partial(_rehome_actor, a),
+        )
 
     # -- learner loop --------------------------------------------------------
     lr = lr0
@@ -490,13 +528,13 @@ def main(fabric, cfg: Dict[str, Any]):
 
     try:
         while iter_num < total_iters:
-            if actor_errors:  # surface a crashed actor NOW, not at run end
-                raise actor_errors[0]
+            # one supervision pass per learner tick: restart crashed/hung
+            # actors (state re-homed), degrade past the budget, abort with a
+            # typed error at zero survivors — never a silent learner spin
+            supervisor.check()
             try:
-                item = rollout_q.get(timeout=0.5)
+                item = rollout_q.get(timeout=0.5, deadline_s=_handoff_deadline(), diagnose=supervisor.describe)
             except _queue.Empty:
-                if all(not t.is_alive() for t in actor_threads):
-                    raise RuntimeError("All Sebulba actor threads exited before training finished")
                 continue
             iter_num += 1
             policy_step += policy_steps_per_iter
@@ -558,6 +596,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     aggregator.reset()
                 pipe_metrics = stats.snapshot()
                 pipe_metrics["Pipeline/queue_depth"] = rollout_q.qsize()
+                # learner-visible pool health: deaths/restarts/hangs/live
+                pipe_metrics.update(supervisor.metrics("Pipeline/", "actor"))
                 logger.log_dict(pipe_metrics, policy_step)
                 logger.log_dict(
                     {"Info/learning_rate": lr, "Info/clip_coef": clip_coef, "Info/ent_coef": ent_coef},
@@ -589,15 +629,22 @@ def main(fabric, cfg: Dict[str, Any]):
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
                 fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_checkpoint_state(iter_num))
     finally:
-        stop_event.set()
+        # supervised shutdown: stop, drain, join under the configured budget;
+        # a hung actor is logged and abandoned BY NAME, never silently leaked
+        pool_metrics = supervisor.metrics("Pipeline/", "actor")  # pre-shutdown pool state
+        supervisor.request_stop()
         rollout_q.drain()
-        for t in actor_threads:
-            t.join(timeout=30.0)
+        supervisor.join()
 
-    if actor_errors:
-        raise actor_errors[0]
     if os.environ.get("SHEEPRL_SEBULBA_DEBUG"):  # pipeline-balance dump for bench tuning
-        print("SEBULBA_STATS", {**stats.snapshot(), "staleness_max": stats.max_staleness_seen})
+        print(
+            "SEBULBA_STATS",
+            {
+                **stats.snapshot(),
+                **pool_metrics,
+                "staleness_max": stats.max_staleness_seen,
+            },
+        )
     if stats.max_staleness_seen > 2 * bound:  # pragma: no cover - invariant guard
         # the steady-state bound tolerates transient jitter (see
         # pipeline.staleness_bound); a 2x breach means the pipeline is

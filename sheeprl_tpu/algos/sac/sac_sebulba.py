@@ -49,6 +49,20 @@ through ``on_checkpoint_coupled`` with the ring state
 device train-key stream) in the ``rb`` sidecar, and
 ``checkpoint.resume_from=latest`` restoring counters, params, the ring, and
 BOTH RNG streams (the actor base key and the in-ring train-key stream).
+
+The actor pool runs SUPERVISED (:class:`~sheeprl_tpu.fault.supervisor.
+Supervisor`, ``fault.supervisor.*``): every actor thread heartbeats a
+deadline lease per env step; a crashed actor is restarted (bounded, with
+exponential backoff) on FRESH envs — the old generation's batch is gone or
+wedged — pulling a fresh ``ParamServer`` snapshot at its loop top; a hung
+actor (lease expiry) is abandoned and replaced the same way. Past the
+restart budget the pool degrades to the survivors (visible as
+``Pipeline/actor_deaths`` / ``Pipeline/actors_live``); zero survivors abort
+with a typed error, and the learner's queue reads are deadline-guarded
+(``HandoffTimeoutError`` with per-actor diagnostics) instead of an unbounded
+poll. Shutdown joins through the supervisor's budget, naming any abandoned
+hung actor. All of it is provable via the deterministic chaos points
+``sac_sebulba.actor{N}.step`` (``pytest -m chaos``).
 """
 
 from __future__ import annotations
@@ -59,6 +73,7 @@ import queue as _queue
 import threading
 import time
 import warnings
+from functools import partial
 from typing import Any, Dict, List
 
 import gymnasium as gym
@@ -71,11 +86,13 @@ from sheeprl_tpu.algos.sac.sac import make_resident_train_step, restore_train_st
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.envs.factory import vectorize_env
+from sheeprl_tpu.fault.inject import arm_from_cfg, fault_point
 from sheeprl_tpu.parallel.pipeline import (
     ParamServer,
     PipelineStats,
     RolloutQueue,
     staleness_bound,
+    supervised_actor_pool,
 )
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
@@ -316,8 +333,10 @@ def main(fabric, cfg: Dict[str, Any]):
     rollout_q = RolloutQueue(queue_depth, stats=stats)
     param_server = ParamServer(params["actor"], publish_every=publish_every, stats=stats)
     param_server.publish(params["actor"])  # version 1 = initial/restored weights
-    stop_event = threading.Event()
-    actor_errors: List[BaseException] = []
+    supervisor, _handoff_deadline = supervised_actor_pool(
+        (cfg.get("fault") or {}).get("supervisor"), "sac-sebulba-actors", stats
+    )
+    arm_from_cfg(cfg)  # deterministic chaos drills (no-op unless fault.chaos armed)
     bound = staleness_bound(queue_depth, num_actors, publish_every)
     # The first post-prefill grant replays the whole prefill backlog: the
     # learner publishes ceil(backlog / (publish_every * grad_max)) times
@@ -342,21 +361,27 @@ def main(fabric, cfg: Dict[str, Any]):
         warmup=num_actors + 1, transfer_guard=False,
     )
 
-    def actor_fn(aid: int, envs) -> None:
+    def actor_fn(aid: int, ctx) -> None:
+        envs = actor_envs[aid]  # slot re-homed with FRESH envs before a restart
+        chaos_point = f"sac_sebulba.actor{aid}.step"  # hoisted off the step loop
         try:
             device = actor_devs[aid % len(actor_devs)]
-            rng = jax.random.fold_in(actor_rng_base, aid)
+            # fold the generation in so a restarted actor explores a fresh
+            # stream instead of replaying its predecessor's draws
+            rng = jax.random.fold_in(jax.random.fold_in(actor_rng_base, aid), ctx.generation)
             obs = envs.reset(seed=cfg.seed + aid * num_envs)[0]
-            rows: List[Dict[str, np.ndarray]] = []
-            ep_infos: List[Any] = []
-            while not stop_event.is_set():
+            rows: list = []
+            ep_infos: list = []
+            while not ctx.cancelled:
                 version, actor_params = param_server.pull(device)
                 # ONE host-side split serves the whole block
                 _keys = jax.device_get(jax.random.split(rng, block + 1))
                 rng, step_keys = _keys[0], _keys[1:]
                 for t in range(block):
-                    if stop_event.is_set():
+                    if ctx.cancelled:
                         return
+                    ctx.beat()  # renew the heartbeat lease: silent == hung
+                    fault_point(chaos_point)  # chaos: kill/hang-at-step
                     with produced_lock:
                         produced["iters"] += 1
                         my_iter = produced["iters"]
@@ -398,27 +423,39 @@ def main(fabric, cfg: Dict[str, Any]):
                         }
                     )
                     obs = next_obs
+                if ctx.cancelled:
+                    # cancelled at the block boundary: the queue's fast path
+                    # would accept a stale blob — never ship one
+                    return
                 # pack + stage on the actor thread: the learner only ever sees
                 # a committed device blob (its critical path has no host copy)
                 blob = learner_fabric.put_replicated(drb.pack_rows(rows))
                 item = {"blob": blob, "count": len(rows), "version": version, "ep_infos": ep_infos}
                 rows, ep_infos = [], []
-                if not rollout_q.put(item, stop_event=stop_event):
+                # ctx doubles as the stop flag; beat while back-pressured so
+                # a stalled-but-healthy actor is never mistaken for hung
+                if not rollout_q.put(item, stop_event=ctx, beat=ctx.beat):
                     return
-        except BaseException as e:  # surface crashes to the learner
-            actor_errors.append(e)
-        finally:
+        finally:  # crashes propagate to the supervisor (restart/degrade/abort)
             try:
                 envs.close()
             except Exception:
                 pass
 
-    actor_threads = [
-        threading.Thread(target=actor_fn, args=(a, actor_envs[a]), name=f"sac-sebulba-actor-{a}", daemon=True)
-        for a in range(num_actors)
-    ]
-    for t in actor_threads:
-        t.start()
+    def _rehome_actor(aid: int, ctx) -> None:
+        # State re-homing before a restart: the dead generation's envs are
+        # closed (crash) or leaked with their wedged thread (hang) — either
+        # way the replacement acts on FRESH envs rebuilt from the config and
+        # a fresh ParamServer snapshot at its loop top. The logging-env slot
+        # is not re-attached (the original writer may still hold it).
+        actor_envs[aid] = vectorize_env(cfg, cfg.seed + aid * num_envs, rank, None, prefix="train")
+
+    for a in range(num_actors):
+        supervisor.spawn(
+            name=f"sac-sebulba-actor-{a}",
+            target=partial(actor_fn, a),
+            on_restart=partial(_rehome_actor, a),
+        )
 
     # -- learner loop --------------------------------------------------------
     params_live, aopt_live, copt_live, lopt_live = params, aopt, copt, lopt
@@ -444,13 +481,13 @@ def main(fabric, cfg: Dict[str, Any]):
 
     try:
         while iter_num < total_iters:
-            if actor_errors:  # surface a crashed actor NOW, not at run end
-                raise actor_errors[0]
+            # one supervision pass per learner tick: restart crashed/hung
+            # actors (state re-homed), degrade past the budget, abort with a
+            # typed error at zero survivors — never a silent learner spin
+            supervisor.check()
             try:
-                item = rollout_q.get(timeout=0.5)
+                item = rollout_q.get(timeout=0.5, deadline_s=_handoff_deadline(), diagnose=supervisor.describe)
             except _queue.Empty:
-                if all(not t.is_alive() for t in actor_threads):
-                    raise RuntimeError("All sac_sebulba actor threads exited before training finished")
                 continue
             count = int(item["count"])
             stats.observe_staleness(param_server.version - item["version"])
@@ -533,6 +570,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     aggregator.reset()
                 pipe_metrics = stats.snapshot()
                 pipe_metrics["Pipeline/queue_depth"] = rollout_q.qsize()
+                # learner-visible pool health: deaths/restarts/hangs/live
+                pipe_metrics.update(supervisor.metrics("Pipeline/", "actor"))
                 logger.log_dict(pipe_metrics, policy_step)
                 logger.log_dict(drb.metrics(), policy_step)
                 if guard and sentinel.total_skipped:
@@ -568,18 +607,19 @@ def main(fabric, cfg: Dict[str, Any]):
                     replay_buffer=drb.state_dict() if cfg.buffer.checkpoint else None,
                 )
     finally:
-        stop_event.set()
+        # supervised shutdown: stop, drain, join under the configured budget;
+        # a hung actor is logged and abandoned BY NAME, never silently leaked
+        pool_metrics = supervisor.metrics("Pipeline/", "actor")  # pre-shutdown pool state
+        supervisor.request_stop()
         rollout_q.drain()
-        for t in actor_threads:
-            t.join(timeout=30.0)
+        supervisor.join()
 
-    if actor_errors:
-        raise actor_errors[0]
     if os.environ.get("SHEEPRL_SEBULBA_DEBUG"):  # pipeline-balance dump for bench/test tuning
         print(
             "SAC_SEBULBA_STATS",
             {
                 **stats.snapshot(),
+                **pool_metrics,
                 "staleness_max": stats.max_staleness_seen,
                 "policy_steps": policy_step,
                 "grad_steps": cumulative_grad_steps,

@@ -3,12 +3,22 @@
 Atomic/async checkpointing with manifest-published auto-resume
 (:mod:`~sheeprl_tpu.fault.manager`), divergence sentinels around the
 jittable finite guard (:mod:`~sheeprl_tpu.fault.sentinel`), self-healing
-vector-env workers (:mod:`~sheeprl_tpu.fault.watchdog`) and the
-deterministic fault-injection harness that keeps all of it tested
+vector-env workers (:mod:`~sheeprl_tpu.fault.watchdog`), the thread
+supervision runtime for the async tiers — heartbeat leases, bounded
+restarts, restart→degrade→abort escalation
+(:mod:`~sheeprl_tpu.fault.supervisor`) — and the deterministic
+fault/chaos-injection harness that keeps all of it tested
 (:mod:`~sheeprl_tpu.fault.inject`). See ``howto/fault_tolerance.md``.
 """
 
-from sheeprl_tpu.fault.inject import FaultInjected, FlakyEnv, NaNInjector, fault_point
+from sheeprl_tpu.fault.inject import (
+    FaultInjected,
+    FlakyEnv,
+    NaNInjector,
+    ThreadKilled,
+    arm_from_cfg,
+    fault_point,
+)
 from sheeprl_tpu.fault.manager import (
     CheckpointManager,
     find_latest_run_checkpoint,
@@ -17,10 +27,19 @@ from sheeprl_tpu.fault.manager import (
     read_manifest,
 )
 from sheeprl_tpu.fault.sentinel import DivergenceError, DivergenceSentinel
+from sheeprl_tpu.fault.supervisor import (
+    AllWorkersDeadError,
+    HungWorkerError,
+    SupervisionError,
+    Supervisor,
+    WorkerAbortError,
+    WorkerContext,
+)
 from sheeprl_tpu.fault.watchdog import EnvTimeoutError, SelfHealingEnv
 from sheeprl_tpu.utils.checkpoint import CheckpointError
 
 __all__ = [
+    "AllWorkersDeadError",
     "CheckpointError",
     "CheckpointManager",
     "DivergenceError",
@@ -28,8 +47,15 @@ __all__ = [
     "EnvTimeoutError",
     "FaultInjected",
     "FlakyEnv",
+    "HungWorkerError",
     "NaNInjector",
     "SelfHealingEnv",
+    "SupervisionError",
+    "Supervisor",
+    "ThreadKilled",
+    "WorkerAbortError",
+    "WorkerContext",
+    "arm_from_cfg",
     "fault_point",
     "find_latest_run_checkpoint",
     "latest_complete",

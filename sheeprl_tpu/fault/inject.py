@@ -11,7 +11,21 @@ builders round out the toolbox:
   2nd time the ``checkpoint.pre_commit`` probe fires (comma-separate to arm
   several points);
 - ``arm("checkpoint.staged", at=1)`` — raise ``FaultInjected`` in-process;
-- ``truncate_file`` / ``scramble_file`` — simulate torn/corrupted writes;
+  further actions cover the supervised async runtime: ``kill-thread`` raises
+  :class:`ThreadKilled` (a ``BaseException`` — routine per-item ``except
+  Exception`` recovery can't swallow it, only the supervision layer sees the
+  death) and ``hang`` stalls the calling thread for ``hang_s`` seconds
+  (releasable via :func:`release_hangs`) so heartbeat-lease expiry and
+  queue-stall paths are provable;
+- ``arm_from_cfg(cfg)`` — arm a whole CHAOS SCHEDULE from
+  ``cfg.fault.chaos``: ``events`` are ``"point:action:at[:hang_s]"`` specs
+  where ``at`` may be a literal hit number or a ``"lo-hi"`` range drawn from
+  the seeded per-(seed, point) stream — deterministic across runs, varied
+  across seeds;
+- ``truncate_file`` / ``scramble_file`` / ``corrupt_checkpoint_arrays`` —
+  simulate torn/corrupted writes (the last one rots a checkpoint BELOW its
+  manifest digest: the save stays "complete" by manifest but ``load_state``
+  fails — the case that can wedge a naive checkpoint watcher forever);
 - ``NaNInjector`` — poison training data at chosen iterations so the
   divergence sentinel path is exercised end-to-end;
 - ``FlakyEnv`` — an env wrapper whose ``step``/``reset`` raises or hangs on
@@ -25,6 +39,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -33,32 +48,56 @@ import gymnasium as gym
 
 __all__ = [
     "FaultInjected",
+    "ThreadKilled",
     "fault_point",
     "arm",
+    "arm_from_cfg",
     "disarm",
     "reset",
+    "release_hangs",
     "truncate_file",
     "scramble_file",
+    "corrupt_checkpoint_arrays",
+    "plant_torn_checkpoint",
     "NaNInjector",
     "FlakyEnv",
 ]
 
 KILL_ENV_VAR = "SHEEPRL_FAULT_KILL"
+ARM_ENV_VAR = "SHEEPRL_FAULT_ARM"
 NAN_ENV_VAR = "SHEEPRL_FAULT_NAN_AT"
 
+_ACTIONS = ("raise", "kill", "kill-thread", "hang")
+
 _counts: Dict[str, int] = {}
-_armed: Dict[str, Tuple[str, int]] = {}  # point -> (action, fire-on-Nth-hit)
+_armed: Dict[str, Tuple[str, int, float]] = {}  # point -> (action, Nth-hit, hang_s)
+_hang_release = threading.Event()
 
 
 class FaultInjected(RuntimeError):
     """Raised by an in-process-armed fault point."""
 
 
-def arm(point: str, action: str = "raise", at: int = 1) -> None:
-    """Arm ``point`` to fire on its ``at``-th hit. ``action``: "raise"|"kill"."""
-    if action not in ("raise", "kill"):
-        raise ValueError(f"Unknown fault action '{action}'")
-    _armed[point] = (action, int(at))
+class ThreadKilled(BaseException):
+    """Chaos-injected thread death.
+
+    Deliberately a ``BaseException``: per-item recovery code (``except
+    Exception`` around a poll/batch) must NOT be able to swallow it — it
+    models a thread dying outright, which only the supervision layer
+    (:class:`~sheeprl_tpu.fault.supervisor.Supervisor`) may observe and heal.
+    """
+
+
+def arm(point: str, action: str = "raise", at: int = 1, hang_s: float = 5.0) -> None:
+    """Arm ``point`` to fire on its ``at``-th hit.
+
+    ``action``: ``raise`` (:class:`FaultInjected`), ``kill`` (SIGKILL the
+    process), ``kill-thread`` (:class:`ThreadKilled`), or ``hang`` (stall the
+    calling thread ``hang_s`` seconds, then return — a lease-expiry / stall
+    injection, not a crash)."""
+    if action not in _ACTIONS:
+        raise ValueError(f"Unknown fault action '{action}' (one of {_ACTIONS})")
+    _armed[point] = (action, int(at), float(hang_s))
     _counts.pop(point, None)
 
 
@@ -69,13 +108,65 @@ def disarm(point: Optional[str] = None) -> None:
         _armed.pop(point, None)
 
 
+def release_hangs() -> None:
+    """Wake every thread currently stalled in a ``hang`` fault point (and any
+    future one until the next :func:`reset`) — test teardown's escape hatch."""
+    _hang_release.set()
+
+
 def reset() -> None:
     """Clear all armed points and hit counters (test isolation)."""
+    global _hang_release
     _armed.clear()
     _counts.clear()
+    _hang_release.set()  # release any thread still stalled in a hang
+    _hang_release = threading.Event()
 
 
-def _env_spec(point: str) -> Optional[Tuple[str, int]]:
+def _parse_event(token: str, seed: int = 0) -> Optional[Tuple[str, str, int, float]]:
+    """``"point:action:at[:hang_s]"`` -> (point, action, at, hang_s); ``at``
+    may be ``"lo-hi"``, drawn deterministically from the (seed, point) pair."""
+    parts = [p.strip() for p in token.strip().split(":")]
+    if not parts or not parts[0]:
+        return None
+    point = parts[0]
+    action = parts[1] if len(parts) > 1 and parts[1] else "raise"
+    at_raw = parts[2] if len(parts) > 2 and parts[2] else "1"
+    hang_s = float(parts[3]) if len(parts) > 3 and parts[3] else 5.0
+    if "-" in at_raw:
+        import numpy as np
+
+        lo, hi = (int(x) for x in at_raw.split("-", 1))
+        # per-(seed, point) stream: adding an event never reshuffles another's
+        rng = np.random.default_rng([seed, *point.encode()])
+        at = int(rng.integers(lo, hi + 1))
+    else:
+        at = int(at_raw)
+    return point, action, at, hang_s
+
+
+def arm_from_cfg(cfg: Any) -> int:
+    """Arm the deterministic chaos schedule in ``cfg.fault.chaos`` (plus any
+    ``SHEEPRL_FAULT_ARM`` env events); returns how many points were armed.
+    A no-op (one dict probe) unless ``fault.chaos.enabled``."""
+    armed = 0
+    chaos = ((cfg.get("fault") or {}).get("chaos") or {}) if cfg is not None else {}
+    if chaos.get("enabled", False):
+        seed = int(chaos.get("seed", 0) or 0)
+        for token in chaos.get("events") or ():
+            spec = _parse_event(str(token), seed=seed)
+            if spec is not None:
+                arm(spec[0], action=spec[1], at=spec[2], hang_s=spec[3])
+                armed += 1
+    for token in os.environ.get(ARM_ENV_VAR, "").split(","):
+        spec = _parse_event(token) if token.strip() else None
+        if spec is not None:
+            arm(spec[0], action=spec[1], at=spec[2], hang_s=spec[3])
+            armed += 1
+    return armed
+
+
+def _env_spec(point: str) -> Optional[Tuple[str, int, float]]:
     raw = os.environ.get(KILL_ENV_VAR, "")
     if not raw:
         return None
@@ -85,7 +176,7 @@ def _env_spec(point: str) -> Optional[Tuple[str, int]]:
             continue
         name, _, at = token.partition(":")
         if name == point:
-            return ("kill", int(at) if at else 1)
+            return ("kill", int(at) if at else 1, 0.0)
     return None
 
 
@@ -94,12 +185,19 @@ def fault_point(point: str) -> None:
     spec = _armed.get(point) or _env_spec(point)
     if spec is None:
         return
-    action, at = spec
+    action, at, hang_s = spec
     _counts[point] = _counts.get(point, 0) + 1
     if _counts[point] != at:
         return
     if action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)  # the preemption model: no cleanup
+    if action == "hang":
+        # stall (lease expiry / queue stall), then RETURN: the woken thread
+        # proceeds and must notice its supervision verdict (ctx.cancelled)
+        _hang_release.wait(hang_s)
+        return
+    if action == "kill-thread":
+        raise ThreadKilled(f"thread killed at '{point}' (hit {at})")
     raise FaultInjected(f"fault injected at '{point}' (hit {at})")
 
 
@@ -118,6 +216,76 @@ def scramble_file(path: "str | Path", seed: int = 0) -> None:
     rng = np.random.default_rng(seed)
     with open(path, "wb") as f:
         f.write(rng.integers(0, 256, size=size, dtype=np.uint8).tobytes())
+
+
+def plant_torn_checkpoint(
+    ckpt_dir: "str | Path", name: str, state: Any, step: Optional[int] = None, seed: int = 0
+) -> Path:
+    """Install a manifest-published checkpoint that is ALREADY rotten.
+
+    The save is built in a staging directory, its arrays scrambled
+    (:func:`corrupt_checkpoint_arrays`), and only then moved into
+    ``ckpt_dir`` and published — so a concurrent watcher can never observe a
+    loadable intermediate state. This is the deterministic form of the
+    post-publish bit-rot scenario: manifest says complete, digest matches,
+    ``load_state`` fails. Returns the installed path."""
+    import shutil
+    import tempfile
+
+    from sheeprl_tpu.fault import manager as _manager
+
+    ckpt_dir = Path(ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    if step is None:
+        step = _manager._parse_step(name) or 0
+    # same filesystem so the installs below are renames, not copies
+    with tempfile.TemporaryDirectory(dir=ckpt_dir.parent, prefix="torn_staging_") as staging:
+        staged = Path(staging) / name
+        _manager.CheckpointManager().save(staged, dict(state), step=int(step), publish=False)
+        if corrupt_checkpoint_arrays(staged, seed=seed) == 0:
+            raise RuntimeError(
+                f"checkpoint {staged} keeps its arrays inline — plant_torn_checkpoint needs the "
+                "sidecar layout to rot below the manifest digest"
+            )
+        target = ckpt_dir / name
+        # arrays first: a bare-scan discovery of the meta must already see
+        # the (corrupt) sidecar, never a complete-looking save
+        shutil.move(str(staged) + ".arrays", str(target) + ".arrays")
+        shutil.move(str(staged), str(target))
+    entries = _manager.read_manifest(ckpt_dir)
+    entries = [e for e in entries if e.get("file") != name]
+    entries.append(
+        {
+            "file": name,
+            "step": int(step),
+            "time": time.time(),
+            "format_version": 2,
+            "digest": _manager._digest(target),
+            "has_rb": False,
+        }
+    )
+    entries.sort(key=lambda e: (int(e.get("step", 0)), float(e.get("time", 0.0))))
+    _manager._write_manifest(ckpt_dir, entries)
+    return target
+
+
+def corrupt_checkpoint_arrays(path: "str | Path", seed: int = 0) -> int:
+    """Deep-corrupt a PUBLISHED checkpoint below its manifest digest.
+
+    The meta pickle (what the manifest digests) is left intact, so discovery
+    still reports the save complete — but every file in the ``.arrays``
+    sidecar is scrambled, so ``load_state`` fails. This is the watcher's
+    worst case: a checkpoint that looks publishable forever and never loads.
+    Returns the number of files scrambled (0 when the checkpoint keeps its
+    arrays inline in the meta — scramble the meta + re-stamp the manifest
+    digest by hand for that layout)."""
+    arrays = Path(str(path) + ".arrays")
+    scrambled = 0
+    if arrays.is_dir():
+        for f in sorted(p for p in arrays.rglob("*") if p.is_file()):
+            scramble_file(f, seed=seed + scrambled)
+            scrambled += 1
+    return scrambled
 
 
 # -- NaN injection -----------------------------------------------------------

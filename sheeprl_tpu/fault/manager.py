@@ -50,6 +50,7 @@ from sheeprl_tpu.utils.checkpoint import (
 __all__ = [
     "CheckpointManager",
     "read_manifest",
+    "complete_entries",
     "latest_complete",
     "find_latest_run_checkpoint",
     "load_resume_state",
@@ -151,6 +152,13 @@ def _complete_entries(ckpt_dir: Path) -> List[Tuple[float, int, Path]]:
                 step = _parse_step(p.name)
                 out[p] = (p.stat().st_mtime, step if step is not None else 0, p)
     return sorted(out.values(), key=lambda t: (t[1], t[0]))
+
+
+def complete_entries(ckpt_dir: "str | Path") -> List[Tuple[float, int, Path]]:
+    """Every complete checkpoint in ``ckpt_dir`` as ``(time, step, path)``,
+    oldest first — the ranked view consumers that must SKIP a bad newest
+    entry (e.g. the serve watcher's quarantine) iterate in reverse."""
+    return _complete_entries(Path(ckpt_dir))
 
 
 def latest_complete(ckpt_dir: "str | Path") -> Optional[Path]:

@@ -49,13 +49,46 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from sheeprl_tpu.fault.inject import fault_point
+
 __all__ = [
+    "HandoffTimeoutError",
     "PipelineStats",
     "RolloutQueue",
     "ParamServer",
     "DoubleBufferedStager",
     "staleness_bound",
+    "supervised_actor_pool",
 ]
+
+
+def supervised_actor_pool(sup_cfg: Optional[Dict[str, Any]], name: str, stats: "PipelineStats"):
+    """One ``fault.supervisor``-configured Supervisor for a Sebulba actor
+    pool, plus the learner-side handoff-deadline callable to pass to
+    :meth:`RolloutQueue.get` — shared by both Sebulba mains so the subtle
+    bits (the null-coercion of ``handoff_deadline_s`` and the first-item
+    ``grace_s`` widening while the actors' opening block pays XLA compiles)
+    exist exactly once. Returns ``(supervisor, handoff_deadline_fn)``."""
+    from sheeprl_tpu.fault.supervisor import Supervisor
+
+    sup_cfg = sup_cfg or {}
+    supervisor = Supervisor.from_config(sup_cfg, name=name)
+    handoff_deadline = float(sup_cfg.get("handoff_deadline_s", 120.0) or 0) or None
+
+    def _deadline() -> Optional[float]:
+        if handoff_deadline is None:
+            return None
+        return handoff_deadline + (0.0 if stats.rollouts_consumed else supervisor.grace_s)
+
+    return supervisor, _deadline
+
+
+class HandoffTimeoutError(RuntimeError):
+    """The consumer starved past its deadline on a queue whose producers are
+    nominally live — the 'actors hung/stuck' verdict, distinct from both
+    routine slowness (a bounded wait) and 'all actors dead' (the
+    supervisor's :class:`~sheeprl_tpu.fault.supervisor.AllWorkersDeadError`).
+    Carries the producer diagnostics the raiser passed in."""
 
 
 def staleness_bound(queue_depth: int, in_flight: int, publish_every: int) -> int:
@@ -148,13 +181,24 @@ class RolloutQueue:
         self.depth = depth
         self.stats = stats or PipelineStats()
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._starved_since: Optional[float] = None  # consumer-side deadline clock
 
     def qsize(self) -> int:
         return self._q.qsize()
 
-    def put(self, item: Any, stop_event: Optional[threading.Event] = None, poll_s: float = 0.05) -> bool:
+    def put(
+        self,
+        item: Any,
+        stop_event: Optional[threading.Event] = None,
+        poll_s: float = 0.05,
+        beat: Optional[Any] = None,
+    ) -> bool:
         """Enqueue; returns False (item dropped) if ``stop_event`` fires while
-        blocked on a full queue."""
+        blocked on a full queue. ``beat`` (a supervised producer's
+        ``ctx.beat``) is invoked each poll while blocked — back-pressure is
+        routine, and a stalled-but-healthy producer must keep renewing its
+        heartbeat lease or the supervisor would call it hung."""
+        fault_point("pipeline.queue.put")  # chaos: queue-stall / producer-kill injection
         try:
             self._q.put_nowait(item)
         except queue.Full:
@@ -164,6 +208,8 @@ class RolloutQueue:
                 if stop_event is not None and stop_event.is_set():
                     self.stats.add("actor_stall_s", time.perf_counter() - start)
                     return False
+                if beat is not None:
+                    beat()
                 try:
                     self._q.put(item, timeout=poll_s)
                     break
@@ -174,11 +220,44 @@ class RolloutQueue:
         self.stats.observe_depth(self._q.qsize())
         return True
 
-    def get(self, timeout: Optional[float] = None) -> Any:
+    def get(
+        self,
+        timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        diagnose: Optional[Any] = None,
+    ) -> Any:
         """Dequeue; raises ``queue.Empty`` on timeout. Starvation (any wait at
-        all) is charged to ``learner_starved_s``."""
+        all) is charged to ``learner_starved_s``.
+
+        ``deadline_s`` arms the deadline-guarded handoff: CONSECUTIVE empty
+        gets past the deadline raise :class:`HandoffTimeoutError` carrying
+        ``diagnose()`` (e.g. ``Supervisor.describe``) — the consumer fails
+        fast with producer diagnostics instead of polling forever against a
+        stuck pipeline. Any successful get resets the deadline clock."""
+        fault_point("pipeline.queue.get")  # chaos: consumer-side stall injection
         start = time.perf_counter()
-        item = self._q.get(timeout=timeout)
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            if deadline_s is not None:
+                if self._starved_since is None:
+                    self._starved_since = start
+                starved = time.perf_counter() - self._starved_since
+                if starved >= deadline_s:
+                    detail = ""
+                    if diagnose is not None:
+                        try:
+                            detail = f" Producers: {diagnose()}"
+                        except Exception:  # diagnostics must never mask the timeout
+                            pass
+                    raise HandoffTimeoutError(
+                        f"rollout handoff starved for {starved:.2f}s (deadline {deadline_s:g}s, "
+                        f"queue depth {self._q.qsize()}/{self.depth}, "
+                        f"{self.stats.rollouts_produced} produced / "
+                        f"{self.stats.rollouts_consumed} consumed).{detail}"
+                    ) from None
+            raise
+        self._starved_since = None
         waited = time.perf_counter() - start
         if waited > 1e-4:
             self.stats.add("learner_starved_s", waited)

@@ -25,12 +25,20 @@ Layers (``howto/serving.md`` is the operator guide):
   + :class:`CheckpointWatcher` (checkpoint-dir manifests → publishes);
 - :mod:`sheeprl_tpu.serve.server` — :class:`PolicyServer` assembly,
   in-process :class:`PolicyClient`, and the thin JSON-lines socket front end.
+
+Robustness: the scheduler worker and checkpoint watcher run SUPERVISED
+(:class:`~sheeprl_tpu.fault.supervisor.Supervisor` — restart-on-crash with
+the scheduler's in-flight batch recovered so admitted requests are never
+dropped), the watcher counts its swallowed poll errors
+(``Serve/watcher_errors``) and quarantines repeatedly-unloadable
+checkpoints, the socket front end answers ``{"health": true}`` probes, and
+SIGTERM/SIGINT trigger a graceful drain in the CLI.
 """
 
 from sheeprl_tpu.serve.engine import BucketEngine, JitEngine
 from sheeprl_tpu.serve.policy import ServePolicy
 from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeClosedError, ServeOverloadedError, ServeStats
-from sheeprl_tpu.serve.server import PolicyClient, PolicyServer
+from sheeprl_tpu.serve.server import PolicyClient, PolicyServer, install_drain_handlers
 from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
 
 __all__ = [
@@ -45,4 +53,5 @@ __all__ = [
     "CheckpointWatcher",
     "PolicyClient",
     "PolicyServer",
+    "install_drain_handlers",
 ]

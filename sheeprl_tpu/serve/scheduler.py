@@ -17,9 +17,18 @@ Past the queue bound ``submit`` blocks (backpressure — offered load above
 capacity throttles callers instead of growing an unbounded queue) and raises
 :class:`ServeOverloadedError` once its timeout expires.
 
+The worker can run SUPERVISED (``start(supervisor=...)`` with a
+:class:`~sheeprl_tpu.fault.supervisor.Supervisor`): a crash mid-cycle kills
+only that worker generation — the supervisor restarts it through
+:meth:`RequestScheduler.recover_inflight`, which re-queues the batch the
+dead generation had collected but not yet resolved, so an admitted request
+is NEVER dropped by a worker death (provable via the
+``serve.scheduler.batch`` chaos point, ``pytest -m chaos``).
+
 ``Serve/*`` metrics ride :class:`~sheeprl_tpu.parallel.pipeline.PipelineStats`
 (:class:`ServeStats` extends it): queue depth, batch-fill ratio, p50/p99
-request latency over a sliding window, swap count, served totals.
+request latency over a sliding window, swap count, served totals, watcher
+error count.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from sheeprl_tpu.fault.inject import fault_point
 from sheeprl_tpu.parallel.pipeline import PipelineStats
 from sheeprl_tpu.serve.policy import ServePolicy
 
@@ -58,6 +68,7 @@ class ServeStats(PipelineStats):
         self.rejected = 0
         self.swaps = 0
         self.weight_version = 0
+        self.watcher_errors = 0  # swallowed checkpoint-watcher poll failures
         self._latencies = collections.deque(maxlen=int(latency_window))
         self._depth_fn = None  # wired by the scheduler
 
@@ -97,6 +108,7 @@ class ServeStats(PipelineStats):
                     "Serve/queue_depth": depth,
                     "Serve/weight_version": self.weight_version,
                     "Serve/swap_count": self.swaps,
+                    "Serve/watcher_errors": self.watcher_errors,
                     "Serve/p50_latency_ms": round(p50 * 1e3, 3),
                     "Serve/p99_latency_ms": round(p99 * 1e3, 3),
                 }
@@ -170,20 +182,58 @@ class RequestScheduler:
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=self.queue_bound)
         self.stats._depth_fn = self._q.qsize
         self._holdover: Optional[_Request] = None
+        self._inflight: Optional[List[_Request]] = None  # collected, not yet resolved
+        self._requeue: List[_Request] = []  # recovered from a dead worker generation
         self._base_key = jax.random.PRNGKey(seed)
         self._batch_idx = 0
         self._stop = threading.Event()
         self._closed = threading.Event()
-        self._worker = threading.Thread(target=self._run, name="serve-scheduler", daemon=True)
+        self._worker: Optional[threading.Thread] = threading.Thread(
+            target=self._run, name="serve-scheduler", daemon=True
+        )
+        self._handle = None  # supervisor WorkerHandle when supervised
         self._started = False
 
     # -- lifecycle ----------------------------------------------------------- #
 
-    def start(self) -> "RequestScheduler":
+    def start(self, supervisor: Any = None) -> "RequestScheduler":
+        """Start the admission worker. With ``supervisor`` (a
+        :class:`~sheeprl_tpu.fault.supervisor.Supervisor`) the worker runs
+        SUPERVISED: a crash restarts it with the in-flight batch recovered
+        (zero admitted requests dropped); lease-based hang detection is off —
+        a dispatch's duration is bounded by the engine, not by us."""
         if not self._started:
             self._started = True
-            self._worker.start()
+            if supervisor is None:
+                self._worker.start()
+            else:
+                self._worker = None
+                self._handle = supervisor.spawn(
+                    "serve-scheduler",
+                    self._run,
+                    on_restart=lambda ctx: self.recover_inflight(),
+                    lease_s=None,
+                )
         return self
+
+    def worker_alive(self) -> bool:
+        """Is the admission worker currently live (health probes)?"""
+        if self._handle is not None:
+            return self._handle.live()
+        return self._worker is not None and self._worker.is_alive()
+
+    def _worker_thread(self) -> Optional[threading.Thread]:
+        return self._handle.thread if self._handle is not None else self._worker
+
+    def recover_inflight(self) -> int:
+        """Re-queue whatever a DEAD worker generation had admitted but not
+        resolved (its collected batch) so the next generation serves it
+        first, in admission order; returns how many requests were recovered.
+        Call only between generations (the supervisor's restart hook)."""
+        recovered, self._inflight = self._inflight, None
+        if recovered:
+            self._requeue = list(recovered) + self._requeue
+        return len(recovered or ())
 
     def stop(self, drain: bool = True) -> None:
         """Stop the worker. With ``drain`` (default) every request already
@@ -193,17 +243,33 @@ class RequestScheduler:
         self._closed.set()  # no new submits
         self._drain_on_stop = drain
         self._stop.set()
-        if self._started:
-            self._worker.join(timeout=30.0)
-            if self._worker.is_alive():
+        if self._handle is not None:
+            # owner-side retire BEFORE joining: a crash racing this stop must
+            # not be respawned by the supervisor's monitor into a second
+            # settler concurrently sweeping _requeue/_holdover/_inflight
+            self._handle.retire()
+        worker = self._worker_thread()
+        if self._started and worker is not None:
+            worker.join(timeout=30.0)
+            if worker.is_alive():
                 # still mid-dispatch past the join budget: the worker owns
                 # the drain (its shutdown loop sweeps until the queue is
                 # empty) — serving leftovers from THIS thread would race it
                 # on the engine slabs and the sample-key counter
                 return
         # a submit that passed the closed-check just before stop() may have
-        # enqueued after the worker's final drain sweep — settle stragglers
+        # enqueued after the worker's final drain sweep — and a worker that
+        # CRASHED (supervised, no restart once stopping) leaves its
+        # recovered/held/in-flight requests behind: settle all stragglers
         leftovers: List[_Request] = []
+        if self._inflight:
+            leftovers.extend(self._inflight)
+            self._inflight = None
+        leftovers.extend(self._requeue)
+        self._requeue = []
+        if self._holdover is not None:
+            leftovers.append(self._holdover)
+            self._holdover = None
         while True:
             try:
                 leftovers.append(self._q.get_nowait())
@@ -259,6 +325,8 @@ class RequestScheduler:
     # -- worker side --------------------------------------------------------- #
 
     def _next_request(self, timeout: float) -> Optional[_Request]:
+        if self._requeue:  # recovered in-flight first: admission order survives a crash
+            return self._requeue.pop(0)
         if self._holdover is not None:
             req, self._holdover = self._holdover, None
             return req
@@ -335,15 +403,23 @@ class RequestScheduler:
             for r in pending:
                 r.resolve(None, -1, error=err)
 
-    def _run(self) -> None:
+    def _run(self, ctx: Any = None) -> None:
         while not self._stop.is_set():
             batch = self._collect()
             if batch:
+                # the in-flight marker is what makes a worker death lossless:
+                # if this generation dies before resolving, recover_inflight
+                # hands the batch to its successor
+                self._inflight = batch
+                fault_point("serve.scheduler.batch")  # chaos: kill-the-worker-mid-batch
                 self._serve_batch(batch)
+                self._inflight = None
         # shutdown: drain everything already admitted
         drain = getattr(self, "_drain_on_stop", True)
         while True:
             pending: List[_Request] = []
+            pending.extend(self._requeue)
+            self._requeue = []
             if self._holdover is not None:
                 pending.append(self._holdover)
                 self._holdover = None
@@ -355,3 +431,8 @@ class RequestScheduler:
             if not pending:
                 break
             self._settle(pending, drain)
+        if ctx is not None:
+            # owner-driven stop (our own _stop flag): the exit is EXPECTED —
+            # without this a supervised worker stopped via scheduler.stop()
+            # alone would read as a crash and be respawned into a drain race
+            ctx.retire()

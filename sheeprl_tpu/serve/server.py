@@ -13,29 +13,44 @@ Wire protocol (one JSON object per line, both directions)::
     -> {"obs": {"state": [[...]]}, "n": 1}
     <- {"actions": [[...]], "version": 3}
     <- {"error": "..."}                       # per-request failure
+    -> {"health": true}
+    <- {"status": "ok", "ready": true, ...}   # liveness/readiness probe
 
 ``obs`` leaves are RAW env observations (the server applies the algorithm's
 own normalization via ``ServePolicy.prepare``); ``n`` (default 1) is the
 number of batched rows in the request.
+
+Supervision: the scheduler worker and the checkpoint watcher run under one
+:class:`~sheeprl_tpu.fault.supervisor.Supervisor` (config ``serve.
+supervisor``) with a monitor thread — a crashed worker is restarted (the
+scheduler recovers its in-flight batch: zero admitted requests dropped), and
+the ``{"health": true}`` probe reports engine/scheduler/watcher/store
+liveness, queue depth, weight-version staleness and per-worker restart
+counts. ``serve_policy`` (the CLI body) installs SIGTERM/SIGINT handlers
+that run a GRACEFUL DRAIN: stop accepting, settle every admitted request
+through ``scheduler.stop(drain=True)``, then exit 0.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
 import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.fault.supervisor import Supervisor
 from sheeprl_tpu.serve.engine import BucketEngine, JitEngine, default_buckets
 from sheeprl_tpu.serve.policy import ServePolicy
 from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeStats
 from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
 
-__all__ = ["PolicyClient", "PolicyServer", "serve_policy"]
+__all__ = ["PolicyClient", "PolicyServer", "install_drain_handlers", "serve_policy"]
 
 
 class PolicyClient:
@@ -74,6 +89,11 @@ class _JsonLineHandler(socketserver.StreamRequestHandler):
                 continue
             try:
                 msg = json.loads(line)
+                if msg.get("health"):
+                    resp = server.health_fn()
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+                    continue
                 obs = {k: np.asarray(v) for k, v in msg["obs"].items()}
                 n = int(msg.get("n", 1))
                 # submit_timeout: under sustained overload the request must
@@ -96,10 +116,17 @@ class _TcpFrontEnd(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, addr, client: PolicyClient, request_timeout_s: float = 30.0) -> None:
+    def __init__(
+        self,
+        addr,
+        client: PolicyClient,
+        request_timeout_s: float = 30.0,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ) -> None:
         super().__init__(addr, _JsonLineHandler)
         self.client = client
         self.request_timeout_s = request_timeout_s
+        self.health_fn = health_fn or (lambda: {"status": "unknown"})
 
 
 class PolicyServer:
@@ -145,13 +172,25 @@ class PolicyServer:
             stats=self.stats,
         )
         self.client = PolicyClient(policy, self.scheduler)
+        # one supervisor over the serving workers (scheduler + watcher):
+        # restart-on-crash with in-flight recovery, health-probe visibility
+        self.supervisor = Supervisor.from_config(
+            dict(cfg.get("supervisor") or {}), name="serve", max_restarts=3, backoff=0.25
+        )
         self.watcher: Optional[CheckpointWatcher] = None
         if watch_dir is not None:
-            self.watcher = CheckpointWatcher(watch_dir, self.weights, poll_s=float(cfg.get("watch_poll_s", 2.0)))
+            self.watcher = CheckpointWatcher(
+                watch_dir,
+                self.weights,
+                poll_s=float(cfg.get("watch_poll_s", 2.0)),
+                stats=self.stats,
+                quarantine_after=int(cfg.get("watcher_quarantine_after", 3)),
+            )
         self._tcp: Optional[_TcpFrontEnd] = None
         self._tcp_thread: Optional[threading.Thread] = None
         self._host = str(cfg.get("host", "127.0.0.1"))
         self._port = cfg.get("port", None)
+        self._draining = False
 
     # -- lifecycle ----------------------------------------------------------- #
 
@@ -161,22 +200,69 @@ class PolicyServer:
         return self._tcp.server_address[:2] if self._tcp is not None else None
 
     def start(self, with_socket: Optional[bool] = None) -> "PolicyServer":
-        self.scheduler.start()
+        self.scheduler.start(supervisor=self.supervisor)
         if self.watcher is not None:
-            self.watcher.start()
+            self.watcher.start(supervisor=self.supervisor)
+        self.supervisor.start_monitor(poll_s=0.5)
         want_socket = (self._port is not None) if with_socket is None else with_socket
         if want_socket:
             port = int(self._port or 0)
-            self._tcp = _TcpFrontEnd((self._host, port), self.client)
+            self._tcp = _TcpFrontEnd((self._host, port), self.client, health_fn=self.health)
             self._tcp_thread = threading.Thread(target=self._tcp.serve_forever, name="serve-tcp", daemon=True)
             self._tcp_thread.start()
         return self
 
+    def health(self) -> Dict[str, Any]:
+        """Liveness/readiness snapshot (also served over the socket as
+        ``{"health": true}``): per-component liveness, queue depth, weight
+        version + staleness, supervisor restart counters, drain state."""
+        sched_alive = self.scheduler.worker_alive()
+        watcher_alive = self.watcher.alive() if self.watcher is not None else None
+        fatal = self.supervisor.fatal
+        healthy = sched_alive and watcher_alive in (None, True) and fatal is None
+        status = "draining" if self._draining else ("ok" if healthy else "degraded")
+        workers = self.supervisor.snapshot()
+        out: Dict[str, Any] = {
+            "status": status,
+            # ready == this process can usefully take NEW traffic
+            "ready": bool(sched_alive and not self._draining),
+            "engine": {
+                "kind": type(self.engine).__name__,
+                "buckets": [int(b) for b in (self.engine.buckets or ())],
+            },
+            "scheduler": {
+                "alive": bool(sched_alive),
+                "queue_depth": int(self.scheduler._q.qsize()),
+                "restarts": int(workers.get("serve-scheduler", {}).get("restarts", 0)),
+            },
+            "weights": {
+                "version": int(self.weights.version),
+                "staleness_s": round(self.weights.staleness_s, 3),
+            },
+            "supervisor": {"fatal": str(fatal) if fatal is not None else None, "workers": workers},
+        }
+        if self.watcher is not None:
+            out["watcher"] = {
+                "alive": bool(watcher_alive),
+                "errors": int(self.stats.watcher_errors),
+                "published": int(self.watcher.published),
+                "quarantined": [str(p) for p in sorted(self.watcher.quarantined)],
+                "restarts": int(workers.get("serve-ckpt-watcher", {}).get("restarts", 0)),
+            }
+        return out
+
     def stop(self) -> None:
+        """Graceful drain: stop accepting (socket down, submits closed),
+        settle every admitted request, then tear the workers down."""
+        self._draining = True
         if self._tcp is not None:
             self._tcp.shutdown()
             self._tcp.server_close()
             self._tcp = None
+        # stop restarts BEFORE joining workers: a crash racing shutdown must
+        # fall through to the scheduler's straggler settlement, not respawn
+        self.supervisor.request_stop()
+        self.supervisor.stop_monitor()
         if self.watcher is not None:
             self.watcher.stop()
         self.scheduler.stop(drain=True)
@@ -204,11 +290,52 @@ def request_over_socket(addr: Tuple[str, int], obs: Dict[str, Any], n: int = 1, 
     return json.loads(buf.decode())
 
 
+def install_drain_handlers(
+    event: threading.Event, signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)
+) -> Callable[[], None]:
+    """Install handlers that flag ``event`` for a graceful drain; returns a
+    restore callable. A no-op off the main thread (Python only delivers
+    signals there). SIGTERM — the orchestrator's shutdown verb (k8s,
+    systemd, a TPU-pod preemption notice) — previously killed the process
+    mid-batch; now it stops accepting, settles every admitted request and
+    exits 0."""
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+
+    def _handler(signum, frame) -> None:
+        # flag FIRST; then announce via os.write — a print() here can raise
+        # "reentrant call" if the signal lands while the main thread holds
+        # the stdout buffer lock, and must never cost us the drain flag
+        event.set()
+        try:
+            name = signal.Signals(signum).name
+            os.write(
+                1,
+                f"serve: received {name} — graceful drain "
+                "(stop accepting, settle admitted requests, exit 0)\n".encode(),
+            )
+        except OSError:  # stdout gone (orchestrator tore the pipe down)
+            pass
+
+    previous = {s: signal.signal(s, _handler) for s in signals}
+
+    def _restore() -> None:
+        for s, h in previous.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, TypeError):  # interpreter tearing down
+                pass
+
+    return _restore
+
+
 def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) -> None:
     """CLI entrypoint body: build the policy from the checkpoint and serve.
 
     Runs until ``serve.max_requests`` requests have been answered (None →
-    forever) or KeyboardInterrupt; prints a ``Serve/*`` stats snapshot every
+    forever), SIGTERM/SIGINT (graceful drain via :func:`install_drain_handlers`
+    → ``PolicyServer.stop`` → ``scheduler.stop(drain=True)``, exit 0) or
+    KeyboardInterrupt; prints a ``Serve/*`` stats snapshot every
     ``serve.log_every_s`` seconds and once on shutdown.
     """
     import gymnasium as gym
@@ -245,22 +372,27 @@ def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) ->
     server = PolicyServer(policy, serve_cfg, watch_dir=watch_dir)
     max_requests = serve_cfg.get("max_requests")
     log_every_s = float(serve_cfg.get("log_every_s", 10.0) or 10.0)
+    drain = threading.Event()
+    restore_handlers = install_drain_handlers(drain)
     server.start()
     addr = server.address
     if addr is not None:
         print(f"serving {cfg.algo.name} on {addr[0]}:{addr[1]} (buckets={list(server.engine.buckets) or 'jit'})")
     try:
         last_log = time.perf_counter()
-        while True:
-            time.sleep(0.2)
+        while not drain.is_set():
+            drain.wait(0.2)
             now = time.perf_counter()
             if now - last_log >= log_every_s:
                 print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}))
                 last_log = now
             if max_requests is not None and server.stats.requests >= int(max_requests):
                 break
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # raw ^C with handlers already restored/absent
         pass
     finally:
-        server.stop()
+        server.stop()  # graceful drain: nothing admitted is dropped
+        restore_handlers()
         print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}))
+        if drain.is_set():
+            print("serve: drained cleanly")

@@ -14,9 +14,18 @@ ever dropped: a swap is a reference publish, never an interruption.
 
 :class:`CheckpointWatcher` feeds a store from a checkpoint directory: it
 polls the :mod:`sheeprl_tpu.fault.manager` manifests
-(:func:`~sheeprl_tpu.fault.manager.latest_complete` — only *complete*,
+(:func:`~sheeprl_tpu.fault.manager.complete_entries` — only *complete*,
 digest-verified saves are ever considered, so a torn mid-write checkpoint
-can't be published) and publishes each new step's ``state["agent"]``.
+can't be published) and publishes each new step's ``state["agent"]``. The
+manifest digest covers the META pickle only: a save whose ``.arrays``
+payload rotted AFTER publish still looks complete and fails only at load.
+Each such failure is COUNTED (``Serve/watcher_errors``) and STRUCK against
+that path; ``quarantine_after`` strikes quarantine it permanently, so one
+corrupt save can never wedge the publish loop re-reading it forever — the
+watcher falls through to the next newer save when one appears, serving the
+last good weights meanwhile. The poll loop can also run SUPERVISED
+(``start(supervisor=...)``): a thread-killing failure is restarted instead
+of silently ending hot swaps for the rest of the server's life.
 """
 
 from __future__ import annotations
@@ -25,8 +34,9 @@ import threading
 import time
 import warnings
 from pathlib import Path
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Set, Tuple
 
+from sheeprl_tpu.fault.inject import fault_point
 from sheeprl_tpu.parallel.pipeline import ParamServer, PipelineStats
 
 __all__ = ["WeightStore", "CheckpointWatcher"]
@@ -54,16 +64,25 @@ class WeightStore:
         self._params_from_state = params_from_state
         self._device = device
         # version 0 is the construction-time params; real publishes are >= 1
+        self._published_at = time.monotonic()
 
     @property
     def version(self) -> int:
         return self._server.version
 
+    @property
+    def staleness_s(self) -> float:
+        """Seconds since the last publish (construction counts as one) — the
+        health probe's 'how old are the served weights' gauge."""
+        return max(0.0, time.monotonic() - self._published_at)
+
     def pull(self) -> Tuple[int, Any]:
         return self._server.pull(self._device)
 
     def publish_params(self, params: Any) -> int:
-        return self._server.publish(params)
+        version = self._server.publish(params)
+        self._published_at = time.monotonic()
+        return version
 
     def publish_state(self, agent_state: Any) -> int:
         if self._params_from_state is None:
@@ -76,57 +95,113 @@ class CheckpointWatcher:
 
     Watches ``ckpt_dir`` (a run's ``checkpoint/`` directory) through the
     fault-runtime manifests; a new complete entry with a strictly newer step
-    is loaded and its ``state["agent"]`` published. Load errors are warned
-    and skipped — the server keeps serving the previous version (manifest
-    completeness makes these rare: half-written saves are invisible).
+    is loaded and its ``state["agent"]`` published. Load errors are warned,
+    COUNTED (``stats.watcher_errors`` → ``Serve/watcher_errors``) and struck
+    against the path; ``quarantine_after`` strikes quarantine it for good
+    (see the module docstring) — the server keeps serving the previous
+    version throughout.
     """
 
-    def __init__(self, ckpt_dir: "str | Path", store: WeightStore, poll_s: float = 2.0) -> None:
+    def __init__(
+        self,
+        ckpt_dir: "str | Path",
+        store: WeightStore,
+        poll_s: float = 2.0,
+        stats: Optional[PipelineStats] = None,
+        quarantine_after: int = 3,
+    ) -> None:
         self.ckpt_dir = Path(ckpt_dir)
         self.store = store
         self.poll_s = float(poll_s)
+        self.stats = stats
+        self.quarantine_after = max(1, int(quarantine_after))
         self._last: Optional[Path] = None
         self._last_step = -1
+        self._strikes: Dict[Path, int] = {}
+        self.quarantined: Set[Path] = set()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="serve-ckpt-watcher", daemon=True)
+        self._handle = None  # supervisor WorkerHandle when supervised
         self.published = 0
 
-    def start(self, publish_current: bool = False) -> "CheckpointWatcher":
+    def start(self, publish_current: bool = False, supervisor: Any = None) -> "CheckpointWatcher":
         """Begin watching. With ``publish_current`` the newest existing
         checkpoint is published immediately; by default only checkpoints
         appearing AFTER the watcher starts swap in (the server was built from
-        an explicit checkpoint already)."""
+        an explicit checkpoint already). With ``supervisor`` the poll loop
+        runs supervised: a thread-killing failure restarts it."""
         if not publish_current:
             self._prime()
-        self._thread.start()
+        if supervisor is None:
+            self._thread.start()
+        else:
+            self._thread = None
+            self._handle = supervisor.spawn("serve-ckpt-watcher", self._run, lease_s=None)
         return self
+
+    def alive(self) -> bool:
+        """Is the poll loop currently live (health probes)?"""
+        if self._handle is not None:
+            return self._handle.live()
+        return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=10.0)
+        if self._handle is not None:
+            self._handle.retire()  # owner-side: no respawn racing this stop
+        thread = self._handle.thread if self._handle is not None else self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=10.0)
 
     def poll_once(self) -> bool:
         """One manifest sweep; returns True iff a new checkpoint published
         (exposed for tests and for pollers that bring their own cadence)."""
-        from sheeprl_tpu.fault.manager import latest_complete
+        from sheeprl_tpu.fault.manager import complete_entries
         from sheeprl_tpu.utils.checkpoint import load_state
 
-        newest = latest_complete(self.ckpt_dir)
-        if newest is None or newest == self._last:
-            return False
-        step = _step_of(newest)
-        if step <= self._last_step:
-            return False
-        try:
-            state = load_state(newest)
-            agent_state = state["agent"]
-        except Exception as e:
-            warnings.warn(f"serve checkpoint watcher could not load {newest}: {e}")
-            return False
-        self.store.publish_state(agent_state)
-        self._last, self._last_step = newest, step
-        self.published += 1
-        return True
+        fault_point("serve.watcher.poll")  # chaos: poll failure / watcher kill
+        # newest-first, skipping quarantined paths — the candidate is the
+        # first non-quarantined entry strictly newer than the last publish
+        for _t, step, path in reversed(complete_entries(self.ckpt_dir)):
+            if path in self.quarantined:
+                continue
+            if path == self._last or step <= self._last_step:
+                return False
+            try:
+                state = load_state(path)
+                agent_state = state["agent"]
+            except Exception as e:
+                self._strike(path, e)
+                return False
+            self.store.publish_state(agent_state)
+            self._last, self._last_step = path, step
+            self.published += 1
+            return True
+        return False
+
+    def _count_error(self) -> None:
+        # tolerate a plain PipelineStats (annotation-accurate but without the
+        # Serve/* fields): a missing counter must never kill the poll loop
+        if self.stats is not None and hasattr(self.stats, "watcher_errors"):
+            self.stats.add("watcher_errors", 1)
+
+    def _strike(self, path: Path, error: BaseException) -> None:
+        """Count a load failure against ``path``; quarantine past the budget
+        so the loop stops re-reading a save that will never load."""
+        self._count_error()
+        strikes = self._strikes.get(path, 0) + 1
+        self._strikes[path] = strikes
+        if strikes >= self.quarantine_after:
+            self.quarantined.add(path)
+            warnings.warn(
+                f"serve checkpoint watcher QUARANTINED {path} after {strikes} failed loads "
+                f"({type(error).__name__}: {error}) — serving continues on the previous weights"
+            )
+        else:
+            warnings.warn(
+                f"serve checkpoint watcher could not load {path} "
+                f"(strike {strikes}/{self.quarantine_after}): {error}"
+            )
 
     def _prime(self) -> None:
         from sheeprl_tpu.fault.manager import latest_complete
@@ -135,13 +210,22 @@ class CheckpointWatcher:
         if newest is not None:
             self._last, self._last_step = newest, _step_of(newest)
 
-    def _run(self) -> None:
+    def _run(self, ctx: Any = None) -> None:
         while not self._stop.is_set():
+            if ctx is not None:
+                ctx.beat()
             try:
                 self.poll_once()
             except Exception as e:  # never kill serving over a watcher hiccup
+                # (ThreadKilled is a BaseException: it DOES kill this
+                # generation, and the supervisor restarts it)
+                self._count_error()
                 warnings.warn(f"serve checkpoint watcher error: {e}")
             self._stop.wait(self.poll_s)
+        if ctx is not None:
+            # owner-driven stop (our own _stop flag): the exit is EXPECTED,
+            # not a crash for the supervisor to restart
+            ctx.retire()
 
 
 def _step_of(path: Path) -> int:

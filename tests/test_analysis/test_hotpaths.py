@@ -160,6 +160,43 @@ def test_sac_sebulba_dry_run_clean(tmp_path, trace_hygiene):
     )
 
 
+def test_sac_sebulba_actor_restart_clean(tmp_path, trace_hygiene):
+    """Chaos under the strict trace budget: an actor killed mid-run is
+    restarted by the supervisor and the run completes with ZERO post-warmup
+    retraces — the replacement generation must reuse the compiled ``act``
+    program (same abstract signature, same jit cache), not recompile it."""
+    import warnings
+
+    from sheeprl_tpu.fault import inject
+
+    inject.arm("sac_sebulba.actor0.step", action="raise", at=8)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)  # the restart announcement
+            run(
+                _args(tmp_path, "sac_sebulba", extra=SAC_FAST + ["algo.learning_starts=0"])
+                + [
+                    "dry_run=False",
+                    "algo.total_steps=48",
+                    "algo.sebulba.num_actor_threads=2",
+                    "algo.sebulba.rollout_block=4",
+                    "buffer.size=96",
+                    "fault.supervisor.backoff=0.05",
+                ]
+            )
+    finally:
+        inject.reset()
+    report = trace_hygiene.report()
+    # the kill actually happened and the replacement dispatched act again
+    assert report["sac_sebulba.act"]["calls"] >= 2
+    _assert_quiet(
+        trace_hygiene,
+        ["sac_sebulba.train_step", "sac_sebulba.act", "sac_sebulba.append"],
+    )
+    # one abstract signature, one compile — across the restart
+    assert report["sac_sebulba.act"]["compiles"] == 1, report["sac_sebulba.act"]
+
+
 def test_serve_engine_hotpaths_clean(trace_hygiene):
     """The serving tier's hot paths: AOT bucket programs are compiled at
     construction, so arbitrary request shapes hammered through ``infer`` must

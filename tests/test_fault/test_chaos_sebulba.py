@@ -1,0 +1,188 @@
+"""Sebulba chaos e2es through the real CLI: an actor killed mid-run is
+restarted and the run completes with the SAME final env-step counters as the
+fault-free twin (acceptance proof (a)); a hung actor expires its lease and
+the pool degrades to the survivors; zero survivors abort with a typed error;
+the config-driven chaos schedule (``fault.chaos.events``) arms the same
+drills from the CLI."""
+
+import ast
+import time
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.fault.supervisor import AllWorkersDeadError, WorkerAbortError
+
+pytestmark = pytest.mark.chaos
+
+# 3 actors over a small run: total_iters (=total_steps/num_envs) is a
+# multiple of rollout_block, so every consumed item carries exactly `block`
+# rows and the final counters are DETERMINISTIC — the fault-free twin and
+# the chaos run must land on identical policy_steps.
+SAC_CHAOS = [
+    "exp=sac_sebulba",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "buffer.size=128",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.per_rank_batch_size=8",
+    "algo.hidden_size=16",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.learning_starts=4",
+    "algo.total_steps=64",
+    "algo.sebulba.num_actor_threads=3",
+    "algo.sebulba.rollout_block=4",
+    "checkpoint.save_last=False",
+    "checkpoint.every=0",
+    "fabric.devices=1",
+    "fault.supervisor.backoff=0.0",
+]
+
+PPO_CHAOS = [
+    "exp=ppo_sebulba",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.total_steps=96",
+    "algo.sebulba.num_actor_threads=3",
+    "checkpoint.save_last=False",
+    "checkpoint.every=0",
+    "fabric.devices=1",
+    "fault.supervisor.backoff=0.0",
+]
+
+
+def _stats(capfd, tag):
+    out, _err = capfd.readouterr()
+    lines = [l for l in out.splitlines() if l.startswith(f"{tag} ")]
+    assert lines, f"no {tag} line in output:\n{out[-2000:]}"
+    return ast.literal_eval(lines[-1][len(tag) + 1 :])
+
+
+@pytest.fixture()
+def sebulba_debug(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_SEBULBA_DEBUG", "1")
+
+
+def test_sac_sebulba_actor_killed_midrun_restarts_and_counters_match(tmp_path, sebulba_debug, capfd):
+    """Acceptance proof (a): lose 1 of 3 actors mid-run -> the supervisor
+    restarts it on fresh envs, the run completes, final env-step counters
+    EQUAL the fault-free twin's, and Pipeline/actor_deaths == injected
+    kills."""
+    run(SAC_CHAOS + [f"log_root={tmp_path}/logs/clean"])
+    clean = _stats(capfd, "SAC_SEBULBA_STATS")
+    assert clean["Pipeline/actor_deaths"] == 0
+    assert clean["Pipeline/actors_live"] == 3
+
+    inject.arm("sac_sebulba.actor1.step", action="raise", at=10)
+    with pytest.warns(UserWarning, match="sac-sebulba-actor-1.*restarting"):
+        run(SAC_CHAOS + [f"log_root={tmp_path}/logs/chaos"])
+    chaos = _stats(capfd, "SAC_SEBULBA_STATS")
+    assert chaos["Pipeline/actor_deaths"] == 1  # == injected kills
+    assert chaos["Pipeline/actor_restarts"] == 1
+    assert chaos["Pipeline/actors_live"] == 3  # restarted, not degraded
+    assert chaos["policy_steps"] == clean["policy_steps"]  # counters monotone AND equal
+    assert chaos["Pipeline/env_steps_consumed"] == clean["Pipeline/env_steps_consumed"]
+
+
+def test_sac_sebulba_hung_actor_lease_expires_and_pool_degrades(tmp_path, sebulba_debug, capfd):
+    """A hang (not a crash): the actor goes silent past its lease, the
+    supervisor abandons the generation; with no restart budget the pool
+    degrades to the 2 survivors and the run still completes."""
+    inject.arm("sac_sebulba.actor0.step", action="hang", at=8, hang_s=60.0)
+    with pytest.warns(UserWarning, match="hung"):
+        run(
+            SAC_CHAOS
+            + [
+                "fault.supervisor.max_restarts=0",
+                "fault.supervisor.escalation=degrade",
+                "fault.supervisor.lease_s=0.3",
+                "fault.supervisor.grace_s=0.3",
+                f"log_root={tmp_path}/logs",
+            ]
+        )
+    stats = _stats(capfd, "SAC_SEBULBA_STATS")
+    assert stats["Pipeline/actor_hangs"] == 1
+    assert stats["Pipeline/actor_deaths"] == 1
+    assert stats["Pipeline/actors_live"] == 2
+    assert stats["Pipeline/actors_degraded"] == 1
+    inject.release_hangs()
+    time.sleep(0.1)  # let the woken generation observe cancelled and exit
+
+
+def test_sac_sebulba_zero_survivors_aborts_typed(tmp_path):
+    """Every actor dead past the budget: the learner gets a TYPED error
+    instead of spinning on an empty queue forever."""
+    inject.arm("sac_sebulba.actor0.step", action="raise", at=6)
+    with pytest.warns(UserWarning):
+        with pytest.raises(AllWorkersDeadError, match="sac-sebulba-actor-0"):
+            run(
+                SAC_CHAOS
+                + [
+                    "algo.sebulba.num_actor_threads=1",
+                    "fault.supervisor.max_restarts=0",
+                    "fault.supervisor.escalation=degrade",
+                    f"log_root={tmp_path}/logs",
+                ]
+            )
+
+
+def test_sac_sebulba_supervision_disabled_fails_fast_named(tmp_path):
+    """fault.supervisor.enabled=False = the pre-supervision fail-fast
+    semantics, upgraded to a typed error NAMING the dead actor."""
+    inject.arm("sac_sebulba.actor0.step", action="raise", at=6)
+    with pytest.raises(WorkerAbortError, match="sac-sebulba-actor-0"):
+        run(
+            SAC_CHAOS
+            + [
+                "fault.supervisor.enabled=False",
+                f"log_root={tmp_path}/logs",
+            ]
+        )
+
+
+def test_ppo_sebulba_actor_killed_midrun_restarts(tmp_path, sebulba_debug, capfd):
+    """Same drill on the on-policy pipeline: the killed actor is re-homed
+    onto fresh envs and the run completes with the pool back at full
+    strength."""
+    inject.arm("ppo_sebulba.actor2.step", action="raise", at=12)
+    with pytest.warns(UserWarning, match="sebulba-actor-2.*restarting"):
+        run(PPO_CHAOS + [f"log_root={tmp_path}/logs"])
+    stats = _stats(capfd, "SEBULBA_STATS")
+    assert stats["Pipeline/actor_deaths"] == 1
+    assert stats["Pipeline/actor_restarts"] == 1
+    assert stats["Pipeline/actors_live"] == 3
+    assert stats["Pipeline/rollouts_consumed"] >= 6  # 96 steps / (8*2) per item
+
+
+def test_chaos_schedule_from_cli_config(tmp_path, sebulba_debug, capfd):
+    """The SAME drill driven purely by config (`fault.chaos.events`): the
+    deterministic schedule arms at startup, no in-process arm() needed —
+    what a CLI chaos drill against a real deployment uses."""
+    with pytest.warns(UserWarning, match="restarting"):
+        run(
+            SAC_CHAOS
+            + [
+                "fault.chaos.enabled=True",
+                "fault.chaos.seed=3",
+                "fault.chaos.events=['sac_sebulba.actor1.step:raise:8-16']",
+                f"log_root={tmp_path}/logs",
+            ]
+        )
+    stats = _stats(capfd, "SAC_SEBULBA_STATS")
+    assert stats["Pipeline/actor_deaths"] == 1
+    assert stats["Pipeline/actors_live"] == 3

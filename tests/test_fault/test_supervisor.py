@@ -1,0 +1,501 @@
+"""Supervision-runtime semantics, provable via deterministic chaos: crash →
+restart (with re-homing hook + exponential backoff), hang → lease expiry →
+abandoned + replaced, budget exhaustion → degrade or abort per escalation,
+zero survivors → typed error, shutdown join budget naming abandoned workers,
+deadline-guarded queue handoffs, and the chaos-harness primitives
+themselves (hang/kill-thread actions, seeded schedules, deep checkpoint
+corruption)."""
+
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.fault.supervisor import (
+    AllWorkersDeadError,
+    HungWorkerError,
+    Supervisor,
+    WorkerAbortError,
+)
+from sheeprl_tpu.parallel.pipeline import HandoffTimeoutError, RolloutQueue
+
+pytestmark = pytest.mark.chaos
+
+
+def _pump(sup, until, timeout=5.0, poll=0.01):
+    """Drive check() until ``until()`` or timeout; returns until()'s verdict."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.check()
+        if until():
+            return True
+        time.sleep(poll)
+    return until()
+
+
+# --------------------------------------------------------------------------- #
+# crash / restart / re-homing
+# --------------------------------------------------------------------------- #
+
+
+def test_crash_restarts_with_rehoming_hook(recwarn):
+    events = []
+
+    def target(ctx):
+        events.append(("run", ctx.generation))
+        if ctx.generation == 1:
+            raise RuntimeError("boom")
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=2, backoff=0.01, lease_s=5.0)
+    h = sup.spawn("w", target, on_restart=lambda ctx: events.append(("rehome", ctx.generation)))
+    assert _pump(sup, lambda: h.restarts == 1 and h.is_alive())
+    # re-homing ran BEFORE the new generation, with the new generation's ctx
+    assert events == [("run", 1), ("rehome", 2), ("run", 2)]
+    assert h.deaths == 1 and h.hangs == 0
+    assert isinstance(h.last_error, RuntimeError)
+    assert sup.join(2.0) == []
+
+
+def test_restart_backoff_is_exponential():
+    t0 = {}
+
+    def target(ctx):
+        t0[ctx.generation] = time.monotonic()
+        if ctx.generation <= 2:
+            raise RuntimeError("again")
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=3, backoff=0.08, lease_s=None)
+    h = sup.spawn("w", target)
+    with pytest.warns(UserWarning):
+        assert _pump(sup, lambda: h.restarts == 2 and h.is_alive())
+    # delays: backoff * 2^0 then backoff * 2^1 (scheduling noise tolerated)
+    assert t0[2] - t0[1] >= 0.8 * 0.08
+    assert t0[3] - t0[2] >= 0.8 * 0.16
+    sup.join(2.0)
+
+
+def test_unexpected_clean_exit_counts_as_death():
+    def target(ctx):
+        if ctx.generation == 1:
+            return  # neither cancelled nor crashed: unexpected
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=1, backoff=0.01, lease_s=None)
+    h = sup.spawn("w", target)
+    with pytest.warns(UserWarning, match="exited unexpectedly"):
+        assert _pump(sup, lambda: h.restarts == 1 and h.is_alive())
+    assert h.last_error is None
+    sup.join(2.0)
+
+
+def test_failed_rehoming_hook_counts_as_another_death():
+    attempts = []
+
+    def target(ctx):
+        raise RuntimeError("boom")
+
+    def bad_rehome(ctx):
+        attempts.append(ctx.generation)
+        raise OSError("env factory down")
+
+    def survivor(ctx):
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=1, backoff=0.01, escalation="degrade", lease_s=None)
+    h = sup.spawn("w", target, on_restart=bad_rehome)
+    sup.spawn("other", survivor)  # keeps the pool alive so degrade isn't zero-survivors
+    with pytest.warns(UserWarning):
+        assert _pump(sup, lambda: h.state == "degraded")
+    assert attempts == [2]  # one restart attempt, whose re-homing failure exhausted the budget
+    assert isinstance(h.last_error, OSError)
+    sup.join(2.0)
+
+
+# --------------------------------------------------------------------------- #
+# hang detection (lease expiry)
+# --------------------------------------------------------------------------- #
+
+
+def test_hang_expires_lease_and_replaces_generation():
+    woke = []
+
+    def target(ctx):
+        ctx.beat()
+        if ctx.generation == 1:
+            inject.fault_point("hangy.step")  # armed: hang well past the lease
+            woke.append(ctx.cancelled)  # after waking, the verdict must be visible
+            return
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    inject.arm("hangy.step", action="hang", at=1, hang_s=30.0)
+    sup = Supervisor(max_restarts=1, backoff=0.01, lease_s=0.05, grace_s=0.05)
+    h = sup.spawn("hangy", target)
+    with pytest.warns(UserWarning, match="hung"):
+        assert _pump(sup, lambda: h.hangs == 1 and h.restarts == 1 and h.is_alive())
+    assert isinstance(h.last_error, HungWorkerError)
+    inject.release_hangs()  # wake the abandoned generation
+    time.sleep(0.1)
+    assert woke == [True]  # the stale generation saw cancelled=True on waking
+    sup.join(2.0)
+
+
+def test_beat_keeps_slow_worker_alive():
+    def target(ctx):
+        for _ in range(20):  # slow but heartbeating: must NOT be called hung
+            ctx.beat()
+            time.sleep(0.02)
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=0, backoff=0.01, lease_s=0.1, grace_s=0.1)
+    h = sup.spawn("slow", target)
+    assert not _pump(sup, lambda: h.deaths > 0, timeout=0.5)
+    assert h.deaths == 0 and h.is_alive()
+    sup.join(2.0)
+
+
+def test_stale_generation_beat_cannot_refresh_live_lease():
+    release = threading.Event()
+
+    def target(ctx):
+        ctx.beat()
+        if ctx.generation == 1:
+            release.wait(5.0)  # abandoned; beats AFTER replacement spawned
+            for _ in range(50):
+                ctx.beat()
+                time.sleep(0.002)
+            return
+        # replacement: beat once, then go silent so only a STALE beat could save it
+        time.sleep(30.0)
+
+    sup = Supervisor(max_restarts=2, backoff=0.0, lease_s=0.08, grace_s=0.08)
+    h = sup.spawn("w", target)
+    with pytest.warns(UserWarning):
+        assert _pump(sup, lambda: h.hangs == 1 and h.generation == 2)
+        release.set()  # gen-1 now spams beat() while gen-2 is silent
+        assert _pump(sup, lambda: h.hangs == 2)  # gen-2 still expires: stale beats ignored
+    sup.join(0.2)
+
+
+# --------------------------------------------------------------------------- #
+# escalation ladder
+# --------------------------------------------------------------------------- #
+
+
+def _crasher(ctx):
+    raise RuntimeError(f"gen {ctx.generation} down")
+
+
+def test_degrade_drops_worker_and_survivors_continue():
+    def survivor(ctx):
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=0, backoff=0.01, escalation="degrade", lease_s=None)
+    bad = sup.spawn("bad", _crasher)
+    good = sup.spawn("good", survivor)
+    with pytest.warns(UserWarning, match="DEGRADED"):
+        assert _pump(sup, lambda: bad.state == "degraded")
+    sup.check()  # survivors keep the pool alive: no AllWorkersDeadError
+    assert sup.alive_count() == 1 and good.is_alive()
+    m = sup.metrics("Pipeline/", "actor")
+    assert m["Pipeline/actor_deaths"] == 1
+    assert m["Pipeline/actors_live"] == 1
+    assert m["Pipeline/actors_degraded"] == 1
+    sup.join(2.0)
+
+
+def test_abort_escalation_raises_typed_error_naming_worker():
+    sup = Supervisor(max_restarts=0, escalation="abort", lease_s=None)
+    sup.spawn("doomed", _crasher)
+    with pytest.raises(WorkerAbortError, match="doomed") as ei:
+        assert _pump(sup, lambda: False, timeout=2.0)
+    assert isinstance(ei.value.cause, RuntimeError)
+
+
+def test_zero_survivors_raise_all_workers_dead():
+    sup = Supervisor(max_restarts=0, backoff=0.01, escalation="degrade", lease_s=None)
+    sup.spawn("a", _crasher)
+    sup.spawn("b", _crasher)
+    with pytest.warns(UserWarning):
+        with pytest.raises(AllWorkersDeadError) as ei:
+            _pump(sup, lambda: False, timeout=2.0)
+    assert set(ei.value.errors) == {"a", "b"}
+
+
+def test_restart_escalation_ignores_budget():
+    def target(ctx):
+        if ctx.generation <= 4:
+            raise RuntimeError("again")
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    sup = Supervisor(max_restarts=1, backoff=0.0, escalation="restart", lease_s=None)
+    h = sup.spawn("w", target)
+    with pytest.warns(UserWarning):
+        assert _pump(sup, lambda: h.restarts == 4 and h.is_alive())
+    sup.join(2.0)
+
+
+def test_from_config_disabled_is_fail_fast():
+    sup = Supervisor.from_config({"enabled": False, "max_restarts": 5})
+    assert sup.max_restarts == 0 and sup.escalation == "abort"
+
+
+def test_from_config_rejects_unknown_escalation():
+    with pytest.raises(ValueError, match="escalation"):
+        Supervisor.from_config({"escalation": "panic"})
+
+
+# --------------------------------------------------------------------------- #
+# shutdown join budget
+# --------------------------------------------------------------------------- #
+
+
+def test_join_abandons_hung_worker_by_name():
+    def wedged(ctx):
+        ctx.beat()
+        inject.fault_point("wedged.step")  # hang far past any join budget
+
+    def polite(ctx):
+        while not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+
+    inject.arm("wedged.step", action="hang", at=1, hang_s=60.0)
+    sup = Supervisor(max_restarts=0, lease_s=None, join_s=0.2)
+    sup.spawn("wedged-actor", wedged)
+    sup.spawn("polite-actor", polite)
+    time.sleep(0.05)
+    with pytest.warns(UserWarning, match="wedged-actor"):
+        abandoned = sup.join()
+    assert abandoned == ["wedged-actor"]
+    inject.release_hangs()
+
+
+def test_retired_worker_exit_is_not_a_crash():
+    """A worker whose OWNER stopped it through its own flag (scheduler.stop()
+    without supervisor.request_stop()) retires itself: the dead thread must
+    read as stopped — no respawn, no degraded pool, no AllWorkersDeadError."""
+    owner_stop = threading.Event()
+
+    def target(ctx):
+        while not owner_stop.is_set() and not ctx.cancelled:
+            ctx.beat()
+            time.sleep(0.005)
+        ctx.retire()
+
+    sup = Supervisor(max_restarts=2, backoff=0.01, lease_s=None)
+    h = sup.spawn("owned", target)
+    owner_stop.set()
+    assert _pump(sup, lambda: h.state == "stopped")
+    sup.check()  # an all-retired pool is shut down, not dead
+    assert h.deaths == 0 and h.restarts == 0
+    m = sup.metrics()
+    assert m["Pipeline/worker_deaths"] == 0 and m["Pipeline/workers_degraded"] == 0
+
+
+def test_owner_retire_blocks_pending_respawn():
+    """Owner-side handle.retire() during a crash's backoff window: the
+    scheduled restart must be cancelled (state -> stopped), so an owner's
+    standalone stop can never race a monitor respawn into its shutdown
+    settlement."""
+    sup = Supervisor(max_restarts=3, backoff=5.0, lease_s=None)  # long backoff window
+    h = sup.spawn("w", _crasher)
+    with pytest.warns(UserWarning, match="restarting"):
+        assert _pump(sup, lambda: h.state == "backoff")
+    h.retire()
+    assert h.state == "stopped" and not h.live()
+    sup.check()  # no respawn, no AllWorkersDeadError (retired == shut down)
+    assert h.restarts == 0 and h.state == "stopped"
+
+
+def test_monitor_thread_surfaces_fatal_instead_of_raising():
+    sup = Supervisor(max_restarts=0, backoff=0.01, escalation="degrade", lease_s=None)
+    sup.spawn("w", _crasher)
+    with pytest.warns(UserWarning):
+        sup.start_monitor(poll_s=0.01)
+        deadline = time.monotonic() + 5.0
+        while sup.fatal is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert isinstance(sup.fatal, AllWorkersDeadError)
+    sup.stop_monitor()
+
+
+# --------------------------------------------------------------------------- #
+# deadline-guarded handoffs
+# --------------------------------------------------------------------------- #
+
+
+def test_handoff_deadline_raises_with_diagnostics():
+    rq = RolloutQueue(2)
+    with pytest.raises(_queue.Empty):
+        rq.get(timeout=0.05, deadline_s=0.2)
+    with pytest.raises(HandoffTimeoutError, match="actor-7: state=running"):
+        for _ in range(10):
+            try:
+                rq.get(timeout=0.05, deadline_s=0.2, diagnose=lambda: "actor-7: state=running")
+            except _queue.Empty:
+                continue
+
+
+def test_handoff_deadline_resets_on_delivery():
+    rq = RolloutQueue(2)
+    stop = threading.Event()
+
+    def trickle():
+        while not stop.is_set():
+            rq.put({"x": 1})
+            time.sleep(0.05)
+
+    t = threading.Thread(target=trickle, daemon=True)
+    t.start()
+    try:
+        for _ in range(10):  # slow producer stays under the deadline forever
+            while True:
+                try:
+                    rq.get(timeout=0.03, deadline_s=0.5)
+                    break
+                except _queue.Empty:
+                    continue
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+
+
+def test_queue_stall_injection_trips_deadline():
+    """Arm the producer-side chaos point with a hang: the consumer's deadline
+    guard must convert the stalled pipeline into a typed failure."""
+    rq = RolloutQueue(2)
+    inject.arm("pipeline.queue.put", action="hang", at=1, hang_s=30.0)
+    t = threading.Thread(target=lambda: rq.put({"x": 1}), daemon=True)
+    t.start()
+    with pytest.raises(HandoffTimeoutError):
+        for _ in range(20):
+            try:
+                rq.get(timeout=0.05, deadline_s=0.3)
+            except _queue.Empty:
+                continue
+    inject.release_hangs()
+    t.join(timeout=2.0)
+
+
+def test_put_beats_while_backpressured():
+    rq = RolloutQueue(1)
+    rq.put({"x": 0})
+    beats = []
+    stop = threading.Event()
+    t = threading.Thread(target=lambda: rq.put({"x": 1}, stop_event=stop, beat=lambda: beats.append(1)))
+    t.start()
+    time.sleep(0.2)
+    assert beats  # a back-pressured producer keeps renewing its lease
+    rq.get(timeout=1.0)
+    t.join(timeout=2.0)
+    stop.set()
+
+
+# --------------------------------------------------------------------------- #
+# chaos-harness primitives
+# --------------------------------------------------------------------------- #
+
+
+def test_kill_thread_action_escapes_except_exception():
+    seen = []
+
+    def victim():
+        try:
+            inject.fault_point("victim.step")
+        except Exception:  # the routine handler a crash must NOT be absorbed by
+            seen.append("caught")
+
+    inject.arm("victim.step", action="kill-thread", at=1)
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    t.join(timeout=2.0)
+    assert seen == []  # ThreadKilled is a BaseException: it killed the thread
+
+
+def test_hang_action_releasable():
+    t0 = time.monotonic()
+    inject.arm("h.step", action="hang", at=1, hang_s=30.0)
+    t = threading.Thread(target=lambda: inject.fault_point("h.step"), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    inject.release_hangs()
+    t.join(timeout=2.0)
+    assert not t.is_alive() and time.monotonic() - t0 < 5.0
+
+
+def test_arm_fires_on_nth_hit_only():
+    inject.arm("nth.step", action="raise", at=3)
+    inject.fault_point("nth.step")
+    inject.fault_point("nth.step")
+    with pytest.raises(inject.FaultInjected, match="hit 3"):
+        inject.fault_point("nth.step")
+    inject.fault_point("nth.step")  # past the firing hit: quiet again
+
+
+def test_arm_from_cfg_seeded_ranges_are_deterministic():
+    cfg = {
+        "fault": {
+            "chaos": {
+                "enabled": True,
+                "seed": 7,
+                "events": ["a.step:raise:5-50", "b.step:hang:2:9.5"],
+            }
+        }
+    }
+    assert inject.arm_from_cfg(cfg) == 2
+    first = dict(inject._armed)
+    inject.reset()
+    assert inject.arm_from_cfg(cfg) == 2
+    assert dict(inject._armed) == first  # same seed -> same schedule
+    a_at = first["a.step"][1]
+    assert 5 <= a_at <= 50
+    assert first["b.step"] == ("hang", 2, 9.5)
+    inject.reset()
+    cfg["fault"]["chaos"]["seed"] = 8
+    inject.arm_from_cfg(cfg)
+    # a different seed draws a different schedule with overwhelming likelihood;
+    # equality of the full dict would make this flaky, so only assert range
+    assert 5 <= inject._armed["a.step"][1] <= 50
+
+
+def test_arm_from_cfg_disabled_is_noop():
+    assert inject.arm_from_cfg({"fault": {"chaos": {"enabled": False, "events": ["x:raise:1"]}}}) == 0
+    assert inject._armed == {}
+
+
+def test_corrupt_checkpoint_arrays_rots_below_manifest(tmp_path):
+    """The torn-publish model: manifest still calls the save complete, the
+    load fails — exactly what the watcher quarantine exists for."""
+    from sheeprl_tpu.fault.manager import CheckpointManager, latest_complete
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    path = ckpt_dir / "ckpt_10_0.ckpt"
+    CheckpointManager().save(path, {"agent": {"w": np.ones((4, 4), np.float32)}}, step=10)
+    assert latest_complete(ckpt_dir) == path
+    assert inject.corrupt_checkpoint_arrays(path) > 0
+    assert latest_complete(ckpt_dir) == path  # still "complete" by manifest
+    with pytest.raises(Exception):
+        load_state(path)

@@ -1,0 +1,323 @@
+"""Serve-tier chaos drills (acceptance proof (b)): sustained offered load
+across a scheduler-worker kill AND a torn-checkpoint publish with dropped ==
+0 and errors == 0 for every admitted request, the health probe reflecting
+each state transition (ok -> restarts visible -> quarantine visible ->
+draining); watcher poll errors counted and survivable; watcher thread kill
+-> supervised restart; SIGTERM -> graceful drain (in-process handler unit +
+the real CLI verb in a subprocess exiting 0)."""
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.fault.manager import CheckpointManager
+from sheeprl_tpu.serve.server import PolicyServer, install_drain_handlers
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = str(Path(__file__).parents[2])
+
+
+@pytest.fixture(autouse=True)
+def _inject_isolation():
+    inject.reset()
+    yield
+    inject.reset()
+
+
+def _probe(addr, timeout=5.0):
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(b'{"health": true}\n')
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+def _wait(predicate, timeout=10.0, poll=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+def test_serve_chaos_under_load_zero_dropped(toy_policy, tmp_path, recwarn):
+    """Acceptance proof (b): offered load sustained across (1) a
+    kill-the-scheduler-worker injection and (2) a torn checkpoint publish:
+    every admitted request resolves (dropped == 0, errors == 0), weight
+    versions stay monotone in serve order per client, and the health probe
+    reflects each transition."""
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    mgr = CheckpointManager()
+    cfg = {
+        "buckets": [1, 4],
+        "port": 0,
+        "max_wait_ms": 1.0,
+        "watch_poll_s": 0.05,
+        "watcher_quarantine_after": 2,
+        "supervisor": {"backoff": 0.02},
+    }
+    server = PolicyServer(toy_policy, cfg, watch_dir=str(ckpt_dir)).start()
+    addr = server.address
+    assert _probe(addr)["status"] == "ok"
+    assert _probe(addr)["ready"] is True
+
+    inject.arm("serve.scheduler.batch", action="kill-thread", at=4)
+    results = [[] for _ in range(4)]
+    errors = []
+
+    def client_loop(i):
+        for j in range(40):
+            try:
+                actions, version = server.client.act(
+                    {"x": np.full((1, 2), float(i), np.float32)}, n=1, timeout=60
+                )
+                results[i].append((np.asarray(actions), version))
+            except Exception as e:  # admitted requests must NEVER error
+                errors.append((i, j, repr(e)))
+
+    threads = [threading.Thread(target=client_loop, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    # mid-load: one good publish, then a TORN one (rotted below the digest;
+    # planted atomically so the 50ms poller can never catch it loadable)
+    mgr.save(ckpt_dir / "ckpt_10_0.ckpt", {"agent": {"w": np.ones((2, 3), np.float32)}}, step=10)
+    assert _wait(lambda: server.weights.version >= 1)
+    inject.plant_torn_checkpoint(
+        ckpt_dir, "ckpt_20_0.ckpt", {"agent": {"w": 2 * np.ones((2, 3), np.float32)}}, step=20
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads)
+
+    # zero dropped, zero errors: every admitted request resolved with actions
+    assert errors == []
+    assert [len(r) for r in results] == [40, 40, 40, 40]
+    for rows in results:
+        versions = [v for _a, v in rows]
+        assert versions == sorted(versions)  # monotone in serve order per client
+
+    # health reflects the kill (restart counted) and the torn publish
+    # (strikes counted, path quarantined), while serving stayed ok
+    assert _wait(lambda: _probe(addr)["scheduler"]["restarts"] >= 1)
+    assert _wait(lambda: len(_probe(addr)["watcher"]["quarantined"]) == 1, timeout=15)
+    health = _probe(addr)
+    assert health["status"] == "ok"
+    assert health["watcher"]["errors"] >= 2  # the 2 strikes that led to quarantine
+    assert health["watcher"]["published"] == 1  # the good save; the torn one never swapped in
+    assert health["weights"]["version"] == 1
+    assert health["weights"]["staleness_s"] >= 0.0
+
+    # a NEWER good save publishes despite the quarantined one in between
+    mgr.save(ckpt_dir / "ckpt_30_0.ckpt", {"agent": {"w": 3 * np.ones((2, 3), np.float32)}}, step=30)
+    assert _wait(lambda: server.weights.version >= 2)
+
+    server.stop()
+    post = server.health()
+    assert post["status"] == "draining" and post["ready"] is False
+
+
+def test_watcher_poll_error_counted_and_survived(toy_policy, tmp_path):
+    """A poll failure (exception, not thread death) is swallowed, COUNTED in
+    Serve/watcher_errors, and the loop keeps publishing afterwards."""
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    cfg = {"buckets": [1], "port": None, "watch_poll_s": 0.05}
+    server = PolicyServer(toy_policy, cfg, watch_dir=str(ckpt_dir)).start()
+    inject.arm("serve.watcher.poll", action="raise", at=2)
+    with pytest.warns(UserWarning, match="watcher error"):
+        assert _wait(lambda: server.stats.watcher_errors == 1)
+    assert server.watcher.alive()
+    CheckpointManager().save(
+        ckpt_dir / "ckpt_10_0.ckpt", {"agent": {"w": np.ones((2, 3), np.float32)}}, step=10
+    )
+    assert _wait(lambda: server.weights.version >= 1)
+    assert server.stats.snapshot()["Serve/watcher_errors"] == 1
+    server.stop()
+
+
+def test_watcher_thread_kill_restarted_by_supervisor(toy_policy, tmp_path):
+    """ThreadKilled escapes the per-poll except Exception, the generation
+    dies, the supervisor restarts it, and hot swaps keep working."""
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    cfg = {"buckets": [1], "port": None, "watch_poll_s": 0.05, "supervisor": {"backoff": 0.02}}
+    server = PolicyServer(toy_policy, cfg, watch_dir=str(ckpt_dir)).start()
+    inject.arm("serve.watcher.poll", action="kill-thread", at=2)
+    with pytest.warns(UserWarning, match="serve-ckpt-watcher.*restarting"):
+        assert _wait(lambda: server.supervisor.worker("serve-ckpt-watcher").restarts >= 1)
+    assert _wait(lambda: server.watcher.alive())
+    CheckpointManager().save(
+        ckpt_dir / "ckpt_10_0.ckpt", {"agent": {"w": np.ones((2, 3), np.float32)}}, step=10
+    )
+    assert _wait(lambda: server.weights.version >= 1)
+    health = server.health()
+    assert health["watcher"]["restarts"] >= 1 and health["status"] == "ok"
+    server.stop()
+
+
+def test_supervised_scheduler_own_stop_is_not_respawned(toy_policy):
+    """scheduler.stop() WITHOUT supervisor.request_stop() first (the
+    documented standalone API): the worker's clean drain-and-exit must read
+    as retired — the monitor must not respawn it into a drain race nor
+    declare the pool dead."""
+    from sheeprl_tpu.fault.supervisor import Supervisor
+    from sheeprl_tpu.serve.engine import BucketEngine
+    from sheeprl_tpu.serve.scheduler import RequestScheduler
+    from sheeprl_tpu.serve.weights import WeightStore
+
+    engine = BucketEngine(toy_policy, buckets=(1, 4), mode="greedy")
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    sup = Supervisor(max_restarts=3, backoff=0.02, lease_s=None)
+    sup.start_monitor(poll_s=0.02)
+    sched = RequestScheduler(engine, store, max_wait_s=0.001).start(supervisor=sup)
+    req = sched.submit({"x": np.ones((1, 2), np.float32)})
+    sched.result(req, timeout=10)
+    sched.stop(drain=True)
+    assert _wait(lambda: sup.worker("serve-scheduler").state == "stopped")
+    time.sleep(0.2)  # several monitor ticks: no respawn, no fatal verdict
+    h = sup.worker("serve-scheduler")
+    assert h.restarts == 0 and h.deaths == 0 and not h.is_alive()
+    assert sup.fatal is None
+    sup.stop_monitor()
+
+
+def test_watcher_tolerates_plain_pipeline_stats(tmp_path, toy_policy):
+    """stats: PipelineStats (no Serve/* fields) is annotation-legal: a load
+    strike must count nothing rather than AttributeError the poll loop to
+    death — the silent-death mode this PR exists to eliminate."""
+    from sheeprl_tpu.parallel.pipeline import PipelineStats
+    from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
+
+    ckpt_dir = tmp_path / "checkpoint"
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    watcher = CheckpointWatcher(ckpt_dir, store, poll_s=0.05, stats=PipelineStats(), quarantine_after=2)
+    watcher.start()  # plant AFTER start: a pre-existing save would be primed away
+    inject.plant_torn_checkpoint(ckpt_dir, "ckpt_10_0.ckpt", {"agent": {"w": np.ones((2, 3), np.float32)}})
+    with pytest.warns(UserWarning, match="could not load"):
+        assert _wait(lambda: watcher._strikes != {})
+    assert watcher.alive()  # the loop survived the un-countable strike
+    assert _wait(lambda: watcher.quarantined)
+    watcher.stop()
+
+
+def test_scheduler_recover_inflight_preserves_admission_order(toy_policy):
+    """Unit-level zero-drop invariant: a batch collected by a dead worker
+    generation re-enters at the HEAD of the next generation's admission."""
+    from sheeprl_tpu.serve.engine import BucketEngine
+    from sheeprl_tpu.serve.scheduler import RequestScheduler, _Request
+    from sheeprl_tpu.serve.weights import WeightStore
+
+    engine = BucketEngine(toy_policy, buckets=(1, 4), mode="greedy")
+    store = WeightStore(toy_policy.params, toy_policy.params_from_state)
+    sched = RequestScheduler(engine, store, max_wait_s=0.001)
+    inflight = [_Request({"x": np.ones((1, 2), np.float32)}, 1) for _ in range(2)]
+    sched._inflight = list(inflight)
+    assert sched.recover_inflight() == 2
+    assert sched._next_request(timeout=0.01) is inflight[0]
+    assert sched._next_request(timeout=0.01) is inflight[1]
+    assert sched.recover_inflight() == 0  # idempotent once handed over
+
+
+def test_install_drain_handlers_flags_event_and_restores():
+    event = threading.Event()
+    before_term = signal.getsignal(signal.SIGTERM)
+    before_int = signal.getsignal(signal.SIGINT)
+    restore = install_drain_handlers(event)
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        assert event.wait(2.0)
+    finally:
+        restore()
+    assert signal.getsignal(signal.SIGTERM) is before_term
+    assert signal.getsignal(signal.SIGINT) is before_int
+
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_serve_cli_sigterm_graceful_drain_exits_zero(tmp_path):
+    """The real CLI verb in a subprocess: SIGTERM mid-serve stops accepting,
+    settles what was admitted, prints the drain line, and exits 0."""
+    run(PPO_TINY + [f"log_root={tmp_path}/train", "dry_run=True", "checkpoint.save_last=True"])
+    ckpts = sorted(glob.glob(f"{tmp_path}/train/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+    assert ckpts
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu",
+            "serve",
+            f"checkpoint_path={ckpts[-1]}",
+            "fabric.accelerator=cpu",
+            f"serve.port={port}",
+            "serve.buckets=[1,2]",
+            "serve.log_every_s=60",
+        ],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        addr = ("127.0.0.1", port)
+        deadline = time.monotonic() + 180
+        while True:  # wait for the socket front end (AOT compiles first)
+            try:
+                health = _probe(addr)
+                break
+            except (ConnectionRefusedError, OSError):
+                assert proc.poll() is None, f"server died early:\n{proc.stdout.read()}"
+                assert time.monotonic() < deadline, "server never came up"
+                time.sleep(0.5)
+        assert health["status"] == "ok"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    assert proc.returncode == 0, f"non-zero exit after SIGTERM:\n{out}"
+    assert "received SIGTERM — graceful drain" in out
+    assert "serve: drained cleanly" in out
