@@ -299,6 +299,18 @@ def _lane_serve() -> None:
     serve_main()
 
 
+@lane("serve_sessions", "sessions", "ppo_recurrent_serve_session_steps_per_sec")
+def _lane_serve_sessions() -> None:
+    # Stateful-session SLO lane: K closed-loop session clients against the
+    # graft-sessions tier; BENCH_SESSIONS_MODE=batched|naive pairs the bucket
+    # ladder against per-session dispatch on identical traffic. Knobs in
+    # benchmarks/serve_sessions_bench.py, interpretation in howto/serving.md.
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from serve_sessions_bench import main as sessions_main
+
+    sessions_main()
+
+
 def main() -> None:
     # Persistent XLA compilation cache: the PPO train/rollout programs cost
     # ~15s to compile; caching them across bench invocations measures the

@@ -1,5 +1,7 @@
 """Dreamer-V3 evaluation entrypoint
-(reference: ``sheeprl/algos/dreamer_v3/evaluate.py``)."""
+(reference: ``sheeprl/algos/dreamer_v3/evaluate.py``) plus the
+graft-sessions stateful policy builder: the RSSM posterior, the recurrent
+state and the one-hot action carry served as server-side session state."""
 
 from __future__ import annotations
 
@@ -11,9 +13,9 @@ from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
 from sheeprl_tpu.algos.dreamer_v3.utils import test
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.registry import register_evaluation, register_policy_builder
 
-__all__ = ["evaluate_dreamer_v3"]
+__all__ = ["evaluate_dreamer_v3", "serve_policy_dreamer_v3"]
 
 
 @register_evaluation(algorithms="dreamer_v3")
@@ -47,3 +49,138 @@ def evaluate_dreamer_v3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     )
     test(player, params, fabric, cfg, log_dir, writer=logger)
     logger.close()
+
+
+@register_policy_builder(algorithms=["dreamer_v3"])
+def serve_policy_dreamer_v3(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state, full_state=None):
+    """:class:`~sheeprl_tpu.serve.policy.StatefulServePolicy` over the
+    DreamerV3 world model + actor.
+
+    Dreamer checkpoints carry their model trees at the TOP level
+    (``world_model``/``actor``/``critic``/``target_critic``) with no
+    ``agent`` key, so this builder declares ``full_state`` and rebuilds from
+    it (``agent_state`` is ignored); the hot-swap path
+    (``params_from_state``) consumes the same full-state layout, which is
+    what the checkpoint watcher publishes for agent-less checkpoints.
+
+    Per-session state row: ``actions`` (the one-hot/continuous action carry
+    ``PlayerDV3`` threads between env steps), ``recurrent`` (the RSSM
+    deterministic state), ``stochastic`` (the flattened posterior sample)
+    and ``key`` — the offline eval loop's host-side per-step
+    ``key, subkey = split(key)`` moved in-graph, so the posterior draw (and
+    sample-mode action draw) of a served session is bit-identical to the
+    sequential eval loop. The step is ``PlayerDV3._step_fn`` written per row
+    and ``vmap``-ped over the session batch.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import actor_sample, extract_obs_masks
+    from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs
+    from sheeprl_tpu.serve.policy import StatefulServePolicy
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape if is_continuous else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    state = full_state or {}
+    world_model, actor, _, params, _player = build_agent(
+        fabric,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state.get("world_model"),
+        state.get("actor"),
+        state.get("critic"),
+        state.get("target_critic"),
+    )
+    params_template = params
+    rssm = world_model.rssm
+    encoder = world_model.encoder
+    sum_actions = int(np.sum(actions_dim))
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_spec = {}
+    for k in cnn_keys:
+        obs_spec[k] = (tuple(int(d) for d in observation_space[k].shape[-3:]), np.float32)
+    for k in mlp_keys:
+        obs_spec[k] = ((int(np.prod(observation_space[k].shape)),), np.float32)
+
+    base_key = jax.random.PRNGKey(int(cfg.get("seed") or 0))
+
+    def _row_step(p, obs_row, state_row, greedy):
+        # PlayerDV3._step_fn per session, batch shape (1, ...)
+        obs1 = {k: v[None] for k, v in obs_row.items()}
+        ks = jax.random.split(state_row["key"])
+        new_key, subkey = ks[0], ks[1]
+        wmp = p["world_model"]
+        emb = encoder.apply(wmp["encoder"], obs1)
+        rec = rssm.recurrent_model.apply(
+            wmp["recurrent_model"],
+            jnp.concatenate([state_row["stochastic"][None], state_row["actions"][None]], axis=-1),
+            state_row["recurrent"][None],
+        )
+        k_repr, k_act = jax.random.split(subkey)
+        _, stoch = rssm._representation(wmp, rec, emb, k_repr)
+        acts, _ = actor_sample(
+            actor,
+            p["actor"],
+            jnp.concatenate([stoch, rec], axis=-1),
+            k_act,
+            greedy,
+            mask=extract_obs_masks(obs1),
+        )
+        if is_continuous:
+            env_actions = jnp.concatenate(acts, axis=-1)[0]
+        else:
+            env_actions = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)[0]
+        new_state = {
+            "actions": jnp.concatenate(acts, axis=-1)[0],
+            "recurrent": rec[0],
+            "stochastic": stoch[0],
+            "key": new_key,
+        }
+        return env_actions, new_state
+
+    def step_fn(p, obs, state, key, greedy):
+        del key  # per-session streams live IN the state (determinism/parity)
+        return jax.vmap(lambda o, s: _row_step(p, o, s, greedy))(obs, state)
+
+    def init_fn(p, n):
+        # PlayerDV3.init_states: zero action carry + the (learnable) RSSM
+        # initial states derived from the LIVE world-model params
+        rec, post = rssm.get_initial_states(p["world_model"], (n,))
+        return {
+            "actions": jnp.zeros((n, sum_actions), jnp.float32),
+            "recurrent": rec,
+            "stochastic": post,
+            "key": jnp.broadcast_to(base_key, (n, *base_key.shape)),
+        }
+
+    def prepare(obs, n):
+        prepared = prepare_obs(fabric, {k: obs[k] for k in obs_spec}, cnn_keys=cnn_keys, num_envs=n)
+        return {k: np.asarray(prepared[k]).reshape(n, *obs_spec[k][0]) for k in obs_spec}
+
+    def params_from_state(new_state):
+        # the watcher hands the FULL checkpoint state for agent-less layouts
+        rebuilt = {
+            k: jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params_template[k], new_state[k])
+            for k in ("world_model", "actor", "critic", "target_critic")
+        }
+        return fabric.put_replicated(rebuilt)
+
+    action_dim = int(sum_actions) if is_continuous else len(actions_dim)
+    return StatefulServePolicy(
+        name=str(cfg.algo.name),
+        params=params,
+        obs_spec=obs_spec,
+        action_dim=action_dim,
+        step_fn=step_fn,
+        init_fn=init_fn,
+        prepare=prepare,
+        params_from_state=params_from_state,
+    )

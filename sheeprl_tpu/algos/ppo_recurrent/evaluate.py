@@ -1,5 +1,8 @@
 """Recurrent PPO evaluation entrypoint
-(reference: ``sheeprl/algos/ppo_recurrent/evaluate.py``)."""
+(reference: ``sheeprl/algos/ppo_recurrent/evaluate.py``) plus the
+graft-sessions stateful policy builder: the LSTM hidden pair, the previous
+one-hot/continuous action carry and the per-session sample-key stream served
+as server-side session state."""
 
 from __future__ import annotations
 
@@ -11,9 +14,9 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
 from sheeprl_tpu.algos.ppo_recurrent.utils import test
 from sheeprl_tpu.envs.factory import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.registry import register_evaluation, register_policy_builder
 
-__all__ = ["evaluate_ppo_recurrent"]
+__all__ = ["evaluate_ppo_recurrent", "serve_policy_ppo_recurrent"]
 
 
 @register_evaluation(algorithms="ppo_recurrent")
@@ -38,3 +41,113 @@ def evaluate_ppo_recurrent(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     _, params, player = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, state["agent"])
     test(player, params, fabric, cfg, log_dir, writer=logger)
     logger.close()
+
+
+@register_policy_builder(algorithms=["ppo_recurrent"])
+def serve_policy_ppo_recurrent(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state):
+    """:class:`~sheeprl_tpu.serve.policy.StatefulServePolicy` over the
+    recurrent PPO agent.
+
+    Per-session state row: ``{hx, cx}`` (the LSTM hidden pair the offline
+    player threads across env steps), ``prev_actions`` (the previous
+    raw-action carry the eval loop feeds back) and ``key`` (the per-session
+    PRNG stream — the eval loop's host-side ``key, subkey = split(key)``
+    moved in-graph, so a served session replays the sequential eval loop
+    exactly; greedy mode never consumes it). The step is the offline
+    player's T=1 forward (``sample_actions`` + the eval loop's host-side
+    action conversion moved in-graph), written per row and ``vmap``-ped over
+    the session batch — row independence is by construction, which is what
+    makes bucket padding and cross-session batching bit-exact.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.ppo_recurrent.agent import sample_actions
+    from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs
+    from sheeprl_tpu.serve.policy import StatefulServePolicy
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    agent, params, _ = build_agent(fabric, actions_dim, is_continuous, cfg, observation_space, agent_state)
+    params_template = params
+    hidden = int(cfg.algo.rnn.lstm.hidden_size)
+    sum_actions = int(sum(actions_dim))
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_spec = {}
+    for k in cnn_keys:
+        obs_spec[k] = (tuple(int(d) for d in observation_space[k].shape[-3:]), np.float32)
+    for k in mlp_keys:
+        obs_spec[k] = ((int(np.prod(observation_space[k].shape)),), np.float32)
+
+    base_key = jax.random.PRNGKey(int(cfg.get("seed") or 0))
+
+    def _row_step(p, obs_row, state_row, greedy):
+        # the offline eval loop per session: obs/prev time-major (1, 1, ...)
+        obs1 = {k: v[None, None] for k, v in obs_row.items()}
+        ks = jax.random.split(state_row["key"])
+        new_key, subkey = ks[0], ks[1]
+        acts, _logprob, _values, (hx, cx) = sample_actions(
+            agent,
+            p,
+            obs1,
+            state_row["prev_actions"][None, None],
+            state_row["hx"][None],
+            state_row["cx"][None],
+            subkey,
+            greedy=greedy,
+        )
+        if is_continuous:
+            env_actions = jnp.concatenate(acts, axis=-1)[0, 0]
+        else:
+            env_actions = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)[0, 0]
+        new_state = {
+            "hx": hx[0],
+            "cx": cx[0],
+            "prev_actions": jnp.concatenate(acts, axis=-1)[0, 0],
+            "key": new_key,
+        }
+        return env_actions, new_state
+
+    def step_fn(p, obs, state, key, greedy):
+        del key  # per-session streams live IN the state (determinism/parity)
+        return jax.vmap(lambda o, s: _row_step(p, o, s, greedy))(obs, state)
+
+    def init_fn(p, n):
+        del p  # zero-state LSTM; nothing params-dependent
+        z = jnp.zeros((n, hidden), jnp.float32)
+        return {
+            "hx": z,
+            "cx": jnp.zeros((n, hidden), jnp.float32),
+            "prev_actions": jnp.zeros((n, sum_actions), jnp.float32),
+            "key": jnp.broadcast_to(base_key, (n, *base_key.shape)),
+        }
+
+    def prepare(obs, n):
+        prepared = prepare_obs(fabric, {k: obs[k] for k in obs_spec}, cnn_keys=cnn_keys, num_envs=n)
+        # the algo's prepare is time-major (1, n, ...); the serve tier is
+        # batch-major per row — the step re-adds the T axis in-graph
+        return {k: prepared[k].reshape(n, *obs_spec[k][0]) for k in obs_spec}
+
+    def params_from_state(new_agent_state):
+        rebuilt = jax.tree.map(lambda t, s: jnp.asarray(s, dtype=t.dtype), params_template, new_agent_state)
+        return fabric.put_replicated(rebuilt)
+
+    action_dim = int(sum(actions_dim)) if is_continuous else len(actions_dim)
+    return StatefulServePolicy(
+        name=str(cfg.algo.name),
+        params=params,
+        obs_spec=obs_spec,
+        action_dim=action_dim,
+        step_fn=step_fn,
+        init_fn=init_fn,
+        prepare=prepare,
+        params_from_state=params_from_state,
+    )

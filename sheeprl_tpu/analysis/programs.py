@@ -44,6 +44,7 @@ AUDIT_SOURCES: Tuple[str, ...] = (
     "sheeprl_tpu.algos.sac.sac",
     "sheeprl_tpu.algos.sac.sac_sebulba",
     "sheeprl_tpu.serve.engine",
+    "sheeprl_tpu.serve.sessions",
 )
 
 

@@ -314,7 +314,7 @@ def serve_algorithm(cfg: DotDict) -> None:
     from sheeprl_tpu.parallel import Fabric
     from sheeprl_tpu.serve.server import serve_policy
     from sheeprl_tpu.utils.checkpoint import load_state
-    from sheeprl_tpu.utils.registry import resolve_policy_builder
+    from sheeprl_tpu.utils.registry import registered_policy_builder_names, resolve_policy_builder
     from sheeprl_tpu.utils.utils import pin_cpu_platform
 
     pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
@@ -330,7 +330,8 @@ def serve_algorithm(cfg: DotDict) -> None:
     entry = resolve_policy_builder(cfg.algo.name)
     if entry is None:
         raise RuntimeError(
-            f"Given the algorithm named '{cfg.algo.name}', no serving policy builder has been registered."
+            f"Given the algorithm named '{cfg.algo.name}', no serving policy builder has been "
+            f"registered. Registered builders: {', '.join(registered_policy_builder_names())}."
         )
     builder = get_entrypoint(entry)
     fabric.launch(serve_policy, cfg, state, builder)

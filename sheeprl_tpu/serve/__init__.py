@@ -21,6 +21,13 @@ Layers (``howto/serving.md`` is the operator guide):
   deliberately naive :class:`JitEngine` baseline the bench compares against;
 - :mod:`sheeprl_tpu.serve.scheduler` — :class:`RequestScheduler`: max-wait /
   max-batch admission, backpressure past a queue bound, ``Serve/*`` metrics;
+- :mod:`sheeprl_tpu.serve.sessions` — graft-sessions: the STATEFUL serving
+  tier (:class:`StatefulServePolicy` behind a server-side
+  :class:`SessionCache` of device-resident per-user state rows, stepped in
+  bucket-padded batches by the :class:`SessionEngine`'s AOT
+  ``serve.session[N].step`` programs — per-user GRU/LSTM hiddens and Dreamer
+  posteriors carried across requests with TTL eviction, an LRU spill cap and
+  swap-compatible hot weight updates);
 - :mod:`sheeprl_tpu.serve.weights` — :class:`WeightStore` versioned hot swap
   + :class:`CheckpointWatcher` (checkpoint-dir manifests → publishes);
 - :mod:`sheeprl_tpu.serve.server` — :class:`PolicyServer` assembly,
@@ -36,15 +43,19 @@ SIGTERM/SIGINT trigger a graceful drain in the CLI.
 """
 
 from sheeprl_tpu.serve.engine import BucketEngine, JitEngine
-from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.serve.policy import ServePolicy, StatefulServePolicy
 from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeClosedError, ServeOverloadedError, ServeStats
 from sheeprl_tpu.serve.server import PolicyClient, PolicyServer, install_drain_handlers
+from sheeprl_tpu.serve.sessions import SessionCache, SessionEngine
 from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
 
 __all__ = [
     "BucketEngine",
     "JitEngine",
     "ServePolicy",
+    "StatefulServePolicy",
+    "SessionCache",
+    "SessionEngine",
     "RequestScheduler",
     "ServeStats",
     "ServeOverloadedError",

@@ -41,11 +41,39 @@ from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.parallel.pipeline import DoubleBufferedStager
 from sheeprl_tpu.serve.policy import ServePolicy
 
-__all__ = ["BucketEngine", "JitEngine", "default_buckets", "bucket_program"]
+__all__ = ["BucketEngine", "JitEngine", "default_buckets", "bucket_program", "chunk_plan", "check_chunk_order"]
 
 
 def default_buckets() -> Tuple[int, ...]:
     return (1, 8, 32, 128)
+
+
+def chunk_plan(n: int, cap: int) -> "list[Tuple[int, int]]":
+    """``[start, stop)`` spans chunking an ``n``-row batch through a
+    ``cap``-row ladder top. One function for both engines so the ordering
+    contract below has a single producer."""
+    return [(start, min(start + cap, n)) for start in range(0, n, cap)]
+
+
+def check_chunk_order(spans: "list[Tuple[int, int]]", n: int) -> None:
+    """Assert a chunk plan is in-order, contiguous and covers ``[0, n)``.
+
+    For the stateless engine a reordered chunk would silently hand caller A
+    caller B's rows — the stateless parity tests can't see it because every
+    reference they compare against is built from the same plan. For the
+    SESSION engine row order additionally binds action rows to session
+    states, so a reorder corrupts state streams. Checked explicitly on every
+    oversize dispatch; it is O(#chunks)."""
+    expect = 0
+    for start, stop in spans:
+        if start != expect or stop <= start:
+            raise RuntimeError(
+                f"serve chunk plan out of order: spans {spans} do not walk [0, {n}) "
+                "contiguously — row<->caller/session binding would be corrupted"
+            )
+        expect = stop
+    if expect != n:
+        raise RuntimeError(f"serve chunk plan covers [0, {expect}) but the batch has {n} rows")
 
 
 def _shape_struct(tree: Any) -> Any:
@@ -196,9 +224,11 @@ class BucketEngine:
         n = self.policy.validate_batch(obs)
         cap = self.buckets[-1]
         if n > cap:
+            spans = chunk_plan(n, cap)
+            check_chunk_order(spans, n)
             outs = []
-            for start in range(0, n, cap):
-                chunk = {k: v[start : start + cap] for k, v in obs.items()}
+            for start, stop in spans:
+                chunk = {k: v[start:stop] for k, v in obs.items()}
                 sub = key if key is None else jax.random.fold_in(key, start)
                 outs.append(self.infer(params, chunk, key=sub, greedy=greedy))
             return np.concatenate(outs, axis=0)

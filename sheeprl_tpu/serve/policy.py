@@ -8,6 +8,15 @@ into a :class:`ServePolicy`: pure jittable greedy/sample programs over a
 *prepared* observation dict, plus the host-side preparation and the
 params-rebuild hook the hot-swap path needs. Everything downstream — the AOT
 bucket engine, the scheduler, the weight store — is algorithm-blind.
+
+:class:`StatefulServePolicy` is the *sessionful* variant of the contract
+(graft-sessions): recurrent/latent policies (``ppo_recurrent``'s LSTM hidden,
+DreamerV3's posterior + recurrent state + one-hot action carry) expose one
+``step_fn(params, obs, state, key) -> (actions, state')`` over a per-row state
+pytree plus ``init_fn(params, n)``. The per-user state rows live server-side
+in a :class:`~sheeprl_tpu.serve.sessions.SessionCache` slab and are stepped
+in bucket-padded batches by the
+:class:`~sheeprl_tpu.serve.sessions.SessionEngine`.
 """
 
 from __future__ import annotations
@@ -17,7 +26,29 @@ from typing import Any, Callable, Dict, Tuple
 
 import numpy as np
 
-__all__ = ["ServePolicy"]
+__all__ = ["ServePolicy", "StatefulServePolicy"]
+
+
+def _validate_batch(obs_spec: Dict[str, Tuple[Tuple[int, ...], Any]], obs: Dict[str, np.ndarray]) -> int:
+    """Shared spec check for both policy contracts: returns the (shared)
+    leading batch size; raises ``ValueError`` on unknown/missing keys,
+    per-row shape mismatch, or inconsistent batch sizes."""
+    if set(obs) != set(obs_spec):
+        raise ValueError(
+            f"observation keys {sorted(obs)} do not match the policy's spec {sorted(obs_spec)}"
+        )
+    n = None
+    for k, (shape, _) in obs_spec.items():
+        v = obs[k]
+        if v.ndim != len(shape) + 1 or tuple(v.shape[1:]) != tuple(shape):
+            raise ValueError(
+                f"observation '{k}' has per-row shape {tuple(v.shape[1:])}, expected {tuple(shape)}"
+            )
+        if n is None:
+            n = int(v.shape[0])
+        elif int(v.shape[0]) != n:
+            raise ValueError(f"inconsistent batch sizes across observation keys: {n} vs {v.shape[0]}")
+    return int(n or 0)
 
 
 @dataclasses.dataclass
@@ -60,19 +91,69 @@ class ServePolicy:
         """Check a prepared batch against ``obs_spec``; returns the (shared)
         leading batch size. Raises ``ValueError`` on unknown/missing keys,
         per-row shape mismatch, or inconsistent batch sizes."""
-        if set(obs) != set(self.obs_spec):
-            raise ValueError(
-                f"observation keys {sorted(obs)} do not match the policy's spec {sorted(self.obs_spec)}"
-            )
-        n = None
-        for k, (shape, _) in self.obs_spec.items():
-            v = obs[k]
-            if v.ndim != len(shape) + 1 or tuple(v.shape[1:]) != tuple(shape):
-                raise ValueError(
-                    f"observation '{k}' has per-row shape {tuple(v.shape[1:])}, expected {tuple(shape)}"
-                )
-            if n is None:
-                n = int(v.shape[0])
-            elif int(v.shape[0]) != n:
-                raise ValueError(f"inconsistent batch sizes across observation keys: {n} vs {v.shape[0]}")
-        return int(n or 0)
+        return _validate_batch(self.obs_spec, obs)
+
+
+@dataclasses.dataclass
+class StatefulServePolicy:
+    """One *stateful* policy: per-user recurrent/latent state carried across
+    requests, stepped server-side.
+
+    ``step_fn(params, obs, state, key, greedy)`` is a PURE jittable callable:
+    ``obs`` a prepared batch dict matching ``obs_spec`` (``B`` rows),
+    ``state`` a pytree whose leaves carry a leading ``B`` row axis (one row =
+    one session), ``key`` a batch-level PRNG key, ``greedy`` a STATIC python
+    bool (the engine compiles one program per mode). It returns
+    ``(actions, state')`` — env-format actions shaped ``(B, action_dim)``
+    exactly like :class:`ServePolicy`, and the advanced state with the same
+    structure/avals as ``state``. Rows must be independent: row ``i`` of a
+    batched step must be bit-identical to stepping that row alone, which is
+    what makes bucket padding and cross-session batching free. Builders that
+    need in-step randomness with *per-session* determinism (DreamerV3's
+    posterior sample, sample-mode action draws) carry a per-row PRNG key
+    INSIDE the state and split it in-graph — the offline eval loop's
+    host-side ``key, subkey = split(key)`` moved into the step — so a served
+    session replays the sequential eval loop bit for bit; the batch-level
+    ``key`` argument is for builders that want cross-batch entropy instead.
+
+    ``init_fn(params, n)`` builds ``n`` fresh per-row states (pure jittable —
+    it runs INSIDE the session step program so params-dependent initial
+    states, e.g. Dreamer's learnable initial recurrent state, re-derive from
+    the live weights and fresh/padded rows cost no extra dispatch).
+
+    ``prepare`` / ``params_from_state`` are exactly the
+    :class:`ServePolicy` contracts: host-side obs normalization and the
+    hot-swap rebuild hook. State compatibility across swaps is structural: a
+    rebuilt params tree with identical avals steps live sessions unchanged
+    (``ServePolicy.params_from_state`` guarantees that by construction); the
+    session cache versions-and-reinits otherwise.
+    """
+
+    name: str
+    params: Any
+    #: key -> (per-row shape, dtype) of the PREPARED observation leaves
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], Any]]
+    action_dim: int
+    step_fn: Callable[..., Tuple[Any, Any]]
+    init_fn: Callable[[Any, int], Any]
+    prepare: Callable[[Dict[str, np.ndarray], int], Dict[str, np.ndarray]]
+    params_from_state: Callable[[Any], Any]
+
+    def validate_batch(self, obs: Dict[str, np.ndarray]) -> int:
+        """See :meth:`ServePolicy.validate_batch`."""
+        return _validate_batch(self.obs_spec, obs)
+
+    def state_spec(self, params: Any = None) -> Any:
+        """Per-row state avals (a pytree of ``jax.ShapeDtypeStruct`` WITHOUT
+        the row axis), derived abstractly from ``init_fn`` under ``params``
+        (default: this policy's own). The session cache allocates its slab
+        against this, and the engine's swap check re-derives it under the
+        SWAPPED tree through this same method — one derivation, so the
+        compatibility comparison can never drift from the allocation."""
+        import jax
+
+        params = self.params if params is None else params
+        params_struct = jax.tree.map(lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), params)
+        # n closed over statically: row counts are SHAPES, never traced
+        row = jax.eval_shape(lambda p: self.init_fn(p, 1), params_struct)
+        return jax.tree.map(lambda s: jax.ShapeDtypeStruct(tuple(s.shape[1:]), s.dtype), row)

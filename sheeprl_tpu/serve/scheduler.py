@@ -71,6 +71,7 @@ class ServeStats(PipelineStats):
         self.watcher_errors = 0  # swallowed checkpoint-watcher poll failures
         self._latencies = collections.deque(maxlen=int(latency_window))
         self._depth_fn = None  # wired by the scheduler
+        self._sessions_fn = None  # wired when serving a stateful policy
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -113,15 +114,36 @@ class ServeStats(PipelineStats):
                     "Serve/p99_latency_ms": round(p99 * 1e3, 3),
                 }
             )
+            sessions_fn = self._sessions_fn
+        if sessions_fn is not None:
+            s = sessions_fn()
+            out.update(
+                {
+                    "Serve/sessions_live": s["live"],
+                    "Serve/sessions_peak": s["peak"],
+                    "Serve/sessions_opened": s["opened"],
+                    "Serve/sessions_evicted": s["evicted_lru"] + s["evicted_ttl"],
+                    "Serve/sessions_ttl_evicted": s["evicted_ttl"],
+                    "Serve/sessions_reset": s["resets"],
+                    "Serve/sessions_client_resets": s["client_resets"],
+                    "Serve/sessions_state_bytes": s["state_bytes"],
+                }
+            )
         return out
 
 
 class _Request:
-    __slots__ = ("obs", "n", "event", "actions", "version", "error", "t_submit", "t_resolve")
+    __slots__ = (
+        "obs", "n", "session_id", "reset", "event", "actions", "version", "error", "t_submit", "t_resolve",
+    )
 
-    def __init__(self, obs: Dict[str, np.ndarray], n: int) -> None:
+    def __init__(
+        self, obs: Dict[str, np.ndarray], n: int, session_id: Optional[str] = None, reset: bool = False
+    ) -> None:
         self.obs = obs
         self.n = n
+        self.session_id = session_id
+        self.reset = bool(reset)
         self.event = threading.Event()
         self.actions: Optional[np.ndarray] = None
         self.version = -1
@@ -152,6 +174,19 @@ class RequestScheduler:
     second scheduler for that). In sample mode each BATCH gets a fresh key
     folded from the scheduler's base key — per-row decorrelation rides the
     in-graph per-row key split of the policy's ``sample_fn``.
+
+    With ``sessions`` (the engine's
+    :class:`~sheeprl_tpu.serve.sessions.SessionCache` — a
+    :class:`~sheeprl_tpu.serve.sessions.SessionEngine` is then required)
+    requests carry ``session_id``/``reset`` and the scheduler runs the
+    STATEFUL batch path: each admitted request's session resolves to its
+    state slab row (TTL sweeps piggyback on the admission loop), at most one
+    request per session is admitted into a batch (a second one is held over
+    — in-order per-session stepping is the whole point), and the batch is
+    ONE ``serve.session[N].step`` dispatch. On a weight swap the engine
+    checks state-aval compatibility once per version: matching avals step
+    live sessions seamlessly, a mismatch triggers the cache's versioned
+    re-init.
     """
 
     def __init__(
@@ -164,6 +199,7 @@ class RequestScheduler:
         greedy: bool = True,
         seed: int = 0,
         stats: Optional[ServeStats] = None,
+        sessions: Any = None,
     ) -> None:
         if max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
@@ -179,8 +215,14 @@ class RequestScheduler:
         self.queue_bound = int(queue_bound)
         self.greedy = bool(greedy)
         self.stats = stats or ServeStats()
+        self.sessions = sessions
+        if sessions is not None and not (hasattr(engine, "step_sessions") and hasattr(engine, "check_swap")):
+            raise ValueError("a session cache needs a SessionEngine (engine lacks step_sessions/check_swap)")
+        self._last_version: Optional[int] = None  # swap-compat check cadence
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=self.queue_bound)
         self.stats._depth_fn = self._q.qsize
+        if sessions is not None:
+            self.stats._sessions_fn = sessions.snapshot
         self._holdover: Optional[_Request] = None
         self._inflight: Optional[List[_Request]] = None  # collected, not yet resolved
         self._requeue: List[_Request] = []  # recovered from a dead worker generation
@@ -280,16 +322,31 @@ class RequestScheduler:
 
     # -- client side --------------------------------------------------------- #
 
-    def submit(self, obs: Dict[str, np.ndarray], timeout: Optional[float] = None) -> _Request:
+    def submit(
+        self,
+        obs: Dict[str, np.ndarray],
+        timeout: Optional[float] = None,
+        session_id: Optional[str] = None,
+        reset: bool = False,
+    ) -> _Request:
         """Enqueue a prepared batch; returns the request future. Blocks while
         the queue sits at its bound (backpressure); ``timeout`` seconds later
         it gives up with :class:`ServeOverloadedError`. Sample-mode keys are
         the SCHEDULER's (one fresh fold per batch — see class docstring);
-        callers needing caller-chosen keys talk to the engine directly."""
+        callers needing caller-chosen keys talk to the engine directly.
+
+        On a stateful server ``session_id`` names the caller's session (one
+        row — per-user state is per row) and ``reset`` restarts its state
+        from ``init_fn`` before stepping; omitting ``session_id`` serves a
+        one-shot step from a fresh throwaway state (the donor row)."""
         if self._closed.is_set():
             raise ServeClosedError("scheduler is stopped")
+        if session_id is not None and self.sessions is None:
+            raise ValueError("session_id on a stateless server (this policy carries no per-user state)")
         n = self.engine.policy.validate_batch(obs)
-        req = _Request(obs, n)
+        if session_id is not None and n != 1:
+            raise ValueError(f"a session request is one state row, got n={n}")
+        req = _Request(obs, n, session_id=session_id, reset=reset)
         try:
             if timeout is None:
                 while not self._closed.is_set():
@@ -337,12 +394,16 @@ class RequestScheduler:
 
     def _collect(self) -> List[_Request]:
         """One admission round: first request arms the deadline, admission
-        closes at ``max_batch`` rows or the deadline, whichever first."""
+        closes at ``max_batch`` rows or the deadline, whichever first. A
+        second request for a session already in the batch also closes it
+        (held over, never reordered) — one batch steps a session at most
+        once, so per-session streams stay strictly ordered."""
         first = self._next_request(timeout=0.05)
         if first is None:
             return []
         batch = [first]
         rows = first.n
+        seen = {first.session_id} if first.session_id is not None else set()
         deadline = time.perf_counter() + self.max_wait_s
         while rows < self.max_batch:
             remaining = deadline - time.perf_counter()
@@ -351,11 +412,13 @@ class RequestScheduler:
             nxt = self._next_request(timeout=remaining)
             if nxt is None:
                 break
-            if rows + nxt.n > self.max_batch:
+            if rows + nxt.n > self.max_batch or (nxt.session_id is not None and nxt.session_id in seen):
                 self._holdover = nxt  # serve it at the head of the next batch
                 break
             batch.append(nxt)
             rows += nxt.n
+            if nxt.session_id is not None:
+                seen.add(nxt.session_id)
         return batch
 
     def _serve_batch(self, batch: List[_Request]) -> None:
@@ -366,16 +429,48 @@ class RequestScheduler:
             else {k: np.concatenate([r.obs[k] for r in batch], axis=0) for k in batch[0].obs}
         )
         version, params = self.weights.pull()
+        if self.sessions is not None and version != self._last_version:
+            # once per swapped version: live sessions ride a compatible tree
+            # untouched; an incompatible one versions-and-reinits the cache
+            self.engine.check_swap(params)
+            self._last_version = version
         key = None
         if not self.greedy:
             key = jax.random.fold_in(self._base_key, self._batch_idx)
             self._batch_idx += 1
         try:
-            actions = self.engine.infer(params, obs, key=key, greedy=self.greedy)
+            if self.sessions is not None:
+                session_ids: List[Optional[str]] = []
+                resets: List[bool] = []
+                for r in batch:
+                    if r.session_id is None:
+                        # one-shot rows: a fresh throwaway state on the donor row
+                        session_ids.extend([None] * r.n)
+                        resets.extend([False] * r.n)
+                    else:
+                        session_ids.append(r.session_id)
+                        resets.append(r.reset)
+                if key is None:  # the step program takes a key in both modes
+                    key = jax.random.fold_in(self._base_key, self._batch_idx)
+                    self._batch_idx += 1
+                # step_sessions commits fresh flags only AFTER a successful
+                # dispatch — a failed one leaves the sessions re-initializable
+                actions = self.engine.step_sessions(params, obs, session_ids, resets, key=key)
+            else:
+                actions = self.engine.infer(params, obs, key=key, greedy=self.greedy)
         except BaseException as e:  # resolve callers, keep serving
             for r in batch:
                 r.resolve(None, version, error=e)
             return
+        if self.sessions is not None:
+            # the state slab is COMMITTED: re-serving this batch after a
+            # worker death in the resolve loop below would step every session
+            # a second time for one client-observed step (silent per-user
+            # stream corruption). Drop the in-flight marker now — stateful
+            # recovery is exactly-once-or-visible-timeout, while the
+            # stateless path stays at-least-once (re-dispatch is idempotent
+            # there).
+            self._inflight = None
         self.stats.observe_version(version)
         self.stats.add("batches", 1)
         self.stats.add("rows_served", rows)
@@ -386,16 +481,23 @@ class RequestScheduler:
 
     def _settle(self, pending: List[_Request], drain: bool) -> None:
         """Shutdown settlement: serve ``pending`` in admission-preserving
-        chunks of at most ``max_batch`` rows, or fail them all closed."""
+        chunks of at most ``max_batch`` rows (and at most one request per
+        session — drained session steps stay strictly ordered too), or fail
+        them all closed."""
         if drain:
             batch: List[_Request] = []
             rows = 0
+            seen: set = set()
             for r in pending:
-                if batch and rows + r.n > self.max_batch:
+                if batch and (
+                    rows + r.n > self.max_batch or (r.session_id is not None and r.session_id in seen)
+                ):
                     self._serve_batch(batch)
-                    batch, rows = [], 0
+                    batch, rows, seen = [], 0, set()
                 batch.append(r)
                 rows += r.n
+                if r.session_id is not None:
+                    seen.add(r.session_id)
             if batch:
                 self._serve_batch(batch)
         else:
@@ -405,6 +507,10 @@ class RequestScheduler:
 
     def _run(self, ctx: Any = None) -> None:
         while not self._stop.is_set():
+            if self.sessions is not None:
+                # TTL sweep rides the admission loop (cadence-gated inside):
+                # sessions idle past ttl_s free their slab rows under load
+                self.sessions.maybe_sweep()
             batch = self._collect()
             if batch:
                 # the in-flight marker is what makes a worker death lossless:

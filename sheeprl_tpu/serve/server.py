@@ -16,9 +16,16 @@ Wire protocol (one JSON object per line, both directions)::
     -> {"health": true}
     <- {"status": "ok", "ready": true, ...}   # liveness/readiness probe
 
+    # stateful policies (graft-sessions): name your session; the server
+    # carries your recurrent/latent state between requests
+    -> {"obs": {...}, "session_id": "user-42"}
+    -> {"obs": {...}, "session_id": "user-42", "reset": true}  # new episode
+
 ``obs`` leaves are RAW env observations (the server applies the algorithm's
 own normalization via ``ServePolicy.prepare``); ``n`` (default 1) is the
-number of batched rows in the request.
+number of batched rows in the request. ``session_id`` (stateful policies
+only) binds the request to a server-side state row; ``reset`` restarts that
+session's state from the policy's initial state before stepping.
 
 Supervision: the scheduler worker and the checkpoint watcher run under one
 :class:`~sheeprl_tpu.fault.supervisor.Supervisor` (config ``serve.
@@ -46,8 +53,9 @@ import numpy as np
 
 from sheeprl_tpu.fault.supervisor import Supervisor
 from sheeprl_tpu.serve.engine import BucketEngine, JitEngine, default_buckets
-from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.serve.policy import ServePolicy, StatefulServePolicy
 from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeStats
+from sheeprl_tpu.serve.sessions import SessionEngine, default_session_buckets
 from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
 
 __all__ = ["PolicyClient", "PolicyServer", "install_drain_handlers", "serve_policy"]
@@ -71,12 +79,17 @@ class PolicyClient:
         n: int = 1,
         timeout: Optional[float] = None,
         submit_timeout: Optional[float] = None,
+        session_id: Optional[str] = None,
+        reset: bool = False,
     ) -> Tuple[np.ndarray, int]:
         """Actions (``(n, action_dim)``) + the weight version that produced
         them. ``timeout`` bounds the wait for the result; ``submit_timeout``
-        bounds the backpressure wait for queue space."""
+        bounds the backpressure wait for queue space. On a stateful server
+        ``session_id`` carries this caller's recurrent/latent state between
+        calls (``n`` must be 1 — one user, one state row) and ``reset``
+        restarts it for a new episode."""
         prepared = self.policy.prepare(obs, n)
-        req = self.scheduler.submit(prepared, timeout=submit_timeout)
+        req = self.scheduler.submit(prepared, timeout=submit_timeout, session_id=session_id, reset=reset)
         return self.scheduler.result(req, timeout=timeout)
 
 
@@ -96,11 +109,19 @@ class _JsonLineHandler(socketserver.StreamRequestHandler):
                     continue
                 obs = {k: np.asarray(v) for k, v in msg["obs"].items()}
                 n = int(msg.get("n", 1))
+                session_id = msg.get("session_id")
+                if session_id is not None:
+                    session_id = str(session_id)
                 # submit_timeout: under sustained overload the request must
                 # error out (backpressure made visible), not pin this
                 # connection's thread forever — serve_config.yaml promises it
                 actions, version = server.client.act(
-                    obs, n=n, timeout=server.request_timeout_s, submit_timeout=server.request_timeout_s
+                    obs,
+                    n=n,
+                    timeout=server.request_timeout_s,
+                    submit_timeout=server.request_timeout_s,
+                    session_id=session_id,
+                    reset=bool(msg.get("reset", False)),
                 )
                 resp = {"actions": np.asarray(actions).tolist(), "version": int(version)}
             except Exception as e:  # per-request: report, keep the connection
@@ -153,8 +174,28 @@ class PolicyServer:
         if mode not in ("greedy", "sample"):
             raise ValueError(f"serve.mode must be greedy|sample, got {mode!r}")
         buckets = cfg.get("buckets") or default_buckets()
-        if engine == "aot":
-            self.engine: Any = BucketEngine(policy, buckets=buckets, mode=mode)
+        stateful = isinstance(policy, StatefulServePolicy)
+        if stateful:
+            # graft-sessions: per-user state rows behind the same admission
+            # tier. serve.session.* sizes the cache and (optionally) its own
+            # bucket ladder; a "naive" baseline is session.buckets=[1] +
+            # max_batch=1, not the JitEngine (state must never retrace).
+            if engine != "aot":
+                raise ValueError(
+                    "stateful policies serve through the AOT session engine; for a naive "
+                    "per-session baseline use serve.session.buckets=[1] with serve.max_batch=1"
+                )
+            scfg = dict(cfg.get("session") or {})
+            self.engine: Any = SessionEngine(
+                policy,
+                buckets=scfg.get("buckets") or default_session_buckets(),
+                mode=mode,
+                max_sessions=int(scfg.get("max_sessions", 1024)),
+                ttl_s=float(scfg.get("ttl_s", 300.0)),
+                sweep_every_s=float(scfg.get("sweep_every_s", 1.0)),
+            )
+        elif engine == "aot":
+            self.engine = BucketEngine(policy, buckets=buckets, mode=mode)
         elif engine == "naive":
             self.engine = JitEngine(policy, mode=mode)
         else:
@@ -170,6 +211,7 @@ class PolicyServer:
             greedy=mode == "greedy",
             seed=int(cfg.get("seed", 0) or 0),
             stats=self.stats,
+            sessions=self.engine.cache if stateful else None,
         )
         self.client = PolicyClient(policy, self.scheduler)
         # one supervisor over the serving workers (scheduler + watcher):
@@ -248,6 +290,21 @@ class PolicyServer:
                 "published": int(self.watcher.published),
                 "quarantined": [str(p) for p in sorted(self.watcher.quarantined)],
                 "restarts": int(workers.get("serve-ckpt-watcher", {}).get("restarts", 0)),
+            }
+        cache = getattr(self.engine, "cache", None)
+        if cache is not None:
+            s = cache.snapshot()
+            out["sessions"] = {
+                "live": int(s["live"]),
+                "peak": int(s["peak"]),
+                "max_sessions": int(s["max_sessions"]),
+                "opened": int(s["opened"]),
+                "evictions": int(s["evicted_lru"] + s["evicted_ttl"]),
+                "ttl_evictions": int(s["evicted_ttl"]),
+                "resets": int(s["resets"]),
+                "client_resets": int(s["client_resets"]),
+                "state_bytes": int(s["state_bytes"]),
+                "ttl_s": float(s["ttl_s"]),
             }
         return out
 
@@ -329,6 +386,32 @@ def install_drain_handlers(
     return _restore
 
 
+def resolve_builder_state(builder, state: Dict[str, Any], checkpoint_path, algo_name: str):
+    """What of the loaded checkpoint does this builder get? Builders that
+    declare a ``full_state`` parameter receive the whole state (the
+    population builder reads ``best_member`` from it; the dreamer family
+    checkpoints its models as top-level trees with no ``agent`` key and
+    rebuilds from the full state). For everyone else the ``agent`` tree is
+    REQUIRED: a missing one on a builder that can only consume it would
+    silently serve random-init weights — fail loudly instead."""
+    import inspect
+
+    wants_full_state = False
+    try:
+        wants_full_state = "full_state" in inspect.signature(builder).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic callables
+        pass
+    builder_kwargs = {"full_state": state} if wants_full_state else {}
+    agent_state = state.get("agent")
+    if agent_state is None and not wants_full_state:
+        raise RuntimeError(
+            f"checkpoint {checkpoint_path} has no 'agent' state and the "
+            f"'{algo_name}' policy builder does not accept full_state — refusing to "
+            "serve untrained random-init weights"
+        )
+    return agent_state, builder_kwargs
+
+
 def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) -> None:
     """CLI entrypoint body: build the policy from the checkpoint and serve.
 
@@ -351,18 +434,10 @@ def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) ->
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     env.close()
 
-    # builders that declare a `full_state` parameter get the whole loaded
-    # checkpoint (e.g. the population builder reads `best_member` from it
-    # instead of deserializing the stacked checkpoint a second time)
-    import inspect
-
-    builder_kwargs = {}
-    try:
-        if "full_state" in inspect.signature(builder).parameters:
-            builder_kwargs["full_state"] = state
-    except (TypeError, ValueError):  # pragma: no cover - exotic callables
-        pass
-    policy = builder(fabric, cfg, observation_space, action_space, state["agent"], **builder_kwargs)
+    agent_state, builder_kwargs = resolve_builder_state(
+        builder, state, cfg.get("checkpoint_path"), str(cfg.algo.name)
+    )
+    policy = builder(fabric, cfg, observation_space, action_space, agent_state, **builder_kwargs)
     serve_cfg = dict(cfg.get("serve", {}))
     watch_dir = None
     if serve_cfg.get("watch"):

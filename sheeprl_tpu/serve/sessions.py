@@ -1,0 +1,586 @@
+"""graft-sessions: stateful session serving behind the continuous-batching tier.
+
+Real products serve *stateful* agents — a user's GRU/LSTM hidden or Dreamer
+posterior carried across requests — not one-shot policy calls. This module
+keeps that state SERVER-SIDE and device-resident:
+
+- :class:`SessionCache` — ``session_id -> slab row``: one preallocated
+  device slab per state leaf (``max_sessions + 1`` rows; the extra row is
+  the padding DONOR), host-side metadata per session (last-used stamp for
+  the TTL sweep and the LRU spill cap, a generation tag for versioned
+  re-init after an incompatible swap), and the ``Serve/sessions_*``
+  counters the health probe and ``ServeStats`` report.
+
+- :class:`SessionEngine` — the stateful twin of
+  :class:`~sheeprl_tpu.serve.engine.BucketEngine`: at construction it AOT
+  lowers+compiles ONE ``serve.session[N].step`` program per padded batch
+  bucket. A dispatch gathers the admitted sessions' slab rows by index,
+  ``where``-merges ``init_fn(params, N)`` into rows flagged FRESH (new
+  sessions, client resets, generation-stale rows, and every padding row —
+  padding steps a donor zero/init state, so fresh rows and padding cost no
+  extra program), runs ``policy.step_fn``, scatters the advanced rows back
+  into the slab (the slab buffer is DONATED — the update is in-place in
+  HBM), and returns the real action rows. No request shape, session count
+  or session lifetime event ever traces: the only inputs that vary are
+  fixed-shape index/flag vectors.
+
+State rides the existing serve guarantees unchanged: the scheduler pulls one
+weight snapshot per batch (a hot swap with matching state avals steps live
+sessions without interruption; a mismatch bumps the cache generation and
+re-inits lazily, counted as ``Serve/sessions_reset``), drain serves every
+admitted step, and a supervised worker restart re-serves the recovered
+in-flight batch against the server-owned cache — zero sessions dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.analysis.tracecheck import tracecheck
+from sheeprl_tpu.parallel.pipeline import DoubleBufferedStager
+from sheeprl_tpu.serve.engine import check_chunk_order, chunk_plan
+from sheeprl_tpu.serve.policy import StatefulServePolicy
+
+__all__ = ["SessionCache", "SessionEngine", "session_program", "default_session_buckets"]
+
+
+def default_session_buckets() -> Tuple[int, ...]:
+    # stateful steps are usually heavier than stateless policy calls and
+    # session traffic is closed-loop (a user sends step t+1 only after
+    # receiving step t), so the ladder tops out lower than the stateless one
+    return (1, 8, 32)
+
+
+def _row_mask(fresh: jax.Array, leaf: jax.Array) -> jax.Array:
+    """Broadcast a ``(B,)`` bool row flag over a ``(B, ...)`` state leaf."""
+    return fresh.reshape(fresh.shape + (1,) * (leaf.ndim - 1))
+
+
+def session_program(policy: StatefulServePolicy, slab_rows: int, bucket: int, greedy: bool):
+    """The ONE lowering path for a padded-bucket session step: the jitted
+    callable plus its abstract call signature. Inputs are ``(params, slab,
+    idx[i32 N], fresh[bool N], obs slab, key)``; outputs ``(actions, slab')``
+    with the slab DONATED — gather, fresh-init merge, policy step and
+    scatter fused into one device program so a session step is exactly one
+    dispatch. The graft-audit registry lowers the SAME pairs
+    (``serve.session[N].step``), so the gate can never drift from what
+    serving runs."""
+    spec = policy.state_spec()
+
+    def _step(params, slab, idx, fresh, obs, key):
+        gathered = jax.tree.map(lambda s: s[idx], slab)
+        init = policy.init_fn(params, bucket)
+        state = jax.tree.map(
+            lambda i, g: jnp.where(_row_mask(fresh, g), i.astype(g.dtype), g), init, gathered
+        )
+        actions, new_state = policy.step_fn(params, obs, state, key, greedy)
+        # duplicate indices only ever occur on the donor row (padding); which
+        # padded row wins is irrelevant — donor rows are re-inited fresh on
+        # every dispatch
+        new_slab = jax.tree.map(
+            lambda s, ns: s.at[idx].set(ns.astype(s.dtype)), slab, new_state
+        )
+        return actions, new_slab
+
+    params_struct = jax.tree.map(lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), policy.params)
+    slab_struct = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((slab_rows, *s.shape), s.dtype), spec
+    )
+    obs_struct = {
+        k: jax.ShapeDtypeStruct((bucket, *shape), np.dtype(dtype))
+        for k, (shape, dtype) in policy.obs_spec.items()
+    }
+    idx_struct = jax.ShapeDtypeStruct((bucket,), np.int32)
+    fresh_struct = jax.ShapeDtypeStruct((bucket,), np.bool_)
+    key_struct = jax.ShapeDtypeStruct(np.shape(jax.random.PRNGKey(0)), jax.random.PRNGKey(0).dtype)
+    avals = (params_struct, slab_struct, idx_struct, fresh_struct, obs_struct, key_struct)
+    return jax.jit(_step, donate_argnums=(1,)), avals
+
+
+class _Session:
+    __slots__ = ("row", "last_used", "generation", "needs_init")
+
+    def __init__(self, row: int, now: float, generation: int) -> None:
+        self.row = row
+        self.last_used = now
+        self.generation = generation
+        # sticky until a dispatch actually initializes the row
+        # (cache.mark_stepped): a failed dispatch between admission and step
+        # must NOT leave a never-initialized session reading another
+        # session's stale slab content as its own state
+        self.needs_init = True
+
+
+class SessionCache:
+    """``session_id -> device-resident state slab row`` with TTL eviction,
+    an LRU spill cap and generation-tagged versioned re-init.
+
+    The slab itself (``.slab``) is owned jointly with the
+    :class:`SessionEngine`: the engine donates it per dispatch and writes
+    the returned buffer back. All metadata mutation happens on the scheduler
+    worker thread; the lock only guards the counters/metadata against
+    concurrent health-probe reads.
+    """
+
+    def __init__(
+        self,
+        state_spec: Any,
+        max_sessions: int = 1024,
+        ttl_s: float = 300.0,
+        sweep_every_s: float = 1.0,
+    ) -> None:
+        if max_sessions < 1:
+            raise ValueError(f"session.max_sessions must be >= 1, got {max_sessions}")
+        self.max_sessions = int(max_sessions)
+        self.ttl_s = float(ttl_s)
+        self.sweep_every_s = float(sweep_every_s)
+        self.state_spec = state_spec
+        #: row ``max_sessions`` is the padding DONOR — never assigned to a session
+        self.donor_row = self.max_sessions
+        self.slab = self._fresh_slab()
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, _Session] = {}
+        self._free: List[int] = list(range(self.max_sessions - 1, -1, -1))
+        self.generation = 0
+        # counters surfaced through ServeStats + the health probe
+        self.opened = 0  # newly claimed session rows (client resets count separately)
+        self.evicted_lru = 0  # spill-cap evictions (cache full, newest wins)
+        self.evicted_ttl = 0  # TTL sweep evictions
+        self.resets = 0  # INVOLUNTARY re-inits (incompatible swap generation)
+        self.client_resets = 0  # reset=True requests on a live session
+        self.peak = 0
+        self._last_sweep = time.monotonic()
+
+    # -- introspection -------------------------------------------------------- #
+
+    @property
+    def live(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    @property
+    def state_bytes(self) -> int:
+        """Device bytes held by the state slab (all rows, donor included)."""
+        leaves = jax.tree.leaves(self.state_spec)
+        per_row = sum(int(np.prod(s.shape)) * np.dtype(s.dtype).itemsize for s in leaves)
+        return per_row * (self.max_sessions + 1)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "live": len(self._sessions),
+                "peak": self.peak,
+                "max_sessions": self.max_sessions,
+                "opened": self.opened,
+                "evicted_lru": self.evicted_lru,
+                "evicted_ttl": self.evicted_ttl,
+                "resets": self.resets,
+                "client_resets": self.client_resets,
+                "generation": self.generation,
+                "ttl_s": self.ttl_s,
+                "state_bytes": self.state_bytes,
+            }
+
+    # -- scheduler-side mutation ---------------------------------------------- #
+
+    def touch(
+        self,
+        session_id: str,
+        reset: bool = False,
+        now: Optional[float] = None,
+        protect: Optional[Any] = None,
+    ) -> Tuple[int, bool]:
+        """Resolve ``session_id`` to its slab row for the batch being
+        assembled; returns ``(row, fresh)``. A new session claims a free row
+        (evicting the LRU session when the cache sits at ``max_sessions`` —
+        the spill cap), a live one whose generation predates the last
+        incompatible swap re-inits in place (counted as a reset), and
+        ``reset=True`` re-inits on request. ``fresh`` rows are
+        ``init_fn``-initialized inside the next step dispatch — and STICKY
+        until :meth:`mark_stepped` confirms a dispatch actually ran, so a
+        failed dispatch can never leave a session reading an uninitialized
+        (or reused) slab row as its own state.
+
+        ``protect`` (a set of session ids) exempts sessions from LRU
+        eviction: the batch being assembled must pass its own ids, or a
+        same-``now`` admission round bigger than the spill cap could evict a
+        session it just touched and hand ONE slab row to TWO live sessions
+        in the same dispatch (the scatter is last-write-wins — silent
+        cross-user state corruption). With every candidate protected the
+        touch raises instead."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is not None:
+                sess.last_used = now
+                if sess.generation != self.generation:
+                    # versioned re-init: the state rows written before an
+                    # incompatible swap are garbage for the new program
+                    sess.generation = self.generation
+                    sess.needs_init = True
+                    self.resets += 1
+                if reset:
+                    self.client_resets += 1
+                    sess.needs_init = True
+                return sess.row, sess.needs_init
+            if not self._free:
+                self._evict_lru_locked(protect or ())
+            row = self._free.pop()
+            self._sessions[session_id] = _Session(row, now, self.generation)
+            self.opened += 1
+            self.peak = max(self.peak, len(self._sessions))
+            return row, True
+
+    def _evict_lru_locked(self, protect) -> None:
+        candidates = [k for k in self._sessions if k not in protect]
+        if not candidates:
+            raise RuntimeError(
+                f"one batch holds more distinct live sessions than session.max_sessions="
+                f"{self.max_sessions} can cache — raise max_sessions (or lower max_batch)"
+            )
+        victim = min(candidates, key=lambda k: self._sessions[k].last_used)
+        self._free.append(self._sessions.pop(victim).row)
+        self.evicted_lru += 1
+
+    def mark_stepped(self, session_ids) -> None:
+        """Confirm a successful dispatch initialized/advanced these sessions'
+        rows (clears the sticky fresh flag). The engine's
+        :meth:`SessionEngine.step_sessions` calls this — direct
+        ``touch``/``infer_sessions`` users must, too, or every step
+        re-initializes."""
+        with self._lock:
+            for sid in session_ids:
+                sess = self._sessions.get(sid)
+                if sess is not None:
+                    sess.needs_init = False
+
+    def drop(self, session_id: str) -> bool:
+        """Explicitly end a session (frees its row); True iff it existed."""
+        with self._lock:
+            sess = self._sessions.pop(session_id, None)
+            if sess is None:
+                return False
+            self._free.append(sess.row)
+            return True
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """TTL sweep: evict every session idle longer than ``ttl_s``;
+        returns how many were evicted. The scheduler calls
+        :meth:`maybe_sweep` between batches, so eviction latency is bounded
+        by ``sweep_every_s`` plus one admission round."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._last_sweep = now
+            stale = [sid for sid, s in self._sessions.items() if now - s.last_used > self.ttl_s]
+            for sid in stale:
+                self._free.append(self._sessions.pop(sid).row)
+            self.evicted_ttl += len(stale)
+            return len(stale)
+
+    def maybe_sweep(self, now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        if now - self._last_sweep < self.sweep_every_s:
+            return 0
+        return self.sweep(now)
+
+    def invalidate_all(self) -> None:
+        """Versioned re-init after an incompatible hot swap: bump the
+        generation so every live session lazily re-inits (and counts a
+        ``Serve/sessions_reset``) on its next touch. Sessions stay ADMITTED
+        — ids, rows and LRU order survive; only the state content restarts."""
+        with self._lock:
+            self.generation += 1
+
+    def _fresh_slab(self) -> Any:
+        return jax.tree.map(
+            lambda s: jnp.zeros((self.max_sessions + 1, *s.shape), s.dtype), self.state_spec
+        )
+
+    def rebuild_slab(self) -> None:
+        """Replace the slab with a fresh zeroed allocation AND version-reinit
+        every session. The engine's failure recovery: once a dispatch has
+        CONSUMED the donated slab, an error anywhere before its outputs
+        materialize leaves the old buffer deleted (on backends that honor
+        donation) — continuing to reference it would fail every future
+        dispatch with 'array has been deleted' while the health probe reads
+        ok. A rebuilt slab + generation bump turns that permanent wedge into
+        one round of counted session re-inits."""
+        self.slab = self._fresh_slab()
+        self.invalidate_all()
+
+
+class SessionEngine:
+    """Bucket-padded batched session stepping over AOT ``serve.session[N].step``
+    programs — the stateful counterpart of
+    :class:`~sheeprl_tpu.serve.engine.BucketEngine` (same ladder/padding/
+    staging discipline; same per-call params hot-swap contract).
+
+    ``mode`` is ``"greedy"`` or ``"sample"`` — a session server runs ONE
+    action program (mixed-mode batches would tear a session's stream across
+    two programs); run a second server for the other mode.
+    """
+
+    def __init__(
+        self,
+        policy: StatefulServePolicy,
+        buckets: Optional[Sequence[int]] = None,
+        mode: str = "greedy",
+        max_sessions: int = 1024,
+        ttl_s: float = 300.0,
+        sweep_every_s: float = 1.0,
+        warmup: bool = True,
+    ) -> None:
+        buckets = tuple(sorted({int(b) for b in (buckets or default_session_buckets())}))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"session bucket ladder must be positive ints, got {buckets}")
+        if mode not in ("greedy", "sample"):
+            raise ValueError(f"session engine mode must be greedy|sample, got {mode!r}")
+        self.policy = policy
+        self.buckets = buckets
+        self.mode = mode
+        self.greedy = mode == "greedy"
+        self.cache = SessionCache(
+            policy.state_spec(), max_sessions=max_sessions, ttl_s=ttl_s, sweep_every_s=sweep_every_s
+        )
+        self._lock = threading.Lock()
+        self._templates: Dict[int, Dict[str, Tuple[Tuple[int, ...], Any]]] = {
+            b: {k: ((b, *shape), np.dtype(dtype)) for k, (shape, dtype) in policy.obs_spec.items()}
+            for b in buckets
+        }
+        self._stagers: Dict[int, DoubleBufferedStager] = {b: DoubleBufferedStager(None) for b in buckets}
+        self._key_aval = jax.random.PRNGKey(0)
+        self._programs: Dict[int, Any] = {}
+        slab_rows = self.cache.max_sessions + 1
+        for b in buckets:
+            jit_fn, avals = session_program(policy, slab_rows, b, self.greedy)
+            compiled = jit_fn.lower(*avals).compile()
+            self._programs[b] = tracecheck.instrument(
+                compiled,
+                name=f"serve.session[{b}].step",
+                warmup=1,  # first call registers the (only) signature
+                transfer_guard=False,  # host obs/idx/fresh by contract
+            )
+        self._dispatch = tracecheck.instrument(
+            self._dispatch_impl,
+            name="serve.session.infer",
+            warmup=len(buckets),
+            transfer_guard=False,
+        )
+        self.dispatches = 0
+        self.rows = 0
+        self.padded_rows = 0
+        if warmup:
+            self._warmup()
+
+    # -- construction helpers ------------------------------------------------- #
+
+    def _warmup(self) -> None:
+        """Run every bucket program once on donor-only rows: pays first-call
+        transfer/layout costs AND registers every abstract signature inside
+        the tracecheck warmup window. Donor rows re-init fresh every
+        dispatch, so warmup leaves no session state behind."""
+        for b in self.buckets:
+            slab = self._stagers[b].acquire(self._templates[b])
+            for k in slab:
+                slab[k][:] = 0
+            idx = np.full((b,), self.cache.donor_row, np.int32)
+            fresh = np.ones((b,), np.bool_)
+            out, new_slab = self._dispatch(b, self.policy.params, self.cache.slab, idx, fresh, slab, self._key_aval)
+            np.asarray(out)  # block before the obs slab is reused
+            self.cache.slab = new_slab
+
+    # -- hot path ------------------------------------------------------------- #
+
+    def bucket_for(self, n: int) -> int:
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _dispatch_impl(self, bucket: int, params: Any, slab: Any, idx: Any, fresh: Any, obs: Dict[str, Any], key: Any):
+        return self._programs[bucket](params, slab, idx, fresh, obs, key)
+
+    def check_swap(self, params: Any) -> bool:
+        """Hot-swap state compatibility: abstractly re-derive the per-row
+        state avals under the swapped params and compare with the slab spec.
+        Matching avals (the normal case — ``params_from_state`` rebuilds
+        into the compiled template) keep every live session stepping
+        untouched; a mismatch bumps the cache generation so sessions re-init
+        versioned (counted ``Serve/sessions_reset``) instead of feeding
+        incompatible rows to the program. Returns True iff sessions
+        survived."""
+        try:
+            spec = self.policy.state_spec(params)
+            compatible = jax.tree.structure(spec) == jax.tree.structure(self.cache.state_spec) and all(
+                a.shape == b.shape and a.dtype == b.dtype
+                for a, b in zip(jax.tree.leaves(spec), jax.tree.leaves(self.cache.state_spec))
+            )
+        except Exception:  # init_fn cannot even trace under the new params
+            compatible = False
+        if not compatible:
+            self.cache.invalidate_all()
+        return compatible
+
+    def step_sessions(
+        self,
+        params: Any,
+        obs: Dict[str, np.ndarray],
+        session_ids: Sequence[Optional[str]],
+        resets: Optional[Sequence[bool]] = None,
+        key: Optional[Any] = None,
+    ) -> np.ndarray:
+        """The full per-batch orchestration: resolve each row's session
+        (``None`` = one-shot fresh donor state), dispatch, and — only on
+        success — commit the fresh flags (:meth:`SessionCache.mark_stepped`).
+        ``session_ids`` has one entry per obs ROW; a session id may appear
+        only once per call (the scheduler's admission guarantees it)."""
+        resets = [False] * len(session_ids) if resets is None else list(resets)
+        now = time.monotonic()
+        batch_ids = {sid for sid in session_ids if sid is not None}
+        rows: List[int] = []
+        fresh: List[bool] = []
+        for sid, rs in zip(session_ids, resets):
+            if sid is None:
+                rows.append(self.cache.donor_row)
+                fresh.append(True)
+            else:
+                row, fr = self.cache.touch(sid, reset=rs, now=now, protect=batch_ids)
+                rows.append(row)
+                fresh.append(fr)
+        actions = self.infer_sessions(params, obs, rows, fresh, key=key)
+        self.cache.mark_stepped([sid for sid in session_ids if sid is not None])
+        return actions
+
+    def infer_sessions(
+        self,
+        params: Any,
+        obs: Dict[str, np.ndarray],
+        rows: Sequence[int],
+        fresh: Sequence[bool],
+        key: Optional[Any] = None,
+    ) -> np.ndarray:
+        """Step ``n`` admitted session rows (``rows[i]`` is row ``i``'s slab
+        index, ``fresh[i]`` whether it re-inits) against one params snapshot;
+        returns the ``(n, action_dim)`` actions. Pads into the smallest
+        admitting bucket (padding steps the donor row, always fresh); batches
+        beyond the ladder top are chunked through it in order — the chunk
+        plan is order-asserted because rows bind actions to sessions."""
+        n = self.policy.validate_batch(obs)
+        if n != len(rows) or n != len(fresh):
+            raise ValueError(f"{n} obs rows but {len(rows)} session rows / {len(fresh)} fresh flags")
+        cap = self.buckets[-1]
+        if n > cap:
+            spans = chunk_plan(n, cap)
+            check_chunk_order(spans, n)
+            outs = []
+            for start, stop in spans:
+                chunk = {k: v[start:stop] for k, v in obs.items()}
+                sub = key if key is None else jax.random.fold_in(key, start)
+                outs.append(self.infer_sessions(params, chunk, rows[start:stop], fresh[start:stop], key=sub))
+            return np.concatenate(outs, axis=0)
+        bucket = self.bucket_for(n)
+        idx = np.full((bucket,), self.cache.donor_row, np.int32)
+        idx[:n] = np.asarray(rows, np.int32)
+        fresh_arr = np.ones((bucket,), np.bool_)
+        fresh_arr[:n] = np.asarray(fresh, np.bool_)
+        with self._lock:
+            slab_obs = self._stagers[bucket].acquire(self._templates[bucket])
+            for k, v in obs.items():
+                dst = slab_obs[k]
+                np.copyto(dst[:n], v)
+                if n < bucket:
+                    dst[n:] = 0
+            ok = False
+            try:
+                out, new_slab = self._dispatch(
+                    bucket, params, self.cache.slab, idx, fresh_arr, slab_obs,
+                    self._key_aval if key is None else key,
+                )
+                # adopt the new slab BEFORE any blocking materialization: the
+                # dispatch consumed the donated old buffer either way
+                self.cache.slab = new_slab
+                # np.asarray blocks on the computation — the obs slab is free
+                # for reuse once we return
+                actions = np.asarray(out)[:n]
+                ok = True
+            finally:
+                if not ok:
+                    # the dispatch (or its async execution, surfacing at the
+                    # blocking read) failed after the donated slab was handed
+                    # over: both old and new buffers are unusable — rebuild
+                    # zeroed + version-reinit instead of wedging every future
+                    # dispatch on a deleted array
+                    self.cache.rebuild_slab()
+            self.dispatches += 1
+            self.rows += n
+            self.padded_rows += bucket - n
+        return actions
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.rows + self.padded_rows
+            return {
+                "dispatches": self.dispatches,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "batch_fill_ratio": round(self.rows / total, 4) if total else 0.0,
+            }
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs("serve.session[1].step", "serve.session[8].step")
+def _audit_programs(spec: AuditMesh):
+    """The real ppo_recurrent stateful policy through the registered builder,
+    lowered at a small ladder slice via :func:`session_program`. Two extra
+    contracts over the stateless serve programs: the state SLAB is declared
+    donated (the in-place session update in HBM — an un-aliased slab would
+    double the session tier's memory and add a full copy per step), and the
+    64 KiB constant budget keeps bucket programs weight-free so hot swaps
+    stay zero-recompile."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo_recurrent.evaluate import serve_policy_ppo_recurrent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    cfg = compose(
+        [
+            "exp=ppo_recurrent",
+            "env=gym",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(42)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    act_space = gym.spaces.Discrete(2)
+    policy = serve_policy_ppo_recurrent(fabric, cfg, obs_space, act_space, None)
+    slab_rows = 33  # 32 sessions + the padding donor row
+    for bucket in (1, 8):
+        jit_fn, avals = session_program(policy, slab_rows, bucket, greedy=True)
+        yield AuditProgram(
+            name=f"serve.session[{bucket}].step",
+            fn=jit_fn,
+            args=avals,
+            source=__name__,
+            donate_argnums=(1,),
+            constant_budget=64 * 1024,
+            check_input_shardings=False,
+        )

@@ -169,11 +169,18 @@ class CheckpointWatcher:
                 return False
             try:
                 state = load_state(path)
-                agent_state = state["agent"]
+                # dreamer-family checkpoints carry their model trees at the
+                # top level (world_model/actor/...) with no "agent" key; the
+                # policy's params_from_state owns that layout
+                agent_state = state["agent"] if "agent" in state else state
+                # publish INSIDE the strike scope: a save that loads but whose
+                # tree params_from_state cannot rebuild (wrong layout, shape
+                # drift) must strike and eventually quarantine, not wedge the
+                # publish loop retrying it forever
+                self.store.publish_state(agent_state)
             except Exception as e:
                 self._strike(path, e)
                 return False
-            self.store.publish_state(agent_state)
             self._last, self._last_step = path, step
             self.published += 1
             return True
@@ -187,21 +194,27 @@ class CheckpointWatcher:
 
     def _strike(self, path: Path, error: BaseException) -> None:
         """Count a load failure against ``path``; quarantine past the budget
-        so the loop stops re-reading a save that will never load."""
-        self._count_error()
+        so the loop stops re-reading a save that will never load.
+
+        The warning fires BEFORE the strike/quarantine state and error
+        counter publish: anything polling those (tests under
+        ``pytest.warns``, a monitor tailing counters) may treat observed
+        state as "the warning already happened" without racing this
+        thread."""
         strikes = self._strikes.get(path, 0) + 1
-        self._strikes[path] = strikes
         if strikes >= self.quarantine_after:
-            self.quarantined.add(path)
             warnings.warn(
                 f"serve checkpoint watcher QUARANTINED {path} after {strikes} failed loads "
                 f"({type(error).__name__}: {error}) — serving continues on the previous weights"
             )
+            self.quarantined.add(path)
         else:
             warnings.warn(
                 f"serve checkpoint watcher could not load {path} "
                 f"(strike {strikes}/{self.quarantine_after}): {error}"
             )
+        self._strikes[path] = strikes
+        self._count_error()
 
     def _prime(self) -> None:
         from sheeprl_tpu.fault.manager import latest_complete
@@ -219,8 +232,9 @@ class CheckpointWatcher:
             except Exception as e:  # never kill serving over a watcher hiccup
                 # (ThreadKilled is a BaseException: it DOES kill this
                 # generation, and the supervisor restarts it)
-                self._count_error()
+                # warn BEFORE counting — see _strike for the ordering contract
                 warnings.warn(f"serve checkpoint watcher error: {e}")
+                self._count_error()
             self._stop.wait(self.poll_s)
         if ctx is not None:
             # owner-driven stop (our own _stop flag): the exit is EXPECTED,

@@ -158,6 +158,14 @@ def resolve_policy_builder(algo_name: str) -> Optional[Dict[str, Any]]:
     return _resolve_from(policy_builder_registry, algo_name)
 
 
+def registered_policy_builder_names() -> List[str]:
+    """Every algorithm name with a registered serving policy builder — the
+    ``serve`` verb's unknown-algo error enumerates these so the operator
+    sees what IS servable instead of guessing."""
+    _ensure_populated()
+    return sorted(policy_builder_registry)
+
+
 def get_entrypoint(entry: Dict[str, Any]) -> Callable:
     module = importlib.import_module(entry["module"])
     return getattr(module, entry["entrypoint"])

@@ -1,6 +1,8 @@
 """Shared serve-tier fixtures: a pure-function toy policy (scheduler/weights
-semantics without the algo stack) and real PPO/SAC policies built through the
-registered builders over synthetic spaces."""
+semantics without the algo stack), a toy STATEFUL counter policy (session
+semantics — every action row carries its session's step count, so stream
+continuity/reset/loss are directly observable), and real PPO/SAC/recurrent
+policies built through the registered builders over synthetic spaces."""
 
 import gymnasium as gym
 import jax
@@ -10,7 +12,7 @@ import pytest
 
 from sheeprl_tpu.config import compose
 from sheeprl_tpu.parallel import Fabric
-from sheeprl_tpu.serve.policy import ServePolicy
+from sheeprl_tpu.serve.policy import ServePolicy, StatefulServePolicy
 
 
 @pytest.fixture()
@@ -35,6 +37,37 @@ def toy_policy():
         greedy_fn=greedy_fn,
         sample_fn=sample_fn,
         prepare=lambda obs, n: {"x": np.asarray(obs["x"], dtype=np.float32).reshape(n, 2)},
+        params_from_state=lambda state: jax.tree.map(jnp.asarray, state),
+    )
+
+
+@pytest.fixture()
+def toy_stateful_policy():
+    """Counter policy: per-session state is a step counter; action row =
+    ``[count, w·obs_sum]``. A served stream's ``actions[:, 0]`` must read
+    ``0, 1, 2, ...`` — any reset, drop, reorder or cross-session mixup is
+    immediately visible in the action values themselves."""
+    w = jnp.asarray(np.arange(4, dtype=np.float32).reshape(2, 2))
+    params = {"w": w}
+
+    def step_fn(p, obs, state, key, greedy):
+        del key, greedy
+        count = state["count"][:, 0]
+        y = (obs["x"] @ p["w"]).sum(-1)
+        return jnp.stack([count, y], axis=-1), {"count": state["count"] + 1.0}
+
+    def init_fn(p, n):
+        del p
+        return {"count": jnp.zeros((n, 1), jnp.float32)}
+
+    return StatefulServePolicy(
+        name="toy_stateful",
+        params=params,
+        obs_spec={"x": ((2,), np.float32)},
+        action_dim=2,
+        step_fn=step_fn,
+        init_fn=init_fn,
+        prepare=lambda obs, n: {"x": np.asarray(obs["x"], np.float32).reshape(n, 2)},
         params_from_state=lambda state: jax.tree.map(jnp.asarray, state),
     )
 
@@ -86,3 +119,25 @@ def sac_policy():
     obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32)})
     act_space = gym.spaces.Box(-2.0, 2.0, (1,), np.float32)
     return serve_policy_sac(_fabric(), cfg, obs_space, act_space, None)
+
+
+RECURRENT_TINY = [
+    "exp=ppo_recurrent",
+    "env=gym",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+@pytest.fixture(scope="module")
+def recurrent_policy():
+    """Real stateful ppo_recurrent policy (discrete CartPole spaces) through
+    the registered builder, random init params."""
+    from sheeprl_tpu.algos.ppo_recurrent.evaluate import serve_policy_ppo_recurrent
+
+    cfg = compose(RECURRENT_TINY)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    act_space = gym.spaces.Discrete(2)
+    return serve_policy_ppo_recurrent(_fabric(), cfg, obs_space, act_space, None)

@@ -106,6 +106,28 @@ def test_socket_front_end(toy_policy):
             assert "actions" in json.loads(f.readline())
 
 
+def test_resolve_builder_state_guards_agentless_checkpoints():
+    """Review regression: a checkpoint with no 'agent' tree must fail FAST on
+    a builder that can only consume one (None there means random init — a
+    silent untrained server), while full_state-declaring builders (dreamer
+    family, population) legitimately take the whole state."""
+    from sheeprl_tpu.serve.server import resolve_builder_state
+
+    def plain_builder(fabric, cfg, obs_space, act_space, agent_state):
+        raise AssertionError("never called")
+
+    def full_state_builder(fabric, cfg, obs_space, act_space, agent_state, full_state=None):
+        raise AssertionError("never called")
+
+    state = {"world_model": {}, "actor": {}}
+    with pytest.raises(RuntimeError, match="refusing to serve"):
+        resolve_builder_state(plain_builder, state, "/some/ckpt", "ppo")
+    agent_state, kwargs = resolve_builder_state(full_state_builder, state, "/some/ckpt", "dreamer_v3")
+    assert agent_state is None and kwargs == {"full_state": state}
+    agent_state, kwargs = resolve_builder_state(plain_builder, {"agent": {"w": 1}}, "/some/ckpt", "ppo")
+    assert agent_state == {"w": 1} and kwargs == {}
+
+
 # -- the serve verb end-to-end ---------------------------------------------- #
 
 PPO_TINY = [

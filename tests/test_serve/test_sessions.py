@@ -1,0 +1,469 @@
+"""graft-sessions units: the session cache (TTL sweep, LRU spill cap,
+generation-tagged re-init), the session engine (stream continuity through
+bucket-padded batched stepping, donor padding, zero retraces, ordered
+chunking), the scheduler's session admission rules, hot-swap semantics, and
+the bit-parity-of-batched-stepping claim for the real ppo_recurrent policy."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.engine import check_chunk_order, chunk_plan
+from sheeprl_tpu.serve.server import PolicyServer
+from sheeprl_tpu.serve.sessions import SessionCache, SessionEngine
+
+
+def _spec():
+    return {"count": jax.ShapeDtypeStruct((1,), np.float32)}
+
+
+# -- SessionCache ------------------------------------------------------------- #
+
+
+def test_cache_touch_new_live_and_reset():
+    cache = SessionCache(_spec(), max_sessions=4, ttl_s=100.0)
+    row, fresh = cache.touch("a", now=0.0)
+    assert fresh and 0 <= row < 4
+    cache.mark_stepped(["a"])  # a dispatch initialized the row
+    row2, fresh2 = cache.touch("a", now=1.0)
+    assert row2 == row and not fresh2
+    # client reset: same row, fresh state, counted separately from swaps
+    row3, fresh3 = cache.touch("a", reset=True, now=2.0)
+    assert row3 == row and fresh3
+    snap = cache.snapshot()
+    assert snap["live"] == 1 and snap["opened"] == 1
+    assert snap["client_resets"] == 1 and snap["resets"] == 0
+    assert cache.drop("a") and not cache.drop("a")
+    assert cache.live == 0
+
+
+def test_cache_fresh_is_sticky_until_stepped():
+    """A dispatch failure between admission and step must NOT launder a
+    never-initialized session into a 'live' one reading stale slab content:
+    fresh stays set until mark_stepped confirms a dispatch ran."""
+    cache = SessionCache(_spec(), max_sessions=4, ttl_s=100.0)
+    _, fresh = cache.touch("a", now=0.0)
+    assert fresh
+    _, fresh = cache.touch("a", now=1.0)  # no dispatch happened in between
+    assert fresh
+    cache.mark_stepped(["a"])
+    _, fresh = cache.touch("a", now=2.0)
+    assert not fresh
+    cache.mark_stepped(["ghost"])  # unknown ids are ignored
+
+
+def test_cache_lru_spill_cap():
+    cache = SessionCache(_spec(), max_sessions=2, ttl_s=100.0)
+    cache.touch("a", now=0.0)
+    cache.touch("b", now=1.0)
+    cache.touch("a", now=2.0)  # refresh a: b is now the LRU
+    _, fresh_c = cache.touch("c", now=3.0)  # full -> evict b
+    assert fresh_c
+    snap = cache.snapshot()
+    assert snap["live"] == 2 and snap["evicted_lru"] == 1 and snap["peak"] == 2
+    # b comes back as a NEW session
+    _, fresh_b = cache.touch("b", now=4.0)
+    assert fresh_b and cache.snapshot()["opened"] == 4
+
+
+def test_lru_eviction_never_evicts_a_session_of_the_same_batch(toy_stateful_policy):
+    """Review regression: a same-`now` admission round larger than the spill
+    cap must never evict a session touched in THIS batch (that would hand
+    one slab row to two live sessions in one dispatch — last-write-wins
+    scatter == silent cross-user state corruption). Old sessions outside the
+    batch are still fair game; with every candidate protected the call fails
+    loudly instead."""
+    eng = SessionEngine(toy_stateful_policy, buckets=(1, 4), mode="greedy", max_sessions=2, ttl_s=100.0)
+    params = toy_stateful_policy.params
+    # an OLD session outside the batch is the eviction victim
+    eng.step_sessions(params, {"x": np.ones((1, 2), np.float32)}, ["old"])
+    obs2 = {"x": np.ones((2, 2), np.float32)}
+    acts = eng.step_sessions(params, obs2, ["a", "b"])  # full cache: evicts "old", not "a"
+    assert acts[0, 0] == 0 and acts[1, 0] == 0
+    snap = eng.cache.snapshot()
+    assert snap["evicted_lru"] == 1 and snap["live"] == 2
+    acts = eng.step_sessions(params, obs2, ["a", "b"])  # both streams intact
+    np.testing.assert_array_equal(acts[:, 0], [1.0, 1.0])
+    # a batch with MORE distinct sessions than the cap cannot be cached at
+    # all: loud error, not silent row sharing
+    with pytest.raises(RuntimeError, match="max_sessions"):
+        eng.step_sessions(params, {"x": np.ones((3, 2), np.float32)}, ["c", "d", "e"])
+
+
+def test_cache_ttl_sweep():
+    cache = SessionCache(_spec(), max_sessions=4, ttl_s=10.0)
+    cache.touch("a", now=0.0)
+    cache.touch("b", now=5.0)
+    assert cache.sweep(now=11.0) == 1  # a idle > ttl, b not
+    snap = cache.snapshot()
+    assert snap["live"] == 1 and snap["evicted_ttl"] == 1
+    _, fresh = cache.touch("a", now=12.0)
+    assert fresh  # evicted sessions restart fresh
+
+
+def test_cache_generation_versioned_reinit():
+    cache = SessionCache(_spec(), max_sessions=4, ttl_s=100.0)
+    row_a, _ = cache.touch("a", now=0.0)
+    cache.touch("b", now=0.0)
+    cache.mark_stepped(["a", "b"])
+    cache.invalidate_all()
+    # sessions stay ADMITTED (same rows, same LRU) but re-init lazily,
+    # each counted once as an involuntary reset
+    row, fresh = cache.touch("a", now=1.0)
+    assert row == row_a and fresh
+    _, fresh_b = cache.touch("b", now=1.0)
+    assert fresh_b
+    cache.mark_stepped(["a"])
+    _, fresh_again = cache.touch("a", now=2.0)
+    assert not fresh_again
+    snap = cache.snapshot()
+    assert snap["resets"] == 2 and snap["live"] == 2 and snap["generation"] == 1
+
+
+def test_cache_state_bytes():
+    cache = SessionCache(_spec(), max_sessions=8, ttl_s=1.0)
+    # 8 rows + 1 donor, one f32 per row
+    assert cache.state_bytes == 9 * 4
+    assert cache.snapshot()["state_bytes"] == 36
+
+
+# -- SessionEngine ------------------------------------------------------------ #
+
+
+def test_engine_stream_continuity_padding_and_reset(toy_stateful_policy):
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
+
+    tracecheck.reset()
+    eng = SessionEngine(toy_stateful_policy, buckets=(1, 4), mode="greedy", max_sessions=8, ttl_s=100.0)
+    cache = eng.cache
+    obs1 = {"x": np.ones((1, 2), np.float32)}
+    params = toy_stateful_policy.params
+    # session a alone, then interleaved with b, then batched with padding
+    for t in range(3):
+        acts = eng.step_sessions(params, obs1, ["a"])
+        assert acts[0, 0] == t
+    assert eng.step_sessions(params, obs1, ["b"])[0, 0] == 0
+    obs2 = {"x": np.ones((2, 2), np.float32)}
+    acts = eng.step_sessions(params, obs2, ["a", "b"])  # padded to bucket 4
+    assert acts[0, 0] == 3 and acts[1, 0] == 1
+    # reset restarts the stream; the other session is untouched
+    assert eng.step_sessions(params, obs1, ["a"], resets=[True])[0, 0] == 0
+    assert eng.step_sessions(params, obs1, ["b"])[0, 0] == 2
+    # sessionless one-shot rows ride the donor: always step 0
+    assert eng.step_sessions(params, obs1, [None])[0, 0] == 0
+    assert cache.snapshot()["live"] == 2
+    # zero post-warmup retraces; exactly one compile per bucket program
+    rep = tracecheck.report()
+    for b in (1, 4):
+        assert rep[f"serve.session[{b}].step"]["compiles"] == 1
+        assert rep[f"serve.session[{b}].step"]["post_warmup_compiles"] == 0
+    assert rep["serve.session.infer"]["compiles"] == 2  # one signature per bucket
+    assert rep["serve.session.infer"]["post_warmup_compiles"] == 0
+    stats = eng.stats()
+    assert stats["padded_rows"] > 0 and 0 < stats["batch_fill_ratio"] < 1
+    tracecheck.reset()
+
+
+def test_engine_chunk_beyond_ladder_preserves_order(toy_stateful_policy):
+    eng = SessionEngine(toy_stateful_policy, buckets=(1, 2), mode="greedy", max_sessions=8, ttl_s=100.0)
+    params = toy_stateful_policy.params
+    # 5 distinct sessions through a top bucket of 2: chunked 2+2+1, in order
+    for t in range(3):
+        obs = {"x": np.arange(10, dtype=np.float32).reshape(5, 2)}
+        acts = eng.step_sessions(params, obs, [f"s{i}" for i in range(5)])
+        assert acts.shape == (5, 2)
+        # every session advanced exactly once per sweep, rows in submit order
+        np.testing.assert_array_equal(acts[:, 0], np.full(5, float(t)))
+        expected_y = (obs["x"] @ np.arange(4, dtype=np.float32).reshape(2, 2)).sum(-1)
+        np.testing.assert_allclose(acts[:, 1], expected_y)
+
+
+def test_engine_rejects_row_count_mismatch(toy_stateful_policy):
+    eng = SessionEngine(toy_stateful_policy, buckets=(2,), mode="greedy", max_sessions=4, ttl_s=100.0)
+    obs = {"x": np.ones((2, 2), np.float32)}
+    with pytest.raises(ValueError, match="session rows"):
+        eng.infer_sessions(toy_stateful_policy.params, obs, [0], [True])
+
+
+def test_chunk_order_guard_trips_on_reordered_plan(toy_stateful_policy, ppo_policy, monkeypatch):
+    """The explicit ordering assertion (stateless parity tests could never
+    catch a reorder — their references are built from the same plan): a
+    shuffled/banged-up chunk plan must fail loudly on BOTH engines."""
+    import sheeprl_tpu.serve.engine as engine_mod
+    import sheeprl_tpu.serve.sessions as sessions_mod
+    from sheeprl_tpu.serve.engine import BucketEngine
+
+    def shuffled(n, cap):
+        spans = [(start, min(start + cap, n)) for start in range(0, n, cap)]
+        return spans[::-1]
+
+    # session engine
+    eng = SessionEngine(toy_stateful_policy, buckets=(2,), mode="greedy", max_sessions=8, ttl_s=100.0)
+    rows, fresh = zip(*[eng.cache.touch(f"s{i}") for i in range(5)])
+    monkeypatch.setattr(sessions_mod, "chunk_plan", shuffled)
+    with pytest.raises(RuntimeError, match="out of order"):
+        eng.infer_sessions(
+            toy_stateful_policy.params, {"x": np.ones((5, 2), np.float32)}, list(rows), list(fresh)
+        )
+    # stateless engine, same guard
+    beng = BucketEngine(ppo_policy, buckets=(1, 2), mode="greedy")
+    monkeypatch.setattr(engine_mod, "chunk_plan", shuffled)
+    with pytest.raises(RuntimeError, match="out of order"):
+        beng.infer(ppo_policy.params, {"state": np.zeros((5, 4), np.float32)})
+
+
+def test_chunk_plan_and_guard_units():
+    assert chunk_plan(5, 2) == [(0, 2), (2, 4), (4, 5)]
+    check_chunk_order(chunk_plan(7, 3), 7)  # no raise
+    with pytest.raises(RuntimeError, match="out of order"):
+        check_chunk_order([(2, 4), (0, 2)], 4)
+    with pytest.raises(RuntimeError, match="covers"):
+        check_chunk_order([(0, 2)], 4)
+
+
+# -- scheduler admission + server assembly ------------------------------------ #
+
+
+def _serve_cfg(**kw):
+    cfg = {"max_wait_ms": 1.0, "port": None, "session": {"buckets": [1, 4], "max_sessions": 8, "ttl_s": 100.0}}
+    cfg.update(kw)
+    return cfg
+
+
+def test_server_session_roundtrip_and_counters(toy_stateful_policy):
+    with PolicyServer(toy_stateful_policy, _serve_cfg()) as server:
+        obs = {"x": np.ones(2, np.float32)}
+        for t in range(4):
+            actions, version = server.client.act(obs, session_id="u1", timeout=30.0)
+            assert actions[0, 0] == t and version == 0
+        # reset starts the episode over
+        actions, _ = server.client.act(obs, session_id="u1", reset=True, timeout=30.0)
+        assert actions[0, 0] == 0
+        # sessionless one-shot on a stateful server: fresh throwaway state
+        for _ in range(2):
+            actions, _ = server.client.act(obs, timeout=30.0)
+            assert actions[0, 0] == 0
+        health = server.health()
+        assert health["sessions"]["live"] == 1 and health["sessions"]["state_bytes"] > 0
+        snap = server.stats.snapshot()
+        assert snap["Serve/sessions_live"] == 1
+        assert snap["Serve/sessions_opened"] == 1
+        assert snap["Serve/sessions_client_resets"] == 1
+        assert snap["Serve/sessions_reset"] == 0
+
+
+def test_concurrent_same_session_never_shares_a_batch(toy_stateful_policy):
+    """Two in-flight requests for one session must serve as TWO ordered
+    steps (the second is held over), never one batch stepping a session
+    twice from the same state."""
+    with PolicyServer(toy_stateful_policy, _serve_cfg(max_wait_ms=50.0)) as server:
+        obs = {"x": np.ones(2, np.float32)}
+        results = []
+
+        def call():
+            actions, _ = server.client.act(obs, session_id="dup", timeout=30.0)
+            results.append(float(actions[0, 0]))
+
+        threads = [threading.Thread(target=call) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sorted(results) == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_session_request_validation(toy_stateful_policy, toy_policy):
+    with PolicyServer(toy_stateful_policy, _serve_cfg()) as server:
+        with pytest.raises(ValueError, match="one state row"):
+            server.scheduler.submit(
+                {"x": np.ones((2, 2), np.float32)}, session_id="u1"
+            )
+    with PolicyServer(toy_policy, {"buckets": [1, 4], "max_wait_ms": 1.0, "port": None}) as server:
+        with pytest.raises(ValueError, match="stateless"):
+            server.scheduler.submit({"x": np.ones((1, 2), np.float32)}, session_id="u1")
+
+
+def test_stateful_policy_refuses_naive_engine(toy_stateful_policy):
+    with pytest.raises(ValueError, match="session engine"):
+        PolicyServer(toy_stateful_policy, _serve_cfg(), engine="naive")
+
+
+# -- hot swap semantics ------------------------------------------------------- #
+
+
+def test_hot_swap_keeps_sessions_live(toy_stateful_policy):
+    """A swapped tree with matching state avals steps live sessions without
+    interruption: streams continue, Serve/sessions_reset stays 0."""
+    with PolicyServer(toy_stateful_policy, _serve_cfg()) as server:
+        obs = {"x": np.ones(2, np.float32)}
+        for t in range(3):
+            actions, _ = server.client.act(obs, session_id="u1", timeout=30.0)
+            assert actions[0, 0] == t
+        new_params = jax.tree.map(lambda x: x + 1.0, toy_stateful_policy.params)
+        version = server.weights.publish_params(new_params)
+        assert version == 1
+        actions, got_version = server.client.act(obs, session_id="u1", timeout=30.0)
+        assert got_version == 1
+        assert actions[0, 0] == 3  # the stream continued across the swap
+        assert actions[0, 1] != 6.0  # ...under the NEW weights (w+1)
+        snap = server.stats.snapshot()
+        assert snap["Serve/sessions_reset"] == 0 and snap["Serve/swap_count"] == 1
+
+
+def test_incompatible_swap_versioned_reinit(toy_stateful_policy):
+    """If a swap changes the derived state avals, the cache re-inits
+    versioned: sessions stay admitted, streams restart, each counted as a
+    Serve/sessions_reset."""
+    eng = SessionEngine(toy_stateful_policy, buckets=(1,), mode="greedy", max_sessions=4, ttl_s=100.0)
+    cache = eng.cache
+    params = toy_stateful_policy.params
+    obs = {"x": np.ones((1, 2), np.float32)}
+    for t in range(2):
+        assert eng.step_sessions(params, obs, ["u1"])[0, 0] == t
+    assert eng.check_swap(params) is True  # same avals: no-op
+    assert cache.snapshot()["generation"] == 0
+    # an init_fn whose avals drift under the new params => incompatible
+    orig_init = toy_stateful_policy.init_fn
+    toy_stateful_policy.init_fn = lambda p, n: {"count": jax.numpy.zeros((n, 2), jax.numpy.float32)}
+    try:
+        assert eng.check_swap(params) is False
+    finally:
+        toy_stateful_policy.init_fn = orig_init
+    assert eng.step_sessions(params, obs, ["u1"])[0, 0] == 0  # versioned re-init
+    assert cache.snapshot()["resets"] == 1
+
+
+def test_failed_dispatch_rebuilds_slab_and_reinits(toy_stateful_policy, monkeypatch):
+    """Review regression: once a dispatch consumes the DONATED slab, a
+    failure before its outputs materialize leaves the old buffer deleted (on
+    donation-honoring backends) — the engine must rebuild a zeroed slab and
+    version-reinit instead of wedging every future dispatch on a dead
+    array. One counted round of re-inits, then business as usual."""
+    eng = SessionEngine(toy_stateful_policy, buckets=(1,), mode="greedy", max_sessions=4, ttl_s=100.0)
+    params = toy_stateful_policy.params
+    obs = {"x": np.ones((1, 2), np.float32)}
+    for t in range(2):
+        assert eng.step_sessions(params, obs, ["u1"])[0, 0] == t
+    orig_dispatch = eng._dispatch
+    generation_before = eng.cache.generation
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("injected dispatch failure")
+
+    monkeypatch.setattr(eng, "_dispatch", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        eng.step_sessions(params, obs, ["u1"])
+    monkeypatch.setattr(eng, "_dispatch", orig_dispatch)
+    assert eng.cache.generation == generation_before + 1  # slab rebuilt + invalidated
+    # the session survives ADMITTED, restarts its stream fresh (counted)
+    assert eng.step_sessions(params, obs, ["u1"])[0, 0] == 0
+    assert eng.cache.snapshot()["resets"] == 1
+    assert eng.step_sessions(params, obs, ["u1"])[0, 0] == 1  # ...and keeps going
+
+
+def test_stateful_inflight_drops_at_commit_never_double_steps(toy_stateful_policy):
+    """Review regression: once a stateful batch's dispatch has COMMITTED to
+    the slab, a worker death in the resolve loop must NOT hand the batch to
+    recover_inflight — re-serving would step every session twice for one
+    client-observed step. The cost is a visible caller timeout, never a
+    silently corrupted stream."""
+    from sheeprl_tpu.serve.scheduler import RequestScheduler, _Request
+    from sheeprl_tpu.serve.weights import WeightStore
+
+    eng = SessionEngine(toy_stateful_policy, buckets=(1, 4), mode="greedy", max_sessions=4, ttl_s=100.0)
+    store = WeightStore(toy_stateful_policy.params, toy_stateful_policy.params_from_state)
+    sched = RequestScheduler(eng, store, max_wait_s=0.001, sessions=eng.cache)
+    obs = {"x": np.ones((1, 2), np.float32)}
+
+    class _DiesOnResolve(_Request):  # _Request is __slots__-only
+        def resolve(self, *a, **k):
+            raise RuntimeError("worker died mid-resolve")
+
+    req = _DiesOnResolve(obs, 1, session_id="u1")
+    sched._inflight = [req]
+    with pytest.raises(RuntimeError, match="mid-resolve"):
+        sched._serve_batch([req])
+    assert sched._inflight is None  # committed: must never be re-served
+    assert sched.recover_inflight() == 0
+    # the session was stepped EXACTLY once: the next request continues at 1
+    req2 = _Request(obs, 1, session_id="u1")
+    sched._serve_batch([req2])
+    assert req2.actions[0][0] == 1
+
+
+# -- TTL eviction under load -------------------------------------------------- #
+
+
+def test_ttl_eviction_under_load(toy_stateful_policy):
+    """Sessions idle past ttl_s are swept WHILE other traffic flows: the
+    active session keeps its stream, the idle one frees its row and restarts
+    fresh on return."""
+    cfg = _serve_cfg()
+    cfg["session"] = {"buckets": [1, 4], "max_sessions": 8, "ttl_s": 0.3, "sweep_every_s": 0.05}
+    with PolicyServer(toy_stateful_policy, cfg) as server:
+        obs = {"x": np.ones(2, np.float32)}
+        server.client.act(obs, session_id="idle", timeout=30.0)
+        # keep "active" hot past the idle session's TTL
+        deadline = time.monotonic() + 1.0
+        steps = 0
+        while time.monotonic() < deadline:
+            actions, _ = server.client.act(obs, session_id="active", timeout=30.0)
+            assert actions[0, 0] == steps  # never reset by the sweep
+            steps += 1
+            time.sleep(0.02)
+        health = server.health()
+        assert health["sessions"]["ttl_evictions"] >= 1
+        assert health["sessions"]["live"] == 1  # only "active" survived
+        # the evicted session returns as a fresh stream
+        actions, _ = server.client.act(obs, session_id="idle", timeout=30.0)
+        assert actions[0, 0] == 0
+
+
+# -- batched stepping == offline sequential stepping (real recurrent policy) -- #
+
+
+def test_recurrent_sessions_bit_parity_unit(recurrent_policy):
+    """Row i of a padded multi-session batch must be BIT-identical to the
+    offline sequential eval loop for that session — the property that makes
+    cross-session batching and padding correctness-free. (The e2e asserts
+    the same through the TCP front end; this unit isolates the engine.)"""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+    from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel import Fabric
+    from tests.test_serve.conftest import RECURRENT_TINY
+
+    cfg = compose(RECURRENT_TINY)
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(42)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    _, params, player = build_agent(fabric, (2,), False, cfg, obs_space, None)
+
+    K, T = 3, 5
+    rngs = [np.random.default_rng(i) for i in range(K)]
+    obs_seqs = [[r.uniform(-1, 1, size=(4,)).astype(np.float32) for _ in range(T)] for r in rngs]
+    ref = []
+    for c in range(K):
+        states = player.reset_states(1)
+        prev = np.zeros((1, 1, 2), np.float32)
+        key = jax.random.PRNGKey(cfg.seed or 0)
+        seq = []
+        for t in range(T):
+            jobs = prepare_obs(fabric, {"state": obs_seqs[c][t]}, num_envs=1)
+            key, subkey = jax.random.split(key)
+            acts, _, _, states = player(params, jobs, jax.device_put(prev), states, subkey, greedy=True)
+            prev = np.concatenate([np.asarray(a) for a in acts], axis=-1).reshape(1, 1, -1)
+            seq.append(np.concatenate([np.asarray(a).argmax(axis=-1) for a in acts], axis=-1).reshape(-1))
+        ref.append(seq)
+
+    eng = SessionEngine(recurrent_policy, buckets=(1, 4), mode="greedy", max_sessions=8, ttl_s=100.0)
+    for t in range(T):
+        obs = {"state": np.stack([recurrent_policy.prepare({"state": obs_seqs[c][t]}, 1)["state"][0] for c in range(K)])}
+        acts = eng.step_sessions(recurrent_policy.params, obs, [f"c{c}" for c in range(K)])
+        for c in range(K):
+            np.testing.assert_array_equal(np.asarray(acts[c]), np.asarray(ref[c][t]))

@@ -1,0 +1,312 @@
+"""The graft-sessions acceptance bar, through the real CLI + TCP front end:
+N interleaved stateful clients produce per-client action sequences
+BIT-identical to the offline sequential eval loop for the same checkpoint —
+for ppo_recurrent (LSTM hidden + prev-action carry) AND dreamer_v3
+(posterior + recurrent state + one-hot carry) — across a hot weight swap
+that keeps every session live, with ``serve.session[N].step`` compiles ==
+#buckets and 0 post-warmup retraces under strict tracecheck."""
+
+import glob
+import json
+import os
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import find_run_config, run, serve
+from sheeprl_tpu.config import dotdict, load_yaml
+from sheeprl_tpu.fault.manager import CheckpointManager
+from sheeprl_tpu.parallel import Fabric
+from sheeprl_tpu.utils.checkpoint import load_state
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _request(addr, payload, timeout=60.0, retry_deadline=None):
+    """One JSON-lines round trip; retries connection refusal until
+    ``retry_deadline`` (server still compiling its bucket ladder)."""
+    while True:
+        try:
+            with socket.create_connection(addr, timeout=timeout) as sock:
+                sock.sendall((json.dumps(payload) + "\n").encode())
+                buf = b""
+                while not buf.endswith(b"\n"):
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            return json.loads(buf.decode())
+        except (ConnectionRefusedError, OSError):
+            if retry_deadline is None or time.perf_counter() > retry_deadline:
+                raise
+            time.sleep(0.1)
+
+
+def _wait_version(addr, version, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        health = _request(addr, {"health": True})
+        if health["weights"]["version"] >= version:
+            return health
+        time.sleep(0.05)
+    raise AssertionError(f"weight version never reached {version}")
+
+
+def _serve_and_stream(ckpt, obs_key, obs_seqs, publish_swap, K, T1, T2, buckets=(1, 4)):
+    """Drive the REAL serve verb: K session clients step phase 1 under the
+    checkpoint weights, a swap publishes, phase 2 continues the SAME
+    sessions under the new weights. Returns (streams, versions, tracecheck
+    report snapshot). Strict tracecheck is armed around the whole server
+    lifetime — any post-warmup retrace raises inside the serve thread and
+    surfaces as a failed request."""
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
+
+    port = _free_port()
+    total = K * (T1 + T2)
+    tracecheck.reset()
+    tracecheck.configure(mode="strict", transfer_guard=True)
+    try:
+        t = threading.Thread(
+            target=serve,
+            args=(
+                [
+                    f"checkpoint_path={ckpt}",
+                    "fabric.accelerator=cpu",
+                    f"serve.port={port}",
+                    f"serve.session.buckets=[{','.join(str(b) for b in buckets)}]",
+                    "serve.max_wait_ms=2.0",
+                    "serve.watch=True",
+                    "serve.watch_poll_s=0.05",
+                    f"serve.max_requests={total}",
+                    "serve.log_every_s=60",
+                ],
+            ),
+            daemon=True,
+        )
+        t.start()
+        addr = ("127.0.0.1", port)
+        boot_deadline = time.perf_counter() + 240.0
+        streams = [[] for _ in range(K)]
+        versions = [[] for _ in range(K)]
+
+        def phase(t0, t1, first_retries=False):
+            for step in range(t0, t1):
+                for c in range(K):
+                    resp = _request(
+                        addr,
+                        {"obs": {obs_key: obs_seqs[c][step].tolist()}, "session_id": f"client-{c}"},
+                        retry_deadline=boot_deadline if first_retries and step == t0 and c == 0 else None,
+                    )
+                    assert "actions" in resp, resp
+                    streams[c].append(np.asarray(resp["actions"])[0])
+                    versions[c].append(resp["version"])
+
+        phase(0, T1, first_retries=True)
+        publish_swap()
+        _wait_version(addr, 1)
+        health = _request(addr, {"health": True})
+        assert health["sessions"]["live"] == K
+        assert health["sessions"]["resets"] == 0  # the swap kept sessions live
+        phase(T1, T1 + T2)
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "serve loop did not exit at max_requests"
+        report = {k: v for k, v in tracecheck.report().items() if k.startswith("serve.session")}
+    finally:
+        tracecheck.configure(mode="warn", transfer_guard=False)
+        tracecheck.reset()
+
+    for c in range(K):
+        assert versions[c][:T1] == [0] * T1  # phase 1 under the checkpoint
+        assert versions[c][T1:] == [1] * T2  # phase 2 under the swapped weights
+    # serve.session[N].step compiles == #buckets, 0 post-warmup retraces
+    for b in buckets:
+        assert report[f"serve.session[{b}].step"]["compiles"] == 1
+    assert sum(report[f"serve.session[{b}].step"]["compiles"] for b in buckets) == len(buckets)
+    for name, entry in report.items():
+        assert entry["post_warmup_compiles"] == 0, (name, entry)
+    assert report["serve.session.infer"]["compiles"] == len(buckets)
+    return streams
+
+
+def _perturb(tree):
+    return jax.tree.map(lambda x: np.asarray(x) + np.asarray(1e-3, np.asarray(x).dtype), tree)
+
+
+def _train_and_find_ckpt(tmp_path, args):
+    run(args + [f"log_root={tmp_path}/train", "dry_run=True", "checkpoint.save_last=True"])
+    ckpts = sorted(glob.glob(f"{tmp_path}/train/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+    assert ckpts, "the training run saved no checkpoint"
+    return ckpts[-1]
+
+
+def _obs_streams(obs_space, obs_key, K, T):
+    rngs = [np.random.default_rng(c) for c in range(K)]
+    shape = obs_space[obs_key].shape
+    return [[r.uniform(-1, 1, size=shape).astype(np.float32) for _ in range(T)] for r in rngs]
+
+
+PPO_REC_TINY = [
+    "exp=ppo_recurrent",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_sequence_length=4",
+    "algo.per_rank_num_batches=2",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def test_sessions_e2e_ppo_recurrent_bit_parity_across_swap(tmp_path):
+    from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+    from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs
+    from sheeprl_tpu.envs.factory import make_env
+
+    ckpt = _train_and_find_ckpt(tmp_path, PPO_REC_TINY)
+    cfg = dotdict(load_yaml(find_run_config(ckpt)))
+    state = load_state(ckpt)
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(cfg.seed)
+    env = make_env(cfg, cfg.seed, 0, None, "sessions_e2e", vector_env_idx=0)()
+    obs_space, act_space = env.observation_space, env.action_space
+    env.close()
+    n_actions = int(act_space.n)
+
+    K, T1, T2 = 3, 4, 4
+    obs_seqs = _obs_streams(obs_space, "state", K, T1 + T2)
+    perturbed_agent = _perturb(state["agent"])
+
+    # offline sequential eval loop per client: phase 1 under the checkpoint,
+    # phase 2 continuing the SAME carried state under the perturbed weights
+    _, params0, player = build_agent(fabric, (n_actions,), False, cfg, obs_space, state["agent"])
+    _, params1, _ = build_agent(fabric, (n_actions,), False, cfg, obs_space, perturbed_agent)
+    ref = []
+    for c in range(K):
+        states = player.reset_states(1)
+        prev = np.zeros((1, 1, n_actions), np.float32)
+        key = jax.random.PRNGKey(cfg.seed or 0)
+        seq = []
+        for t in range(T1 + T2):
+            params = params0 if t < T1 else params1
+            jobs = prepare_obs(fabric, {"state": obs_seqs[c][t]}, num_envs=1)
+            key, subkey = jax.random.split(key)
+            acts, _, _, states = player(params, jobs, jax.device_put(prev), states, subkey, greedy=True)
+            prev = np.concatenate([np.asarray(a) for a in acts], axis=-1).reshape(1, 1, -1)
+            seq.append(np.concatenate([np.asarray(a).argmax(axis=-1) for a in acts], axis=-1).reshape(-1))
+        ref.append(seq)
+
+    ckpt_dir = os.path.dirname(ckpt)
+
+    def publish_swap():
+        CheckpointManager().save(
+            os.path.join(ckpt_dir, "ckpt_900000_0.ckpt"), {"agent": perturbed_agent}, step=900000
+        )
+
+    streams = _serve_and_stream(ckpt, "state", obs_seqs, publish_swap, K, T1, T2)
+    for c in range(K):
+        for t in range(T1 + T2):
+            np.testing.assert_array_equal(
+                np.asarray(streams[c][t]), np.asarray(ref[c][t]),
+                err_msg=f"client {c} step {t}: served != offline eval loop",
+            )
+
+
+DREAMER_TINY = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo=dreamer_v3_XS",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=1",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.reward_model.bins=17",
+    "algo.critic.bins=17",
+    "algo.cnn_keys.encoder=[]",
+    "algo.cnn_keys.decoder=[]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+def test_sessions_e2e_dreamer_v3_bit_parity_across_swap(tmp_path):
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs
+    from sheeprl_tpu.envs.factory import make_env
+
+    ckpt = _train_and_find_ckpt(tmp_path, DREAMER_TINY)
+    cfg = dotdict(load_yaml(find_run_config(ckpt)))
+    state = load_state(ckpt)
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(cfg.seed)
+    env = make_env(cfg, cfg.seed, 0, None, "sessions_e2e")()
+    obs_space, act_space = env.observation_space, env.action_space
+    env.close()
+    n_actions = int(act_space.n)
+
+    K, T1, T2 = 3, 4, 4
+    obs_seqs = _obs_streams(obs_space, "state", K, T1 + T2)
+    model_keys = ("world_model", "actor", "critic", "target_critic")
+    perturbed = {k: _perturb(state[k]) for k in model_keys}
+
+    _, _, _, params0, player = build_agent(
+        fabric, (n_actions,), False, cfg, obs_space, *[state[k] for k in model_keys]
+    )
+    _, _, _, params1, _ = build_agent(
+        fabric, (n_actions,), False, cfg, obs_space, *[perturbed[k] for k in model_keys]
+    )
+    ref = []
+    for c in range(K):
+        player.num_envs = 1
+        player.init_states(params0)
+        key = jax.random.PRNGKey(cfg.seed or 0)
+        seq = []
+        for t in range(T1 + T2):
+            params = params0 if t < T1 else params1
+            jobs = prepare_obs(fabric, {"state": obs_seqs[c][t]}, num_envs=1)
+            key, subkey = jax.random.split(key)
+            acts = player.get_actions(params, jobs, subkey, greedy=True)
+            seq.append(np.stack([np.asarray(a).argmax(axis=-1) for a in acts], axis=-1).reshape(-1))
+        ref.append(seq)
+
+    ckpt_dir = os.path.dirname(ckpt)
+
+    def publish_swap():
+        # dreamer checkpoints are agent-less (model trees at the top level):
+        # the watcher publishes the FULL state and the dreamer builder's
+        # params_from_state consumes exactly that layout
+        CheckpointManager().save(os.path.join(ckpt_dir, "ckpt_900000_0.ckpt"), dict(perturbed), step=900000)
+
+    streams = _serve_and_stream(ckpt, "state", obs_seqs, publish_swap, K, T1, T2)
+    for c in range(K):
+        for t in range(T1 + T2):
+            np.testing.assert_array_equal(
+                np.asarray(streams[c][t]), np.asarray(ref[c][t]),
+                err_msg=f"client {c} step {t}: served != offline eval loop",
+            )

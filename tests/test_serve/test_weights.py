@@ -129,3 +129,33 @@ def test_hot_swap_under_load(toy_policy):
     for _, version, obs, actions in flat:
         w = np.asarray(toy_policy.params["w"]) if version == 0 else np.full((2, 3), float(version), np.float32)
         assert np.allclose(actions, obs["x"] @ w, rtol=1e-5), f"actions torn across versions at v{version}"
+
+def test_watcher_strikes_a_save_that_loads_but_cannot_rebuild(tmp_path, toy_policy):
+    """Review regression: a checkpoint that LOADS fine but whose tree
+    params_from_state cannot rebuild (wrong layout — e.g. a foreign save
+    with no 'agent' key feeding the full state to a stateless rebuilder)
+    must strike and quarantine like any other bad save, not wedge the
+    publish loop retrying it forever; a NEWER good save still swaps in."""
+    import jax.numpy as jnp
+
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    mgr = CheckpointManager()
+
+    def strict_rebuild(agent_state):
+        return {"w": jnp.asarray(agent_state["w"], jnp.float32)}  # KeyError on foreign layouts
+
+    store = WeightStore(toy_policy.params, strict_rebuild)
+    watcher = CheckpointWatcher(ckpt_dir, store, poll_s=0.05, quarantine_after=2)
+    # loads fine, rebuilds never: no "agent" key -> full-state fallback
+    # reaches strict_rebuild, which KeyErrors
+    mgr.save(ckpt_dir / "ckpt_10_0.ckpt", {"foreign": {"w": np.ones((2, 3), np.float32)}}, step=10)
+    with pytest.warns(UserWarning, match="could not load"):
+        assert watcher.poll_once() is False
+    with pytest.warns(UserWarning, match="QUARANTINED"):
+        assert watcher.poll_once() is False
+    assert watcher.quarantined and store.version == 0
+    # a newer GOOD save publishes despite the quarantined one in between
+    mgr.save(ckpt_dir / "ckpt_20_0.ckpt", {"agent": {"w": 2 * np.ones((2, 3), np.float32)}}, step=20)
+    assert watcher.poll_once() is True
+    assert store.version == 1
