@@ -23,6 +23,9 @@ matrix read the registry, nothing is hand-enumerated:
 - ``sac_sebulba`` — the async off-policy pipeline vs its coupled twin at an
   identical recipe (``BENCH_SAC_MODE=async|coupled``,
   howto/async_offpolicy.md);
+- ``dreamer_sebulba`` — async DreamerV3 over the ragged per-env-head device
+  sequence ring vs the coupled host loop at an identical recipe
+  (``BENCH_DREAMER_MODE=sebulba|coupled``, howto/async_offpolicy.md);
 - ``serve`` — the continuous-batching inference tier: p50/p99 latency +
   throughput at fixed offered loads, AOT bucketed engine
   (``BENCH_SERVE_MODE=aot``) vs naive per-request jit dispatch (``naive``),
@@ -222,6 +225,43 @@ def _lane_sac_sebulba() -> None:
                 "value": round(total_steps / elapsed, 2),
                 "unit": "env-steps/s",
                 "mode": sac_mode,
+                "elapsed_s": round(elapsed, 2),
+                "replay_path_s": round(timers.get("Time/replay_path_time", 0.0), 3),
+                "train_s": round(timers.get("Time/train_time", 0.0), 3),
+                "env_interaction_s": round(timers.get("Time/env_interaction_time", 0.0), 3),
+                # no vs_baseline: the PPO reference bar is a different
+                # algorithm's env rate
+            }
+        )
+    )
+
+
+@lane("dreamer_sebulba", "dreamer_async", "dreamer_dummy_sebulba_env_steps_per_sec")
+def _lane_dreamer_sebulba() -> None:
+    dreamer_mode = os.environ.get("BENCH_DREAMER_MODE", "sebulba").strip().lower()
+    if dreamer_mode not in ("sebulba", "coupled"):
+        raise SystemExit(f"Unknown BENCH_DREAMER_MODE '{dreamer_mode}' (expected 'sebulba' or 'coupled')")
+    # the coupled twin is a dedicated exp with the IDENTICAL recipe (model,
+    # batch, sequence length, replay ratio, env) so the ONLY difference
+    # between the two runs is the topology
+    exp = "dreamer_sebulba_benchmarks" if dreamer_mode == "sebulba" else "dreamer_coupled_benchmarks"
+    total_steps = _env_steps(4096)
+    elapsed = _run_cli(exp, total_steps, keep_timer=True)
+    # Both modes consume the identical grant schedule, so env-steps/s is
+    # directly comparable. The per-segment seconds show WHERE the time went:
+    # coupled = env + player inference + host window sampling + train, all
+    # serialized per env step; sebulba = the learner's append + train only
+    # (env/player/packing/transfer ride the actor threads).
+    from sheeprl_tpu.utils.timer import timer as _timer
+
+    timers = _timer.compute()
+    print(
+        json.dumps(
+            {
+                "metric": "dreamer_dummy_sebulba_env_steps_per_sec",
+                "value": round(total_steps / elapsed, 2),
+                "unit": "env-steps/s",
+                "mode": dreamer_mode,
                 "elapsed_s": round(elapsed, 2),
                 "replay_path_s": round(timers.get("Time/replay_path_time", 0.0), 3),
                 "train_s": round(timers.get("Time/train_time", 0.0), 3),

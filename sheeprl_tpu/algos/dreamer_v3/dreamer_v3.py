@@ -362,6 +362,14 @@ def make_train_step(
         )
         return jax.jit(shard_train, donate_argnums=(0, 1, 2))
 
+    # Decoupled (Sebulba) variant: append-free governed train step over the
+    # async sequence ring (per-env heads live ON DEVICE, advanced by the
+    # ragged append program) — returns ``(jitted_fn, ctl_layout)``.
+    if ring.get("decoupled"):
+        from sheeprl_tpu.data.ring import build_seq_train_step
+
+        return build_seq_train_step(gradient_step, mesh, ring)
+
     # Burst variant: carry = (params, opts, moments_state, cum); the ring
     # machinery (append, on-device window sampling, granted-chunk scan) is
     # shared with Dreamer-V1/V2 in ``data/ring.py``.
@@ -560,6 +568,9 @@ def main(fabric, cfg: Dict[str, Any]):
             fabric.world_size,
             float(cfg.buffer.get("hbm_budget_gb", 4.0)),
             allow_shard=False,  # the sequence-ring burst program is replicated
+            # per-env-head sequence shape: heads + validity working set + the
+            # gathered f32 sample window, not just flat rows
+            sequence={"seq_len": seq_len, "batch_size": batch_size},
         )
         if cfg.metric.log_level > 0 and cfg.buffer.get("device_resident", False):
             print(f"Replay: device_resident={resident_mode} ({resident_reason})")
@@ -651,6 +662,7 @@ def main(fabric, cfg: Dict[str, Any]):
             restore=resident_restore
             if resident_restore is not None
             else (rb if (state is not None and cfg.buffer.checkpoint) else None),
+            trace_name="dreamer_v3.burst_step",
         )
         resident_carry = (params, opts, moments_state, jnp.int32(0))
     else:
@@ -830,14 +842,20 @@ def main(fabric, cfg: Dict[str, Any]):
         elif iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step - prefill_steps * policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
-                sample = rb.sample(
-                    batch_size,
-                    sequence_length=seq_len,
-                    n_samples=per_rank_gradient_steps,
-                )  # (G, T, B, ...)
-                # ONE packed sharded transfer for the whole sample dict (the
-                # PR-3 stager trick) instead of K per-key device_put dispatches
-                data = put_packed(sample, data_sharding, dtype=np.float32)
+                # the host-side replay path on the env-step critical path —
+                # numpy window sampling + the f32 staging transfer — timed
+                # for parity with the async tier's append-only segment
+                # (BENCH_METRIC=dreamer_sebulba reads both)
+                with timer("Time/replay_path_time", SumMetric):
+                    sample = rb.sample(
+                        batch_size,
+                        sequence_length=seq_len,
+                        n_samples=per_rank_gradient_steps,
+                    )  # (G, T, B, ...)
+                    # ONE packed sharded transfer for the whole sample dict
+                    # (the PR-3 stager trick) instead of K per-key device_put
+                    # dispatches
+                    data = put_packed(sample, data_sharding, dtype=np.float32)
                 with timer("Time/train_time", SumMetric):
                     rng, train_key = jax.random.split(rng)
                     params, opts, moments_state, metrics = train_fn(
@@ -974,3 +992,139 @@ def main(fabric, cfg: Dict[str, Any]):
             },
         )
     logger.close()
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+
+def audit_dreamer_setup(spec, capacity: int = 8, n_envs: int = 2, seq_len: int = 2, grad_chunk: int = 1):
+    """Tiny pixel+vector DreamerV3 context on the audit mesh (shared with the
+    ``dreamer_sebulba.*`` registrations): XS-scaled agent + optimizers +
+    the sequence-ring spec, all replicated — the Dreamer burst/async programs
+    run fully replicated with the batch axis split per device in-graph."""
+    from sheeprl_tpu.algos.ppo.ppo import _abstract_like
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.burst import dreamer_ring_keys
+
+    batch = 2 * spec.devices
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            f"env.num_envs={n_envs}",
+            "env.screen_size=64",
+            "algo=dreamer_v3_XS",
+            f"algo.per_rank_batch_size={batch}",
+            f"algo.per_rank_sequence_length={seq_len}",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.reward_model.bins=17",
+            "algo.critic.bins=17",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    fabric = Fabric(devices=spec.devices, accelerator="cpu")
+    obs_space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8),
+            "state": gym.spaces.Box(-20, 20, (4,), np.float32),
+        }
+    )
+    actions_dim = (2,)
+    world_model, actor, critic, params, player = build_agent(
+        fabric, actions_dim, False, cfg, obs_space, None, None, None, None
+    )
+    txs = {
+        "world": build_optimizer(cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients),
+        "actor": build_optimizer(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients),
+        "critic": build_optimizer(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients),
+    }
+    opts = {
+        "world": txs["world"].init(params["world_model"]),
+        "actor": txs["actor"].init(params["actor"]),
+        "critic": txs["critic"].init(params["critic"]),
+    }
+    moments = init_moments()
+    rep = fabric.replicated
+    ring_keys = dreamer_ring_keys(obs_space, ["rgb"], ["state"], actions_dim, with_is_first=True)
+    carry = (
+        _abstract_like(params, rep),
+        _abstract_like(opts, rep),
+        _abstract_like(moments, rep),
+        jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+    )
+    return {
+        "cfg": cfg,
+        "fabric": fabric,
+        "mesh": fabric.mesh,
+        "world_model": world_model,
+        "actor": actor,
+        "critic": critic,
+        "params": params,
+        "txs": txs,
+        "carry": carry,
+        "ring_keys": ring_keys,
+        "capacity": capacity,
+        "n_envs": n_envs,
+        "seq_len": seq_len,
+        "grad_chunk": grad_chunk,
+        "batch": batch,
+        "actions_dim": actions_dim,
+        "rep": rep,
+    }
+
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs("dreamer_v3.burst_step")
+def _audit_programs(spec: AuditMesh):
+    from sheeprl_tpu.data.ring import effective_stage_buckets, make_blob_layouts
+
+    s = audit_dreamer_setup(spec)
+    buckets = effective_stage_buckets((1, 2), 2)  # the SequenceRingDriver flush set
+    ring_spec = {
+        "capacity": s["capacity"],
+        "n_envs": s["n_envs"],
+        "grad_chunk": s["grad_chunk"],
+        "seq_len": s["seq_len"],
+        "batch_size": s["batch"],
+        "ring_keys": s["ring_keys"],
+        "stage_buckets": buckets,
+        "stage_max": 2,
+    }
+    # ONE lowering path with the driver: the same make_train_step(ring=...)
+    # builder SequenceRingDriver dispatches (fused append+sample+train)
+    burst_fn = make_train_step(
+        s["world_model"], s["actor"], s["critic"], s["cfg"], s["mesh"], s["actions_dim"], False,
+        s["txs"], ring=ring_spec,
+    )
+    layouts = make_blob_layouts(s["ring_keys"], s["n_envs"], s["grad_chunk"], buckets)
+    blob = jax.ShapeDtypeStruct((layouts[max(buckets)].nbytes,), jnp.uint8, sharding=s["rep"])
+    rb = {
+        k: jax.ShapeDtypeStruct((s["capacity"], s["n_envs"]) + shape, dtype, sharding=s["rep"])
+        for k, (shape, dtype) in s["ring_keys"].items()
+    }
+    yield AuditProgram(
+        name="dreamer_v3.burst_step",
+        fn=burst_fn,
+        args=(s["carry"], rb, blob),
+        source=__name__,
+        donate_argnums=(1,),
+        feedback_outputs=(0, 1),
+        out_decl={0: P(), 1: P()},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )

@@ -18,7 +18,7 @@ from sheeprl_tpu.utils.registry import register_evaluation, register_policy_buil
 __all__ = ["evaluate_dreamer_v3", "serve_policy_dreamer_v3"]
 
 
-@register_evaluation(algorithms="dreamer_v3")
+@register_evaluation(algorithms=["dreamer_v3", "dreamer_sebulba"])
 def evaluate_dreamer_v3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, fabric.global_rank)
@@ -51,7 +51,7 @@ def evaluate_dreamer_v3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     logger.close()
 
 
-@register_policy_builder(algorithms=["dreamer_v3"])
+@register_policy_builder(algorithms=["dreamer_v3", "dreamer_sebulba"])
 def serve_policy_dreamer_v3(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state, full_state=None):
     """:class:`~sheeprl_tpu.serve.policy.StatefulServePolicy` over the
     DreamerV3 world model + actor.

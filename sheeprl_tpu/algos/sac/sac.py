@@ -926,7 +926,11 @@ def main(fabric, cfg: Dict[str, Any]):
             # trainer thread for a wire round-trip per burst (single-caller
             # contract holds — only the trainer thread calls it).
             on_step=lambda carry, _m: snapshot.refresh_async(carry[0]),
+            supervisor_cfg=(cfg.get("fault") or {}).get("supervisor"),
         )
+        # refresh pulls ride the trainer's supervisor (restart ladder instead
+        # of a silently frozen host policy on a dead one-shot pull thread)
+        snapshot.attach_supervisor(trainer.supervisor)
 
         def _flush_burst():
             """Ship the staged transitions + up to one grant chunk to the

@@ -26,11 +26,13 @@ from sheeprl_tpu.replay.device_buffer import (
     restore_host_buffer,
     restore_host_env_buffer,
 )
-from sheeprl_tpu.replay.driver import SequenceRingDriver
+from sheeprl_tpu.replay.driver import AsyncSequenceRing, SeqBlobWriter, SequenceRingDriver
 
 __all__ = [
+    "AsyncSequenceRing",
     "DeviceReplayBuffer",
     "DeviceReplayState",
+    "SeqBlobWriter",
     "SequenceRingDriver",
     "estimate_ring_bytes",
     "resolve_device_resident",

@@ -63,14 +63,38 @@ def estimate_ring_bytes(
     n_dev: int = 1,
     shard_envs: bool = False,
     prioritized: bool = False,
+    sequence: Optional[Dict[str, int]] = None,
 ) -> int:
-    """Per-device HBM footprint of a ring with the given storage spec."""
+    """Per-device HBM footprint of a ring with the given storage spec.
+
+    ``sequence`` switches on the per-env-head sequence-ring accounting (the
+    Dreamer shape): beyond the flat storage rows, the footprint carries the
+    per-env write heads + the device train-key, the per-position window
+    validity working set the in-graph sampler materializes (a ``(capacity,
+    n_envs)`` mask/start table, int32), and — the part that actually bites
+    for pixel rings — the gathered ``(seq_len, batch)`` sample window each
+    gradient step materializes in f32 after the uint8 decode. Pass
+    ``{"seq_len": T, "batch_size": B}``; omitting it keeps the flat-row
+    estimate (the SAC shape).
+    """
     div = n_dev if shard_envs else 1
     total = 0
+    row_bytes_f32 = 0
     for _k, (shape, dtype) in specs.items():
-        total += capacity * (n_envs // div) * int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+        feat = int(np.prod(shape or (1,)))
+        total += capacity * (n_envs // div) * feat * np.dtype(dtype).itemsize
+        row_bytes_f32 += feat * 4
     if prioritized:
         total += 2 * sumtree.leaf_count(capacity * n_envs) * 4
+    if sequence is not None:
+        seq_len = int(sequence["seq_len"])
+        batch = int(sequence["batch_size"])
+        # per-env heads (pos + valid, int32) + the device train-key
+        total += n_envs * 2 * 4 + 8
+        # window-validity working set: (capacity, n_envs) int32 masks/starts
+        total += capacity * n_envs * 4
+        # the gathered sample window, f32 after the in-graph uint8 decode
+        total += seq_len * (batch // max(1, n_dev)) * row_bytes_f32
     return int(total)
 
 
@@ -83,6 +107,7 @@ def resolve_device_resident(
     hbm_budget_gb: float,
     prioritized: bool = False,
     allow_shard: bool = True,
+    sequence: Optional[Dict[str, int]] = None,
 ) -> Tuple[bool, bool, str]:
     """Spillover decision: ``(use_device, shard_envs, reason)``.
 
@@ -91,6 +116,12 @@ def resolve_device_resident(
     HBM budget; an explicit ``True`` that does not fit **degrades to the host
     (memmap-capable) path with a warning** instead of OOMing at allocation —
     capacities beyond HBM are exactly what the host tier is for.
+
+    ``sequence`` (``{"seq_len": T, "batch_size": B}``) switches the estimate
+    to the per-env-head sequence-ring shape — heads, validity working set
+    and the gathered f32 sample window, not just flat rows — so a Dreamer
+    ring that only fits as flat rows cannot sneak past the gate and OOM at
+    its first append (see :func:`estimate_ring_bytes`).
     """
     if isinstance(setting, str):
         setting = setting.strip().lower()
@@ -101,7 +132,7 @@ def resolve_device_resident(
         return False, False, "disabled by config"
     shard_envs = allow_shard and n_dev > 1 and n_envs % n_dev == 0 and not prioritized
     budget = float(hbm_budget_gb) * (1 << 30)
-    est = estimate_ring_bytes(specs, capacity, n_envs, n_dev, shard_envs, prioritized)
+    est = estimate_ring_bytes(specs, capacity, n_envs, n_dev, shard_envs, prioritized, sequence=sequence)
     if est <= budget:
         return True, shard_envs, f"ring fits HBM budget ({est / 2**20:.1f} MiB <= {hbm_budget_gb} GiB)"
     reason = (

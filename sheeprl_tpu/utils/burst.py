@@ -126,6 +126,11 @@ class HostSnapshot:
         self._unpack = jax.jit(lambda v: unravel(v.astype(jnp.float32)))
         self._slot: list = [None]
         self._refresh_thread: Optional[threading.Thread] = None
+        # supervised persistent refresh worker (attach_supervisor): the
+        # pending slot is newest-wins, the worker owns the blocking pull
+        self._pending: list = [None]
+        self._pending_lock = threading.Lock()
+        self._refresh_worker = None
 
     def pull(self, params: Any) -> Any:
         """Blocking pack → pull → unpack (initialization / trainer thread)."""
@@ -136,13 +141,55 @@ class HostSnapshot:
         blocking pull is fine there)."""
         self._slot[0] = jax.device_put(self._pack(params), self.host_device)
 
+    def attach_supervisor(self, supervisor, name: str = "snapshot-refresh") -> None:
+        """Run the device→host pulls on ONE persistent supervised worker
+        instead of one-shot raw daemon threads: a pull that dies
+        (``ThreadKilled`` chaos, a transport error) is restarted through the
+        supervisor's restart→degrade→abort ladder instead of silently
+        freezing the host policy snapshot at its last version. Crash-only
+        supervision (``lease_s=None``) — a device pull's duration is
+        unbounded on a tunneled chip."""
+        if self._refresh_worker is not None:
+            return
+        self._refresh_worker = supervisor.spawn(name=name, target=self._refresh_loop, lease_s=None)
+
+    def _refresh_loop(self, ctx) -> None:
+        import time as _time
+
+        from sheeprl_tpu.fault.inject import fault_point
+
+        while not ctx.cancelled:
+            with self._pending_lock:
+                packed = self._pending[0]
+            if packed is None:
+                _time.sleep(0.02)
+                continue
+            ctx.beat()
+            fault_point("burst.snapshot.refresh")  # chaos: kill-thread mid-pull
+            placed = jax.device_put(packed, self.host_device)
+            self._slot[0] = placed
+            with self._pending_lock:
+                # a crash before this point leaves the pending pull in place,
+                # so the restarted generation re-runs it (newest-wins: a
+                # fresher refresh_async may already have replaced it)
+                if self._pending[0] is packed:
+                    self._pending[0] = None
+
     def refresh_async(self, params: Any) -> bool:
-        """Kick off the device→host pull on a one-shot thread so the caller
-        never waits on the wire. Skipped (returns False) while a previous
-        pull is still in flight. Single-caller-thread contract: the
-        check-then-act on ``_refresh_thread`` is not locked, so exactly ONE
-        thread may call this per snapshot instance (the trainer thread in
-        the BurstRunner wiring)."""
+        """Kick off the device→host pull off-thread so the caller never
+        waits on the wire. Skipped (returns False) while a previous pull is
+        still in flight. With :meth:`attach_supervisor` the pull rides the
+        supervised refresh worker; otherwise a one-shot thread
+        (single-caller-thread contract: the check-then-act on
+        ``_refresh_thread`` is not locked, so exactly ONE thread may call
+        this per snapshot instance — the trainer thread in the BurstRunner
+        wiring)."""
+        if self._refresh_worker is not None:
+            with self._pending_lock:
+                if self._pending[0] is not None:
+                    return False
+                self._pending[0] = self._pack(params)
+            return True
         if self._refresh_thread is not None and self._refresh_thread.is_alive():
             return False
         packed = self._pack(params)
@@ -160,15 +207,29 @@ class HostSnapshot:
 
 
 class TrainerThread:
-    """Bounded-queue trainer thread: jobs go in, ``step_fn(carry, job)``
-    runs off the env loop, and the newest carry/metrics are readable at any
-    time. The queue bound is the backpressure (at most ``maxsize`` bursts in
-    flight). A ``step_fn`` exception parks the thread and resurfaces on the
-    next :meth:`submit`/:meth:`close`; the queue keeps draining so a full
-    ``put`` can never deadlock the env loop.
+    """Bounded-queue SUPERVISED trainer worker: jobs go in, ``step_fn(carry,
+    job)`` runs off the env loop, and the newest carry/metrics are readable
+    at any time. The queue bound is the backpressure (at most ``maxsize``
+    bursts in flight).
+
+    The worker runs under a :class:`~sheeprl_tpu.fault.supervisor.Supervisor`
+    (``fault.supervisor``-shaped ``supervisor_cfg``) with crash-only
+    supervision (``lease_s=None`` — a burst dispatch's duration is unbounded,
+    the same contract as the serve workers): a crash — including the
+    un-swallowable ``ThreadKilled`` chaos action, which the old raw daemon
+    thread died silently on — re-homes nothing (the carry lives in shared
+    state and was not advanced by the failed step) and re-dispatches the
+    in-flight job from the fresh generation; past the restart budget the
+    ladder degrades/aborts and the next :meth:`submit`/:meth:`check`
+    surfaces the typed supervision error instead of blocking the env loop
+    against a dead consumer forever. Note the retry re-submits the SAME job
+    against the SAME carry (``step_fn`` is functional over its carry), so a
+    restart never double-applies a burst.
 
     :class:`BurstRunner` composes this with ring staging; SAC's flat
-    transition ring drives it directly.
+    transition ring drives it directly. The snapshot refresh worker
+    (:meth:`HostSnapshot.attach_supervisor`) shares this supervisor via
+    :attr:`supervisor`.
     """
 
     def __init__(
@@ -177,14 +238,20 @@ class TrainerThread:
         carry: Any,
         on_step: Optional[Callable[[Any, Any], None]] = None,
         maxsize: int = 2,
+        supervisor_cfg: Optional[Dict[str, Any]] = None,
+        name: str = "burst-trainer",
     ) -> None:
+        from sheeprl_tpu.fault.supervisor import Supervisor
+
         self._step_fn = step_fn
         self._on_step = on_step
-        self._state = {"carry": carry, "metrics": None, "error": None}
+        self._state = {"carry": carry, "metrics": None}
         self._lock = threading.Lock()
         self._q: "_queue.Queue" = _queue.Queue(maxsize=maxsize)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        self._inflight: list = [None]  # job being (re)dispatched, survives a restart
+        self._done = threading.Event()
+        self.supervisor = Supervisor.from_config(supervisor_cfg or {}, name=name)
+        self.supervisor.spawn(name=name, target=self._worker, lease_s=None)
 
     @property
     def carry(self) -> Any:
@@ -196,38 +263,66 @@ class TrainerThread:
         with self._lock:
             return self._state["metrics"]
 
-    def raise_if_failed(self) -> None:
-        if self._state["error"] is not None:
-            raise self._state["error"]
+    def check(self) -> None:
+        """One supervision pass (restart due workers, escalate): raises the
+        typed supervision error once the ladder is exhausted."""
+        self.supervisor.check()
+
+    # old name, kept for symmetry with the pre-supervision API
+    raise_if_failed = check
 
     def submit(self, job: Any) -> None:
-        self.raise_if_failed()
-        self._q.put(job)
-
-    def _worker(self) -> None:
+        """Enqueue a burst job; back-pressure keeps driving supervision so a
+        dead/degraded trainer escalates instead of deadlocking the env loop
+        against a full queue nobody drains."""
         while True:
-            job = self._q.get()
-            if job is None:
-                return
+            self.check()
             try:
-                carry, metrics = self._step_fn(self._state["carry"], job)
-                with self._lock:
-                    self._state["carry"] = carry
-                    if metrics is not None:
-                        self._state["metrics"] = metrics
-                if self._on_step is not None:
-                    self._on_step(carry, metrics)
-            except Exception as exc:  # surfaced at the next submit/close
-                self._state["error"] = exc
-                while self._q.get() is not None:
-                    pass
+                self._q.put(job, timeout=0.2)
                 return
+            except _queue.Full:
+                continue
+
+    def _worker(self, ctx) -> None:
+        from sheeprl_tpu.fault.inject import fault_point
+
+        while not ctx.cancelled:
+            job = self._inflight[0]
+            if job is None:
+                try:
+                    job = self._q.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                if job is None:  # close() sentinel: drained, expected exit
+                    ctx.retire()
+                    self._done.set()
+                    return
+                self._inflight[0] = job
+            ctx.beat()
+            fault_point("burst.trainer.step")  # chaos: kill-thread mid-burst
+            carry, metrics = self._step_fn(self._state["carry"], job)
+            with self._lock:
+                self._state["carry"] = carry
+                if metrics is not None:
+                    self._state["metrics"] = metrics
+            self._inflight[0] = None
+            if self._on_step is not None:
+                self._on_step(carry, metrics)
 
     def close(self) -> Any:
-        self._q.put(None)
-        self._thread.join()
-        self.raise_if_failed()
-        # Joining the thread only drains the Python queue; the last dispatched
+        while True:  # a dead consumer + full queue must escalate, not block
+            self.check()
+            try:
+                self._q.put(None, timeout=0.2)
+                break
+            except _queue.Full:
+                continue
+        # drive supervision while draining: a crash mid-drain escalates (and
+        # its restart re-dispatches the in-flight job) instead of hanging here
+        while not self._done.wait(0.2):
+            self.check()
+        self.supervisor.join()
+        # Joining the worker only drains the Python queue; the last dispatched
         # burst may still be executing on-device (JAX dispatch is async).
         # Block so wall-clock accounting and post-run calibration probes see a
         # finished program, not our own in-flight work.
@@ -262,6 +357,7 @@ class BurstRunner:
         params_of: Callable[[Any], Any] = lambda carry: carry[0],
         stage_buckets: Optional[Tuple[int, ...]] = None,
         blob_layouts: Optional[Dict[int, "BlobLayout"]] = None,
+        supervisor_cfg: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._burst_fn = burst_fn
         self._layouts = blob_layouts
@@ -284,7 +380,11 @@ class BurstRunner:
         self.dev_valid = np.zeros(self._n_envs, np.int64)
         self._staged: list = []  # (data dict, env mask) per ring row
         self._bursts = 0  # trained bursts; worker-thread-only state
-        self._thread = TrainerThread(self._step, (carry, rb_dev))
+        self._thread = TrainerThread(self._step, (carry, rb_dev), supervisor_cfg=supervisor_cfg)
+        if snapshot is not None:
+            # the refresh pulls ride the trainer's supervisor: a dead pull is
+            # restarted, never a silently frozen host policy
+            snapshot.attach_supervisor(self._thread.supervisor)
 
     # -- ring-state restore (checkpoint resume) ------------------------------
     def set_ring_state(self, pos: np.ndarray, valid: np.ndarray) -> None:
@@ -506,6 +606,7 @@ class HybridPlayerHarness:
             params_of=params_of,
             stage_buckets=stage_buckets,
             blob_layouts=blob_layouts,
+            supervisor_cfg=(cfg.get("fault") or {}).get("supervisor"),
         )
         self.runner.set_ring_state(dev_pos, dev_valid)
 

@@ -1,0 +1,242 @@
+"""dreamer_sebulba end-to-end: async actor/learner dry runs through the real
+CLI (1/2 devices), the replay-ratio governor's measured grad-steps-per-env-
+step bound, the hard named error on an over-budget sequence ring, shared-
+layout evaluation from a checkpoint, and a checkpoint → SIGKILL →
+``resume_from=latest`` round trip restoring the ring (contents + per-env
+heads + device train-key), both host RNG streams, and the Ratio counters."""
+
+import ast
+import glob
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import sheeprl_tpu
+from sheeprl_tpu.cli import run
+
+REPO_ROOT = str(Path(sheeprl_tpu.__file__).parents[1])
+
+XS_MODEL = [
+    "algo=dreamer_v3_XS",
+    "algo.name=dreamer_sebulba",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=2",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.reward_model.bins=17",
+    "algo.critic.bins=17",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "env.screen_size=64",
+]
+
+SEBULBA_FAST = [
+    "exp=dreamer_sebulba",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "buffer.size=128",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    *XS_MODEL,
+    "algo.learning_starts=4",
+    "algo.total_steps=32",
+    "algo.sebulba.rollout_block=4",
+    "checkpoint.save_last=False",
+    "checkpoint.every=0",
+]
+
+
+def _ckpts(root):
+    return sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+
+
+def _stats(capfd):
+    out, _err = capfd.readouterr()
+    lines = [l for l in out.splitlines() if l.startswith("DREAMER_SEBULBA_STATS ")]
+    assert lines, f"no DREAMER_SEBULBA_STATS line in output:\n{out[-2000:]}"
+    return ast.literal_eval(lines[-1][len("DREAMER_SEBULBA_STATS "):])
+
+
+@pytest.fixture()
+def sebulba_debug(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_SEBULBA_DEBUG", "1")
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_dreamer_sebulba_dry_run(tmp_path, devices):
+    """devices=1 time-slices one chip between the actor and learner sides;
+    devices=2 splits them into disjoint single-device slices."""
+    run(SEBULBA_FAST + [f"fabric.devices={devices}", f"log_root={tmp_path}/logs"])
+
+
+def test_dreamer_sebulba_replay_ratio_governor(tmp_path, sebulba_debug, capfd):
+    """The governor must hold the ACHIEVED grad-steps-per-env-step at the
+    configured algo.replay_ratio (up to the prefill window and integer grant
+    quantization), decoupled from how fast the actors produce."""
+    ratio = 2.0
+    run(
+        SEBULBA_FAST
+        + [
+            "fabric.devices=1",
+            "env.num_envs=1",
+            f"algo.replay_ratio={ratio}",
+            "algo.learning_starts=8",
+            "algo.total_steps=64",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+    stats = _stats(capfd)
+    env_steps = stats["Pipeline/env_steps_consumed"]
+    grad_steps = stats["Pipeline/grad_steps"]
+    assert env_steps >= 64
+    expected = ratio * (env_steps - stats["prefill_policy_steps"])
+    assert abs(grad_steps - expected) <= ratio + 1, (grad_steps, expected, stats)
+    assert stats["Pipeline/replay_ratio_actual"] == pytest.approx(grad_steps / env_steps, abs=1e-3)
+
+
+def test_dreamer_sebulba_over_budget_ring_is_hard_named_error(tmp_path):
+    """The ring is this topology's ONLY storage tier: an over-budget SEQUENCE
+    ring (heads + validity working set + gathered sample window, not just
+    flat rows) must refuse at startup with a named error — never an OOM at
+    the first append, never a silent host spillover."""
+    with pytest.raises(RuntimeError, match="dreamer_sebulba streams sequence heads"):
+        run(
+            SEBULBA_FAST
+            + [
+                "fabric.devices=1",
+                "buffer.hbm_budget_gb=1e-9",
+                f"log_root={tmp_path}/logs",
+            ]
+        )
+
+
+def test_dreamer_sebulba_evaluation_from_checkpoint(tmp_path):
+    """dreamer_sebulba checkpoints share the dreamer family layout
+    (world_model/actor/critic/target_critic at top level): the shared
+    dreamer_v3 evaluate entrypoint loads them."""
+    from sheeprl_tpu.cli import evaluation
+
+    run(
+        SEBULBA_FAST[:-2]
+        + [
+            "fabric.devices=1",
+            "checkpoint.save_last=True",
+            "checkpoint.every=0",
+            f"log_root={tmp_path}/logs",
+        ]
+    )
+    ckpt = _ckpts(f"{tmp_path}/logs")[-1]
+    evaluation([f"checkpoint_path={ckpt}", "env.capture_video=False", "fabric.accelerator=cpu"])
+
+
+KILL_ARGS = [
+    "exp=dreamer_sebulba",
+    "env=dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "buffer.size=256",
+    "buffer.checkpoint=True",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    *XS_MODEL,
+    "algo.learning_starts=4",
+    "algo.total_steps=48",
+    "algo.sebulba.rollout_block=4",
+    "checkpoint.every=16",
+    "checkpoint.save_last=True",
+    "seed=11",
+    "log_root=logs",
+]
+
+
+def _launch(tmp_path, extra_args=(), extra_env=None):
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("SHEEPRL_FAULT_KILL", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", *KILL_ARGS, *extra_args],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+
+
+@pytest.mark.fault
+def test_dreamer_sebulba_checkpoint_kill_resume_from_latest(tmp_path):
+    """Checkpoint → SIGKILL mid-save → ``resume_from=latest``: counters
+    continue monotonically, BOTH host RNG streams and the Ratio state ride
+    the checkpoint, and the sequence ring (contents, per-env heads, device
+    train-key) is restored — proven by the final ring holding every consumed
+    row of the whole 48-row schedule, which only a restored ring can."""
+    proc = _launch(tmp_path, extra_env={"SHEEPRL_FAULT_KILL": "checkpoint.pre_commit:2"})
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    ckpt_dirs = glob.glob(
+        str(tmp_path / "logs/dreamer_sebulba/discrete_dummy/*/version_*/checkpoint")
+    )
+    assert len(ckpt_dirs) == 1
+    from sheeprl_tpu.fault.manager import latest_complete
+
+    first_complete = latest_complete(ckpt_dirs[0])
+    assert first_complete is not None and first_complete.name.startswith("ckpt_16")
+
+    proc2 = _launch(tmp_path, extra_args=["checkpoint.resume_from=latest"])
+    assert proc2.returncode == 0, (proc2.stdout[-2000:], proc2.stderr[-2000:])
+    assert "checkpoint.resume_from=latest ->" in proc2.stdout
+
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    final = find_latest_run_checkpoint(tmp_path / "logs/dreamer_sebulba/discrete_dummy")
+    state = load_state(final)
+    # counters continued monotonically to the full schedule
+    assert state["iter_num"] >= 48
+    assert int(os.path.basename(str(final)).split("_")[1]) >= 48
+    # both host RNG streams and the Ratio governor rode the checkpoint
+    assert state.get("rng") is not None and state.get("actor_rng") is not None
+    assert state["ratio"]["_prev"] is not None
+    import jax
+
+    for leaf in jax.tree.leaves(
+        {k: state[k] for k in ("world_model", "actor", "critic", "target_critic")}
+    ):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # ring state: every consumed regular row of the WHOLE run is in the ring
+    # (the two actors split them, so only the SUM across per-env heads is
+    # deterministic) — the resumed process must have restored the pre-kill
+    # rows, not re-allocated
+    rb = state["rb"][0] if isinstance(state["rb"], list) else state["rb"]
+    from sheeprl_tpu.replay import DeviceReplayState
+
+    assert isinstance(rb, DeviceReplayState) and rb.kind == "sequence"
+    assert int(np.asarray(rb.arrays["valid"]).sum()) >= 48
+    assert np.asarray(rb.arrays["pos"]).shape == (2,)  # one head per env column
+    # the in-ring device train-key stream advanced past its seed and was
+    # carried across the kill
+    import jax.random as jrandom
+
+    assert not np.array_equal(np.asarray(rb.arrays["key"]), np.asarray(jrandom.PRNGKey(11 + 31)))

@@ -285,6 +285,8 @@ def test_audit_cli_repo_tree_clean_gate():
         "ppo_anakin_pop.block", "sac.train_step", "sac.resident_step", "sac.rollout_step",
         "ppo_sebulba.train_step", "ppo_sebulba.gae", "ppo_sebulba.act", "ppo_sebulba.traj",
         "sac_sebulba.train_step", "sac_sebulba.act", "sac_sebulba.append",
+        "dreamer_v3.burst_step",
+        "dreamer_sebulba.train_step", "dreamer_sebulba.act", "dreamer_sebulba.append",
         "serve.bucket[1].greedy", "serve.bucket[8].greedy", "serve.bucket[8].sample",
     ):
         assert expected in measured, f"registered hot path {expected} missing from the audit"

@@ -197,6 +197,59 @@ def test_sac_sebulba_actor_restart_clean(tmp_path, trace_hygiene):
     assert report["sac_sebulba.act"]["compiles"] == 1, report["sac_sebulba.act"]
 
 
+DREAMER_SEB_FAST = [
+    "algo=dreamer_v3_XS",
+    "algo.name=dreamer_sebulba",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=2",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.reward_model.bins=17",
+    "algo.critic.bins=17",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "env.screen_size=64",
+]
+
+
+def test_dreamer_sebulba_steady_state_clean(tmp_path, trace_hygiene):
+    """Async DreamerV3 beyond warmup: a full multi-block run (many act
+    dispatches across 2 actor threads, several ragged append commits with
+    and without reset rows, several governed train scans) must report 0
+    post-warmup retraces on all three hot paths under the strict budget +
+    transfer guard — in particular, episode resets (the in-graph is_first
+    init merge) and ragged reset rows must never key fresh compiles."""
+    run(
+        _args(tmp_path, "dreamer_sebulba", extra=DREAMER_SEB_FAST)
+        + [
+            "dry_run=False",
+            "fabric.devices=1",
+            "buffer.size=256",
+            "algo.learning_starts=0",
+            "algo.total_steps=32",
+            "algo.sebulba.rollout_block=4",
+        ]
+    )
+    report = trace_hygiene.report()
+    assert report["dreamer_sebulba.act"]["calls"] >= 8
+    assert report["dreamer_sebulba.train_step"]["calls"] >= 2
+    _assert_quiet(
+        trace_hygiene,
+        ["dreamer_sebulba.train_step", "dreamer_sebulba.act", "dreamer_sebulba.append"],
+    )
+    # one abstract signature each: act across both actors and every reset
+    # pattern, append across every ragged mask, train across every grant
+    for name in ("dreamer_sebulba.act", "dreamer_sebulba.train_step", "dreamer_sebulba.append"):
+        assert report[name]["compiles"] == 1, (name, report[name])
+
+
 def test_serve_engine_hotpaths_clean(trace_hygiene):
     """The serving tier's hot paths: AOT bucket programs are compiled at
     construction, so arbitrary request shapes hammered through ``infer`` must

@@ -1,0 +1,97 @@
+"""dreamer_sebulba chaos e2e through the real CLI: an actor killed mid-run is
+restarted by the supervisor (fresh envs, zeroed policy carry re-initialized
+in-graph from a fresh snapshot) and the run completes with env/policy step
+counters EQUAL to its fault-free twin — the async-Dreamer analogue of the
+PR 10 sac_sebulba acceptance proof."""
+
+import ast
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.fault import inject
+
+pytestmark = pytest.mark.chaos
+
+# 3 actors over a small run: total_iters is a multiple of rollout_block, so
+# every consumed item carries exactly `block` regular rows and the final
+# counters are DETERMINISTIC — the fault-free twin and the chaos run must
+# land on identical policy_steps.
+DREAMER_CHAOS = [
+    "exp=dreamer_sebulba",
+    "env=dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "buffer.size=256",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo=dreamer_v3_XS",
+    "algo.name=dreamer_sebulba",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=2",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.reward_model.bins=17",
+    "algo.critic.bins=17",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "env.screen_size=64",
+    "algo.learning_starts=4",
+    "algo.total_steps=48",
+    "algo.sebulba.num_actor_threads=3",
+    "algo.sebulba.rollout_block=4",
+    "checkpoint.save_last=False",
+    "checkpoint.every=0",
+    "fabric.devices=1",
+    "fault.supervisor.backoff=0.0",
+    # raise-kill injection is what this lane proves; a generous lease keeps a
+    # slow-box cold compile from tripping hang detection in the CLEAN phase
+    "fault.supervisor.lease_s=240",
+]
+
+
+def _stats(capfd):
+    out, _err = capfd.readouterr()
+    lines = [l for l in out.splitlines() if l.startswith("DREAMER_SEBULBA_STATS ")]
+    assert lines, f"no DREAMER_SEBULBA_STATS line in output:\n{out[-2000:]}"
+    return ast.literal_eval(lines[-1][len("DREAMER_SEBULBA_STATS "):])
+
+
+@pytest.fixture()
+def sebulba_debug(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_SEBULBA_DEBUG", "1")
+
+
+def test_dreamer_sebulba_actor_killed_midrun_restarts_and_counters_match(
+    tmp_path, sebulba_debug, capfd
+):
+    """Acceptance proof: lose 1 of 3 actors mid-run -> the supervisor
+    restarts it on fresh envs, the run completes, final env/policy step
+    counters EQUAL the fault-free twin's, and Pipeline/actor_deaths ==
+    injected kills."""
+    run(DREAMER_CHAOS + [f"log_root={tmp_path}/logs/clean"])
+    clean = _stats(capfd)
+    assert clean["Pipeline/actor_deaths"] == 0
+    assert clean["Pipeline/actors_live"] == 3
+
+    inject.arm("dreamer_sebulba.actor1.step", action="raise", at=10)
+    try:
+        with pytest.warns(UserWarning, match="dreamer-sebulba-actor-1.*restarting"):
+            run(DREAMER_CHAOS + [f"log_root={tmp_path}/logs/chaos"])
+    finally:
+        inject.reset()
+    chaos = _stats(capfd)
+    assert chaos["Pipeline/actor_deaths"] == 1  # == injected kills
+    assert chaos["Pipeline/actor_restarts"] == 1
+    assert chaos["Pipeline/actors_live"] == 3  # restarted, not degraded
+    assert chaos["policy_steps"] == clean["policy_steps"]  # counters monotone AND equal
+    assert chaos["Pipeline/env_steps_consumed"] == clean["Pipeline/env_steps_consumed"]
