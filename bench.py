@@ -30,6 +30,10 @@ matrix read the registry, nothing is hand-enumerated:
   throughput at fixed offered loads, AOT bucketed engine
   (``BENCH_SERVE_MODE=aot``) vs naive per-request jit dispatch (``naive``),
   one hot weight swap per load (howto/serving.md; benchmarks/serve_bench.py);
+- ``serve_fleet`` — replicated serving: N replica processes behind the
+  FleetRouter vs a single replica on identical offered load, one replica
+  SIGKILL per fleet rep, ``dropped == 0`` asserted in-lane
+  (howto/serving.md; benchmarks/serve_fleet_bench.py);
 - ``population`` — P-member population training on the Anakin path:
   ``BENCH_POP_MODE=vmapped`` trains all P members in ONE jitted dispatch
   (``exp=ppo_anakin_population_benchmarks``) vs ``sequential`` = P
@@ -337,6 +341,19 @@ def _lane_serve() -> None:
     from serve_bench import main as serve_main
 
     serve_main()
+
+
+@lane("serve_fleet", "fleet", "serve_fleet_requests_per_sec")
+def _lane_serve_fleet() -> None:
+    # Replicated-serving SLO lane: fleet (N=BENCH_FLEET_REPLICAS replica
+    # PROCESSES behind the FleetRouter) vs single replica behind the same
+    # router on identical offered load, with one replica SIGKILL per fleet
+    # rep and dropped == 0 / errors == 0 asserted in-lane. Knobs in
+    # benchmarks/serve_fleet_bench.py, interpretation in howto/serving.md.
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from serve_fleet_bench import main as fleet_main
+
+    fleet_main()
 
 
 @lane("serve_sessions", "sessions", "ppo_recurrent_serve_session_steps_per_sec")

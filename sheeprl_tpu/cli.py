@@ -6,6 +6,9 @@ Verbs mirror the reference console scripts:
 - ``sheeprl_tpu eval checkpoint_path=...`` — evaluate a checkpoint;
 - ``sheeprl_tpu serve checkpoint_path=...`` — serve a checkpoint behind the
   continuous-batching inference tier (howto/serving.md);
+- ``sheeprl_tpu serve --fleet N ...`` / ``sheeprl_tpu serve_fleet ...`` —
+  serve from N supervised replica processes behind the FleetRouter front
+  end (howto/serving.md#the-serve-fleet);
 - ``sheeprl_tpu agents`` — list registered algorithms;
 - ``sheeprl_tpu registration ...`` — MLflow model registration (optional dep).
 
@@ -21,7 +24,7 @@ import pathlib
 import sys
 import warnings
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from sheeprl_tpu.config import ConfigError, DotDict, compose, dotdict, load_yaml
 from sheeprl_tpu.utils.registry import (
@@ -36,6 +39,7 @@ __all__ = [
     "run",
     "evaluation",
     "serve",
+    "serve_fleet",
     "registration",
     "available_agents",
     "main",
@@ -262,7 +266,10 @@ def run_algorithm(cfg: DotDict) -> None:
     from sheeprl_tpu.parallel.distributed import maybe_init
     from sheeprl_tpu.utils.callback import CheckpointCallback
 
-    maybe_init()
+    # multi-host bring-up BEFORE the fabric builds its mesh: config-driven
+    # (fabric.distributed.*) with the SHEEPRL_* env vars as the pod
+    # runtime's per-host override
+    maybe_init(cfg.fabric.get("distributed"))
     callbacks = []
     for cb_spec in cfg.fabric.get("callbacks") or []:
         target = cb_spec.get("_target_", "") if isinstance(cb_spec, dict) else ""
@@ -312,12 +319,17 @@ def serve_algorithm(cfg: DotDict) -> None:
     the algorithm's *policy builder* and hands off to the continuous-batching
     server instead of the offline test loop."""
     from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.parallel.distributed import maybe_init
     from sheeprl_tpu.serve.server import serve_policy
     from sheeprl_tpu.utils.checkpoint import load_state
     from sheeprl_tpu.utils.registry import registered_policy_builder_names, resolve_policy_builder
     from sheeprl_tpu.utils.utils import pin_cpu_platform
 
     pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
+    # serve joins the same multi-host bring-up contract as train: a serve
+    # replica launched by a pod runtime initializes jax.distributed from the
+    # identical fabric.distributed.* / SHEEPRL_* knobs
+    maybe_init(cfg.get("fabric", {}).get("distributed"))
 
     fabric = Fabric(
         devices=1,
@@ -337,22 +349,83 @@ def serve_algorithm(cfg: DotDict) -> None:
     fabric.launch(serve_policy, cfg, state, builder)
 
 
-def serve(args: Optional[List[str]] = None) -> None:
+def _extract_fleet_flag(args: List[str]) -> Tuple[List[str], Optional[int]]:
+    """Pull ``--fleet [N]`` / ``--fleet=N`` out of hydra-style args; returns
+    (remaining args, replica count or None). Bare ``--fleet`` means 3."""
+    out: List[str] = []
+    fleet: Optional[int] = None
+    i = 0
+    while i < len(args):
+        tok = args[i]
+        if tok == "--fleet":
+            if i + 1 < len(args) and args[i + 1].isdigit():
+                fleet = int(args[i + 1])
+                i += 2
+            else:
+                fleet = 3
+                i += 1
+            continue
+        if tok.startswith("--fleet="):
+            fleet = int(tok.split("=", 1)[1])
+            i += 1
+            continue
+        out.append(tok)
+        i += 1
+    return out, fleet
+
+
+def serve(args: Optional[List[str]] = None, fleet: Optional[int] = None, require_fleet: bool = False) -> None:
     """Serve a checkpoint behind the continuous-batching inference tier
     (``sheeprl_tpu serve checkpoint_path=... [serve.buckets=[1,8,32] ...]``).
     Shares :func:`find_run_config` discovery and the config-merge shape with
-    :func:`evaluation`."""
+    :func:`evaluation`.
+
+    ``--fleet N`` (or ``serve.fleet.replicas=N``, or the ``serve_fleet``
+    verb) serves the checkpoint from N supervised replica PROCESSES behind
+    the :class:`~sheeprl_tpu.serve.fleet.FleetRouter` front end instead of
+    one in-process server (howto/serving.md#the-serve-fleet)."""
     args = list(sys.argv[1:] if args is None else args)
+    args, flag_fleet = _extract_fleet_flag(args)
+    fleet = flag_fleet if flag_fleet is not None else fleet
     serve_cfg = compose(args, config_name="serve_config")
     if not serve_cfg.get("checkpoint_path"):
         raise ValueError("You must specify the checkpoint path to serve")
+    if fleet is not None:
+        serve_cfg.serve.fleet.replicas = int(fleet)
     merged = _merged_ckpt_cfg(
         serve_cfg,
         "serve",
         capture_video=False,
         extra={"serve": dict(serve_cfg.get("serve", {}))},
     )
+    replicas = int(((merged.get("serve") or {}).get("fleet") or {}).get("replicas", 0) or 0)
+    if (require_fleet or flag_fleet is not None) and replicas < 2:
+        # an operator who asked for a FLEET must get one or a loud error —
+        # silently falling back to a single unsupervised server would deploy
+        # without any of the fleet's fault tolerance
+        raise ValueError(
+            f"fleet serving needs serve.fleet.replicas >= 2, got {replicas} — "
+            "drop the fleet flag/verb for a single-process server"
+        )
+    if replicas >= 2:
+        from sheeprl_tpu.parallel.distributed import maybe_init
+        from sheeprl_tpu.serve.fleet import serve_fleet as serve_fleet_body
+        from sheeprl_tpu.utils.utils import pin_cpu_platform
+
+        pin_cpu_platform(merged.get("fabric", {}).get("accelerator", "auto"))
+        maybe_init(merged.get("fabric", {}).get("distributed"))
+        serve_fleet_body(merged)
+        return
     serve_algorithm(merged)
+
+
+def serve_fleet(args: Optional[List[str]] = None) -> None:
+    """Fleet serving verb: ``sheeprl_tpu serve_fleet checkpoint_path=...``
+    is ``serve --fleet N`` with N from ``serve.fleet.replicas`` (>= 2
+    enforced; unset defaults to 3)."""
+    args = list(sys.argv[1:] if args is None else args)
+    has_replicas = any(a.startswith("serve.fleet.replicas=") for a in args)
+    serve(args, fleet=None if has_replicas else 3, require_fleet=True)
 
 
 def available_agents() -> None:
@@ -480,7 +553,7 @@ def registration(args: Optional[List[str]] = None) -> None:
 def main() -> None:
     """Entry: dispatch on first positional verb."""
     argv = sys.argv[1:]
-    if argv and argv[0] in ("run", "eval", "evaluation", "serve", "agents", "registration"):
+    if argv and argv[0] in ("run", "eval", "evaluation", "serve", "serve_fleet", "agents", "registration"):
         verb, rest = argv[0], argv[1:]
     else:
         verb, rest = "run", argv
@@ -490,6 +563,8 @@ def main() -> None:
         evaluation(rest)
     elif verb == "serve":
         serve(rest)
+    elif verb == "serve_fleet":
+        serve_fleet(rest)
     elif verb == "agents":
         available_agents()
     elif verb == "registration":

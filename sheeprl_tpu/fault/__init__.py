@@ -6,7 +6,9 @@ jittable finite guard (:mod:`~sheeprl_tpu.fault.sentinel`), self-healing
 vector-env workers (:mod:`~sheeprl_tpu.fault.watchdog`), the thread
 supervision runtime for the async tiers — heartbeat leases, bounded
 restarts, restart→degrade→abort escalation
-(:mod:`~sheeprl_tpu.fault.supervisor`) — and the deterministic
+(:mod:`~sheeprl_tpu.fault.supervisor`) — its PROCESS twin for serve-fleet
+replicas with health-probe liveness leases and SIGKILL-vs-hang detection
+(:mod:`~sheeprl_tpu.fault.procsup`), and the deterministic
 fault/chaos-injection harness that keeps all of it tested
 (:mod:`~sheeprl_tpu.fault.inject`). See ``howto/fault_tolerance.md``.
 """
@@ -26,6 +28,7 @@ from sheeprl_tpu.fault.manager import (
     load_resume_state,
     read_manifest,
 )
+from sheeprl_tpu.fault.procsup import ProcessHungError, ProcessSupervisor, ReplicaHandle
 from sheeprl_tpu.fault.sentinel import DivergenceError, DivergenceSentinel
 from sheeprl_tpu.fault.supervisor import (
     AllWorkersDeadError,
@@ -49,6 +52,9 @@ __all__ = [
     "FlakyEnv",
     "HungWorkerError",
     "NaNInjector",
+    "ProcessHungError",
+    "ProcessSupervisor",
+    "ReplicaHandle",
     "SelfHealingEnv",
     "SupervisionError",
     "Supervisor",

@@ -16,7 +16,11 @@ builders round out the toolbox:
   Exception`` recovery can't swallow it, only the supervision layer sees the
   death) and ``hang`` stalls the calling thread for ``hang_s`` seconds
   (releasable via :func:`release_hangs`) so heartbeat-lease expiry and
-  queue-stall paths are provable;
+  queue-stall paths are provable; the PROCESS tier (graft-fleet) adds
+  ``kill-replica`` (SIGKILL one live replica subprocess) and
+  ``hang-replica`` (SIGSTOP — alive but unresponsive, the probe-lease-expiry
+  model), dispatched through the handlers the fleet router registers via
+  :func:`set_replica_chaos`;
 - ``arm_from_cfg(cfg)`` — arm a whole CHAOS SCHEDULE from
   ``cfg.fault.chaos``: ``events`` are ``"point:action:at[:hang_s]"`` specs
   where ``at`` may be a literal hit number or a ``"lo-hi"`` range drawn from
@@ -55,6 +59,7 @@ __all__ = [
     "disarm",
     "reset",
     "release_hangs",
+    "set_replica_chaos",
     "truncate_file",
     "scramble_file",
     "corrupt_checkpoint_arrays",
@@ -67,11 +72,16 @@ KILL_ENV_VAR = "SHEEPRL_FAULT_KILL"
 ARM_ENV_VAR = "SHEEPRL_FAULT_ARM"
 NAN_ENV_VAR = "SHEEPRL_FAULT_NAN_AT"
 
-_ACTIONS = ("raise", "kill", "kill-thread", "hang")
+_ACTIONS = ("raise", "kill", "kill-thread", "hang", "kill-replica", "hang-replica")
 
 _counts: Dict[str, int] = {}
 _armed: Dict[str, Tuple[str, int, float]] = {}  # point -> (action, Nth-hit, hang_s)
 _hang_release = threading.Event()
+# process-tier chaos (graft-fleet): the fleet router registers callables that
+# SIGKILL / wedge one of its replica subprocesses; the "kill-replica" /
+# "hang-replica" actions dispatch to them. Armed from the same seeded
+# fault.chaos.events schedule as every other point.
+_replica_chaos: Dict[str, Optional[Any]] = {"kill": None, "hang": None}
 
 
 class FaultInjected(RuntimeError):
@@ -108,6 +118,16 @@ def disarm(point: Optional[str] = None) -> None:
         _armed.pop(point, None)
 
 
+def set_replica_chaos(kill: Optional[Any] = None, hang: Optional[Any] = None) -> None:
+    """Register the process-tier chaos handlers (the fleet router does this
+    at start): ``kill()`` SIGKILLs one live replica subprocess, ``hang()``
+    wedges one (SIGSTOP — alive but unresponsive, the lease-expiry model).
+    The ``kill-replica`` / ``hang-replica`` actions dispatch here; unarmed or
+    unregistered they are no-ops. Cleared by :func:`reset`."""
+    _replica_chaos["kill"] = kill
+    _replica_chaos["hang"] = hang
+
+
 def release_hangs() -> None:
     """Wake every thread currently stalled in a ``hang`` fault point (and any
     future one until the next :func:`reset`) — test teardown's escape hatch."""
@@ -119,6 +139,8 @@ def reset() -> None:
     global _hang_release
     _armed.clear()
     _counts.clear()
+    _replica_chaos["kill"] = None
+    _replica_chaos["hang"] = None
     _hang_release.set()  # release any thread still stalled in a hang
     _hang_release = threading.Event()
 
@@ -191,6 +213,14 @@ def fault_point(point: str) -> None:
         return
     if action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)  # the preemption model: no cleanup
+    if action in ("kill-replica", "hang-replica"):
+        # process-tier chaos: dispatch to the fleet-registered handler; the
+        # CALLING thread (the router's poll loop) keeps running — the drill
+        # is that the fleet survives, not that the caller dies
+        handler = _replica_chaos.get(action.split("-", 1)[0])
+        if handler is not None:
+            handler()
+        return
     if action == "hang":
         # stall (lease expiry / queue stall), then RETURN: the woken thread
         # proceeds and must notice its supervision verdict (ctx.cancelled)

@@ -1,0 +1,468 @@
+"""Process supervision runtime: the subprocess twin of :mod:`.supervisor`.
+
+PR 10's :class:`~sheeprl_tpu.fault.supervisor.Supervisor` brought every async
+*thread* in the tree under heartbeat leases and a ``restart → degrade →
+abort`` escalation ladder. A production serve fleet is the same problem one
+level up: N ``PolicyServer`` REPLICA PROCESSES where whole-process death
+(OOM-kill, spot preemption, a segfault in a native library) and wedged
+replicas (stuck in a syscall, SIGSTOPped, live-locked) are routine operating
+conditions — Sample Factory (arXiv 2006.11751) treats worker death and
+stalls as normal events to be survived, and Podracer (arXiv 2104.06272)
+shapes the multi-replica pod topology. :class:`ProcessSupervisor` is the
+thread supervisor's semantics transplanted onto ``subprocess.Popen``:
+
+- **heartbeat = health-probe liveness.** A thread beats from inside its own
+  loop; a process cannot be trusted to (a wedged replica's heartbeat thread
+  may still run). Instead the OWNER (the fleet router's health poll loop)
+  calls :meth:`ProcessSupervisor.beat` whenever a replica answers its
+  ``{"health": true}`` probe — silence past the lease means the replica is
+  HUNG even though the process is alive.
+- **SIGKILL detection distinct from hang detection.** ``proc.poll()``
+  returning ``-9`` is an external kill (preemption, the OOM killer, a chaos
+  drill) and counts in ``kills``; a lease expiry with the process still
+  alive counts in ``hangs`` and the supervisor SIGKILLs the wedged process
+  itself before respawning (a hung native call cannot be preempted any other
+  way — the watchdog model, one level up).
+- **the same ladder and knob shape.** ``restart`` / ``degrade`` / ``abort``
+  with ``max_restarts`` + exponential ``backoff``, configured from
+  ``serve.fleet.{lease_s,grace_s,max_restarts,backoff,escalation}`` —
+  the same shape as ``fault.supervisor`` — and raising the SAME typed errors
+  (:class:`~sheeprl_tpu.fault.supervisor.WorkerAbortError`,
+  :class:`~sheeprl_tpu.fault.supervisor.AllWorkersDeadError`), so fleet-level
+  failures surface through one error vocabulary.
+- **restart = respawn on the same checkpoint dir.** ``spawn_fn`` re-runs the
+  replica's launch command verbatim; the replica's own
+  :class:`~sheeprl_tpu.serve.weights.CheckpointWatcher` (started with
+  ``publish_current``) re-publishes the newest complete save, so a respawn
+  lands on the freshest weights without any state shipped across the
+  process boundary. ``on_restart`` runs first (the router re-homes the dead
+  replica's sessions there).
+
+Shutdown is :meth:`terminate_all`: SIGTERM every replica (the PR 10 graceful
+drain contract — stop accepting, settle admitted requests, exit 0), wait out
+a grace budget, SIGKILL the stragglers BY NAME.
+
+Detection runs wherever the owner calls :meth:`check` — nothing happens
+between checks, which keeps the runtime deterministic enough to chaos-test
+(``tests/test_fault/test_procsup.py`` and the fleet drill in
+``tests/test_serve/test_fleet_chaos.py``).
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional
+
+from sheeprl_tpu.fault.supervisor import (
+    AllWorkersDeadError,
+    SupervisionError,
+    WorkerAbortError,
+)
+
+__all__ = ["ProcessSupervisor", "ReplicaHandle", "ProcessHungError"]
+
+_ESCALATIONS = ("restart", "degrade", "abort")
+
+# replica states (same vocabulary as the thread supervisor)
+_RUNNING = "running"
+_BACKOFF = "backoff"  # dead, respawn scheduled (exponential backoff pending)
+_DEGRADED = "degraded"  # budget exhausted, dropped from the fleet
+_STOPPED = "stopped"  # exited after a stop request (normal shutdown)
+
+
+class ProcessHungError(SupervisionError):
+    """A replica's health-probe lease expired while its process was alive."""
+
+
+class ReplicaHandle:
+    """One supervised replica process: current Popen/generation + counters."""
+
+    def __init__(
+        self,
+        supervisor: "ProcessSupervisor",
+        name: str,
+        spawn_fn: Callable[[], subprocess.Popen],
+        on_restart: Optional[Callable[[str], None]],
+        lease_s: Optional[float],
+    ) -> None:
+        self.supervisor = supervisor
+        self.name = name
+        self.spawn_fn = spawn_fn
+        self.on_restart = on_restart
+        self.lease_s = lease_s
+        self.state = _RUNNING
+        self.retired = False  # owner-side: no further respawns
+        self.generation = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.deaths = 0
+        self.hangs = 0  # lease expiries (process alive but unresponsive)
+        self.kills = 0  # external killed-by-signal deaths (rc < 0), SIGKILL incl.
+        self.last_rc: Optional[int] = None
+        self.last_signal: Optional[str] = None
+        self.last_error: Optional[str] = None
+        self._deadline = float("inf")
+        self._not_before = 0.0  # backoff gate for the next respawn
+
+    # -- heartbeat (health-probe liveness) ------------------------------------
+    def _beat(self) -> None:
+        # Unlike the thread supervisor's monotone-max beat, a probe success
+        # here PROVES startup is over (the socket answered — imports and AOT
+        # compiles are behind it), so it collapses the spawn grace down to
+        # the steady lease: a replica that goes silent right after becoming
+        # ready is detected within lease_s, not within the grace window.
+        if self.lease_s is not None and self.state == _RUNNING:
+            self._deadline = self.supervisor._clock() + self.lease_s
+
+    def _arm_lease(self, now: float) -> None:
+        if self.lease_s is None:
+            self._deadline = float("inf")
+        else:
+            # spawn grace: a fresh replica pays imports + AOT compiles before
+            # its socket (and therefore its first probe success) exists
+            self._deadline = now + max(self.lease_s, self.supervisor.grace_s)
+
+    # -- introspection --------------------------------------------------------
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def is_alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def live(self) -> bool:
+        """Running-or-coming-back — the router-facing liveness verdict (a
+        replica in restart backoff counts as live, it will be back)."""
+        with self.supervisor._lock:
+            return self.state == _BACKOFF or (self.state == _RUNNING and self.is_alive())
+
+    def retire(self) -> None:
+        """Owner-side: stop supervising this replica — no further respawns.
+        Call before a deliberate stop so a death racing shutdown is read as
+        stopped, not crashed-and-respawnable."""
+        with self.supervisor._lock:
+            self.retired = True
+            if self.state == _BACKOFF or (self.state == _RUNNING and not self.is_alive()):
+                self.state = _STOPPED
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "alive": self.is_alive(),
+            "pid": self.pid(),
+            "generation": self.generation,
+            "restarts": self.restarts,
+            "deaths": self.deaths,
+            "hangs": self.hangs,
+            "kills": self.kills,
+            "last_rc": self.last_rc,
+            "last_signal": self.last_signal,
+            "last_error": self.last_error,
+        }
+
+
+class ProcessSupervisor:
+    """Supervise a fleet of replica subprocesses (see module docstring).
+
+    The owner drives the engine: :meth:`beat` on every successful health
+    probe, :meth:`check` on its poll cadence. ``check`` detects deaths
+    (``proc.poll()``), hangs (lease expiry with the process alive → SIGKILL
+    the wedged process), runs due respawns, and escalates per the policy.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_restarts: int = 3,
+        backoff: float = 0.5,
+        escalation: str = "degrade",
+        lease_s: Optional[float] = 15.0,
+        grace_s: float = 120.0,
+        join_s: float = 30.0,
+        name: str = "fleet",
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        escalation = str(escalation).lower()
+        if escalation not in _ESCALATIONS:
+            raise ValueError(f"Unknown serve.fleet.escalation '{escalation}' ({'|'.join(_ESCALATIONS)})")
+        self.max_restarts = max(0, int(max_restarts))
+        self.backoff = max(0.0, float(backoff))
+        self.escalation = escalation
+        self.lease_s = float(lease_s) if lease_s else None
+        self.grace_s = max(0.0, float(grace_s))
+        self.join_s = max(0.0, float(join_s))
+        self.name = name
+        self._clock = clock
+        self.stopping = False
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, ReplicaHandle] = {}
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]] = None, **defaults: Any) -> "ProcessSupervisor":
+        """Build from a ``serve.fleet``-shaped mapping (``lease_s``,
+        ``grace_s``, ``max_restarts``, ``backoff``, ``escalation``,
+        ``join_s``); ``defaults`` override the class defaults but lose to
+        explicit config keys — the same merge contract as
+        :meth:`~sheeprl_tpu.fault.supervisor.Supervisor.from_config`."""
+        cfg = dict(cfg or {})
+        merged: Dict[str, Any] = {}
+        for key in ("max_restarts", "backoff", "escalation", "lease_s", "grace_s", "join_s", "name"):
+            if cfg.get(key) is not None:
+                merged[key] = cfg[key]
+            elif key in defaults:
+                merged[key] = defaults[key]
+        if "lease_s" in cfg and not cfg["lease_s"]:  # explicit null/0 disables hang detection
+            merged["lease_s"] = None
+        return cls(**merged)
+
+    # -- fleet management -----------------------------------------------------
+    def spawn(
+        self,
+        name: str,
+        spawn_fn: Callable[[], subprocess.Popen],
+        on_restart: Optional[Callable[[str], None]] = None,
+        lease_s: "float | None | str" = "default",
+    ) -> ReplicaHandle:
+        """Launch and start supervising ``spawn_fn()``'s process.
+
+        ``on_restart(name)`` runs before every respawn (the router re-homes
+        the dead replica's sessions there). ``lease_s="default"`` inherits
+        the supervisor's lease; ``None`` disables hang detection for this
+        replica (crash-only supervision)."""
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica '{name}' is already supervised")
+            lease = self.lease_s if lease_s == "default" else (float(lease_s) if lease_s else None)
+            handle = ReplicaHandle(self, name, spawn_fn, on_restart, lease)
+            self._replicas[name] = handle
+            self._launch(handle)
+            return handle
+
+    def replica(self, name: str) -> ReplicaHandle:
+        with self._lock:
+            return self._replicas[name]
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def beat(self, name: str) -> None:
+        """Record a successful health probe for ``name`` — renews its
+        liveness lease. Call from the owner's poll loop."""
+        with self._lock:
+            handle = self._replicas.get(name)
+            if handle is not None:
+                handle._beat()
+
+    def _launch(self, handle: ReplicaHandle) -> None:
+        handle.generation += 1
+        handle.state = _RUNNING
+        handle._arm_lease(self._clock())
+        handle.proc = handle.spawn_fn()
+
+    # -- the engine -----------------------------------------------------------
+    def check(self) -> None:
+        """One supervision pass: detect dead/hung replicas, run due
+        respawns, escalate. Raises :class:`WorkerAbortError` /
+        :class:`AllWorkersDeadError` per the policy; owners that must not
+        die catch and surface through their health probe."""
+        if self.stopping:
+            return
+        now = self._clock()
+        hang_victims: List[ReplicaHandle] = []
+        with self._lock:
+            for handle in self._replicas.values():
+                if handle.state != _RUNNING or handle.proc is None:
+                    continue
+                rc = handle.proc.poll()
+                if rc is not None:
+                    # DEATH. rc < 0 is killed-by-signal — SIGKILL (preemption /
+                    # OOM / chaos) is detected as such, distinct from a hang.
+                    handle.last_rc = rc
+                    if rc < 0:
+                        handle.kills += 1
+                        try:
+                            handle.last_signal = signal.Signals(-rc).name
+                        except ValueError:
+                            handle.last_signal = f"signal {-rc}"
+                        what = f"killed by {handle.last_signal}"
+                    else:
+                        handle.last_signal = None
+                        what = f"exited rc={rc}"
+                    self._on_death(handle, what, hang=False, now=now)
+                elif now > handle._deadline:
+                    # HANG: the process is alive but has not answered a health
+                    # probe inside its lease. Only SIGKILL can preempt a
+                    # wedged process — but the kill (and especially the reap
+                    # wait) must run OUTSIDE the lock: every beat() and
+                    # snapshot() (= every router health response) blocks on
+                    # it otherwise, exactly when the fleet is busiest.
+                    handle.hangs += 1
+                    handle._deadline = float("inf")  # claimed: no double-handling
+                    hang_victims.append(handle)
+        for handle in hang_victims:
+            try:
+                handle.proc.kill()
+                handle.proc.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):  # already gone / unkillable
+                pass
+        with self._lock:
+            for handle in hang_victims:
+                if handle.state != _RUNNING:  # stopped/retired while we killed
+                    continue
+                handle.last_rc = handle.proc.poll()
+                handle.last_signal = None
+                self._on_death(
+                    handle,
+                    f"hung: missed its {handle.lease_s:g}s health-probe lease (SIGKILLed generation "
+                    f"{handle.generation})",
+                    hang=True,
+                    now=now,
+                )
+            # second sweep: run respawns that are DUE — including a zero-
+            # backoff respawn of a death detected in this same pass
+            for handle in self._replicas.values():
+                if handle.retired:
+                    if handle.state == _BACKOFF:
+                        handle.state = _STOPPED  # owner stopped it: never respawn
+                elif handle.state == _BACKOFF and now >= handle._not_before:
+                    self._respawn(handle)
+            live = sum(1 for h in self._replicas.values() if h.state in (_RUNNING, _BACKOFF))
+            dead = {
+                name: RuntimeError(h.last_error or "replica dead")
+                for name, h in self._replicas.items()
+                if h.state == _DEGRADED
+            }
+            if live == 0 and dead:
+                raise AllWorkersDeadError(dead)
+
+    def _on_death(self, handle: ReplicaHandle, what: str, hang: bool, now: float) -> None:
+        if self.stopping or handle.retired:
+            handle.state = _STOPPED
+            return
+        handle.deaths += 1
+        handle.last_error = what
+        if self.escalation == "restart" or handle.restarts < self.max_restarts:
+            delay = self.backoff * (2.0 ** handle.restarts)
+            handle.state = _BACKOFF
+            handle._not_before = now + delay
+            warnings.warn(
+                f"[{self.name}] replica '{handle.name}' {what} — respawning in {delay:g}s "
+                f"(restart {handle.restarts + 1}"
+                + ("" if self.escalation == "restart" else f"/{self.max_restarts}")
+                + ")"
+            )
+        elif self.escalation == "degrade":
+            handle.state = _DEGRADED
+            warnings.warn(
+                f"[{self.name}] replica '{handle.name}' {what} after {handle.restarts} restart(s) — "
+                "DEGRADED: continuing on the surviving replicas"
+            )
+        else:  # abort
+            handle.state = _DEGRADED
+            raise WorkerAbortError(handle.name, RuntimeError(what))
+
+    def _respawn(self, handle: ReplicaHandle) -> None:
+        handle.restarts += 1
+        if handle.on_restart is not None:
+            try:
+                handle.on_restart(handle.name)
+            except Exception as e:  # re-homing failed: count as another death
+                handle.state = _RUNNING
+                self._on_death(handle, f"on_restart hook failed: {type(e).__name__}: {e}", hang=False, now=self._clock())
+                return
+        try:
+            self._launch(handle)
+        except Exception as e:  # spawn itself failed (port race, exec error)
+            handle.state = _RUNNING
+            self._on_death(handle, f"respawn failed: {type(e).__name__}: {e}", hang=False, now=self._clock())
+
+    # -- introspection / metrics ----------------------------------------------
+    def alive_count(self) -> int:
+        """Replicas currently running or pending a scheduled respawn."""
+        with self._lock:
+            return sum(1 for h in self._replicas.values() if h.state in (_RUNNING, _BACKOFF))
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {name: h.info() for name, h in self._replicas.items()}
+
+    def metrics(self, prefix: str = "Fleet/", noun: str = "replica") -> Dict[str, float]:
+        with self._lock:
+            deaths = sum(h.deaths for h in self._replicas.values())
+            restarts = sum(h.restarts for h in self._replicas.values())
+            hangs = sum(h.hangs for h in self._replicas.values())
+            kills = sum(h.kills for h in self._replicas.values())
+            live = sum(1 for h in self._replicas.values() if h.state in (_RUNNING, _BACKOFF))
+            degraded = sum(1 for h in self._replicas.values() if h.state == _DEGRADED)
+        return {
+            f"{prefix}{noun}_deaths": deaths,
+            f"{prefix}{noun}_restarts": restarts,
+            f"{prefix}{noun}_hangs": hangs,
+            f"{prefix}{noun}_kills": kills,
+            f"{prefix}{noun}s_live": live,
+            f"{prefix}{noun}s_degraded": degraded,
+        }
+
+    def describe(self) -> str:
+        """One-line-per-replica diagnostics."""
+        now = self._clock()
+        lines = []
+        with self._lock:
+            for name, h in self._replicas.items():
+                lease = "-" if h._deadline == float("inf") else f"{h._deadline - now:+.1f}s"
+                err = f" last_error={h.last_error}" if h.last_error else ""
+                lines.append(
+                    f"{name}: state={h.state} pid={h.pid()} gen={h.generation} "
+                    f"restarts={h.restarts} lease={lease}{err}"
+                )
+        return "; ".join(lines)
+
+    # -- lifecycle ------------------------------------------------------------
+    def request_stop(self) -> None:
+        """Flag shutdown: checks stop respawning, deaths read as stopped."""
+        self.stopping = True
+
+    def terminate_all(self, grace_s: Optional[float] = None) -> List[str]:
+        """Graceful fleet drain: SIGTERM every live replica (each runs its own
+        PR 10 drain — stop accepting, settle admitted requests, exit 0), wait
+        out ``grace_s`` TOTAL (default: the configured ``join_s``), SIGKILL
+        the stragglers BY NAME; returns their names."""
+        self.request_stop()
+        budget = self.join_s if grace_s is None else float(grace_s)
+        with self._lock:
+            handles = [h for h in self._replicas.values() if h.proc is not None]
+            for h in handles:
+                h.retired = True
+        for h in handles:
+            if h.proc.poll() is None:
+                try:
+                    h.proc.terminate()
+                except OSError:
+                    pass
+        deadline = self._clock() + budget
+        killed: List[str] = []
+        for h in handles:
+            remaining = max(0.0, deadline - self._clock())
+            try:
+                h.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                killed.append(h.name)
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            with self._lock:
+                h.last_rc = h.proc.poll()
+                if h.state in (_RUNNING, _BACKOFF):
+                    h.state = _STOPPED
+        if killed:
+            warnings.warn(
+                f"[{self.name}] drain grace ({budget:g}s) expired — SIGKILLed replica(s) "
+                f"that did not finish their graceful drain: {', '.join(killed)}"
+            )
+        return killed

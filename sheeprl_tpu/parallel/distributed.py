@@ -6,12 +6,17 @@ and initializing NCCL/Gloo groups (reference: ``sheeprl/cli.py:186-198``,
 started by the pod runtime (or manually), with ``jax.distributed.initialize``
 wiring DCN; chips then appear as one global ``jax.devices()`` list and all
 tensor collectives ride ICI via sharded ``jit``.
+
+Wired through the CLI entrypoints (train AND serve) behind the
+``fabric.distributed.*`` config block; the ``SHEEPRL_COORDINATOR`` /
+``SHEEPRL_NUM_PROCESSES`` / ``SHEEPRL_PROCESS_ID`` env vars remain the
+pod-runtime override (one launch command, per-host env) and win over config.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Any, Dict, Optional
 
 import jax
 
@@ -19,29 +24,55 @@ _initialized = False
 
 
 def maybe_init(
+    cfg: Optional[Dict[str, Any]] = None,
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
-) -> None:
-    """Initialize ``jax.distributed`` when running multi-host.
+) -> bool:
+    """Initialize ``jax.distributed`` when running multi-host; returns
+    whether THIS call initialized it.
 
-    No-op when single-process (the common dev case) or already initialized.
-    Env-var driven: honors ``SHEEPRL_COORDINATOR``/``SHEEPRL_NUM_PROCESSES``/
-    ``SHEEPRL_PROCESS_ID`` as well as the standard TPU pod auto-detection.
+    ``cfg`` is a ``fabric.distributed``-shaped mapping (``enabled``,
+    ``coordinator``, ``num_processes``, ``process_id``). Resolution order per
+    field: explicit keyword > ``SHEEPRL_*`` env var (the pod runtime's
+    per-host override) > config key. ``enabled: false`` never initializes;
+    ``enabled: true`` REQUIRES a coordinator (a typed error beats N-1 hosts
+    silently training solo); ``enabled: null`` (the default) auto-detects —
+    initialize iff a coordinator or process count was provided somewhere.
+    No-op when already initialized or single-process.
     """
     global _initialized
     if _initialized:
-        return
-    coordinator_address = coordinator_address or os.environ.get("SHEEPRL_COORDINATOR")
-    if num_processes is None and "SHEEPRL_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["SHEEPRL_NUM_PROCESSES"])
-    if process_id is None and "SHEEPRL_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["SHEEPRL_PROCESS_ID"])
+        return False
+    cfg = dict(cfg or {})
+    enabled = cfg.get("enabled")
+    if enabled is False:
+        return False
+    coordinator_address = (
+        coordinator_address or os.environ.get("SHEEPRL_COORDINATOR") or cfg.get("coordinator")
+    )
+    if num_processes is None:
+        if "SHEEPRL_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["SHEEPRL_NUM_PROCESSES"])
+        elif cfg.get("num_processes") is not None:
+            num_processes = int(cfg["num_processes"])
+    if process_id is None:
+        if "SHEEPRL_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["SHEEPRL_PROCESS_ID"])
+        elif cfg.get("process_id") is not None:
+            process_id = int(cfg["process_id"])
     if coordinator_address is None and num_processes is None:
-        return  # single host
+        if enabled:
+            raise ValueError(
+                "fabric.distributed.enabled=true but no coordinator was provided — set "
+                "fabric.distributed.coordinator (or SHEEPRL_COORDINATOR) so every host "
+                "joins the same jax.distributed runtime instead of silently training solo"
+            )
+        return False  # single host
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
     _initialized = True
+    return True

@@ -43,8 +43,15 @@ SIGTERM/SIGINT trigger a graceful drain in the CLI.
 """
 
 from sheeprl_tpu.serve.engine import BucketEngine, JitEngine
+from sheeprl_tpu.serve.fleet import FleetReplicaError, FleetRouter, ReplicaEndpoint
 from sheeprl_tpu.serve.policy import ServePolicy, StatefulServePolicy
-from sheeprl_tpu.serve.scheduler import RequestScheduler, ServeClosedError, ServeOverloadedError, ServeStats
+from sheeprl_tpu.serve.scheduler import (
+    RequestScheduler,
+    ServeClosedError,
+    ServeOverloadedError,
+    ServeStats,
+    ServeTimeoutError,
+)
 from sheeprl_tpu.serve.server import PolicyClient, PolicyServer, install_drain_handlers
 from sheeprl_tpu.serve.sessions import SessionCache, SessionEngine
 from sheeprl_tpu.serve.weights import CheckpointWatcher, WeightStore
@@ -60,9 +67,13 @@ __all__ = [
     "ServeStats",
     "ServeOverloadedError",
     "ServeClosedError",
+    "ServeTimeoutError",
     "WeightStore",
     "CheckpointWatcher",
     "PolicyClient",
     "PolicyServer",
     "install_drain_handlers",
+    "FleetRouter",
+    "FleetReplicaError",
+    "ReplicaEndpoint",
 ]

@@ -46,7 +46,13 @@ from sheeprl_tpu.fault.inject import fault_point
 from sheeprl_tpu.parallel.pipeline import PipelineStats
 from sheeprl_tpu.serve.policy import ServePolicy
 
-__all__ = ["ServeStats", "RequestScheduler", "ServeOverloadedError", "ServeClosedError"]
+__all__ = [
+    "ServeStats",
+    "RequestScheduler",
+    "ServeOverloadedError",
+    "ServeClosedError",
+    "ServeTimeoutError",
+]
 
 
 class ServeOverloadedError(RuntimeError):
@@ -55,6 +61,16 @@ class ServeOverloadedError(RuntimeError):
 
 class ServeClosedError(RuntimeError):
     """submit() after the scheduler stopped."""
+
+
+class ServeTimeoutError(TimeoutError):
+    """A submitted request did not resolve inside the caller's timeout.
+
+    Typed (and a ``TimeoutError`` subclass, so pre-existing handlers keep
+    working) because the untyped form was a real operational bug: a hung
+    worker pinned every caller that had passed ``timeout=None`` forever,
+    and callers that did time out couldn't tell a serve-tier timeout from
+    any other ``TimeoutError`` in their stack."""
 
 
 class ServeStats(PipelineStats):
@@ -69,6 +85,7 @@ class ServeStats(PipelineStats):
         self.swaps = 0
         self.weight_version = 0
         self.watcher_errors = 0  # swallowed checkpoint-watcher poll failures
+        self.weights_stale = 0  # ok->stale transitions of the staleness alarm
         self._latencies = collections.deque(maxlen=int(latency_window))
         self._depth_fn = None  # wired by the scheduler
         self._sessions_fn = None  # wired when serving a stateful policy
@@ -110,6 +127,7 @@ class ServeStats(PipelineStats):
                     "Serve/weight_version": self.weight_version,
                     "Serve/swap_count": self.swaps,
                     "Serve/watcher_errors": self.watcher_errors,
+                    "Serve/weights_stale": self.weights_stale,
                     "Serve/p50_latency_ms": round(p50 * 1e3, 3),
                     "Serve/p99_latency_ms": round(p99 * 1e3, 3),
                 }
@@ -373,7 +391,7 @@ class RequestScheduler:
     def result(self, req: _Request, timeout: Optional[float] = None) -> Tuple[np.ndarray, int]:
         """Block until ``req`` resolves; returns ``(actions, weight_version)``."""
         if not req.event.wait(timeout):
-            raise TimeoutError("request did not resolve in time")
+            raise ServeTimeoutError(f"request did not resolve within {timeout}s")
         if req.error is not None:
             raise req.error
         self.stats.observe_latency(req.latency_s)

@@ -67,11 +67,24 @@ class PolicyClient:
     ``act`` prepares the observation (the algorithm's own host-side
     normalization), submits it to the scheduler and blocks for the result —
     concurrent callers are micro-batched into shared engine dispatches.
+
+    ``timeout_s`` is the client-side default wait bound: per-call ``timeout``
+    / ``submit_timeout`` of ``None`` fall back to it, and its expiry raises
+    the typed :class:`~sheeprl_tpu.serve.scheduler.ServeTimeoutError`. The
+    previous default (wait forever) meant a hung worker pinned the caller
+    for the life of the process; ``None`` keeps that behavior for callers
+    that explicitly want an unbounded wait.
     """
 
-    def __init__(self, policy: ServePolicy, scheduler: RequestScheduler) -> None:
+    def __init__(
+        self,
+        policy: ServePolicy,
+        scheduler: RequestScheduler,
+        timeout_s: Optional[float] = None,
+    ) -> None:
         self.policy = policy
         self.scheduler = scheduler
+        self.timeout_s = timeout_s
 
     def act(
         self,
@@ -84,10 +97,13 @@ class PolicyClient:
     ) -> Tuple[np.ndarray, int]:
         """Actions (``(n, action_dim)``) + the weight version that produced
         them. ``timeout`` bounds the wait for the result; ``submit_timeout``
-        bounds the backpressure wait for queue space. On a stateful server
-        ``session_id`` carries this caller's recurrent/latent state between
-        calls (``n`` must be 1 — one user, one state row) and ``reset``
-        restarts it for a new episode."""
+        bounds the backpressure wait for queue space (both default to the
+        client's ``timeout_s``). On a stateful server ``session_id`` carries
+        this caller's recurrent/latent state between calls (``n`` must be 1
+        — one user, one state row) and ``reset`` restarts it for a new
+        episode."""
+        timeout = self.timeout_s if timeout is None else timeout
+        submit_timeout = self.timeout_s if submit_timeout is None else submit_timeout
         prepared = self.policy.prepare(obs, n)
         req = self.scheduler.submit(prepared, timeout=submit_timeout, session_id=session_id, reset=reset)
         return self.scheduler.result(req, timeout=timeout)
@@ -213,7 +229,15 @@ class PolicyServer:
             stats=self.stats,
             sessions=self.engine.cache if stateful else None,
         )
-        self.client = PolicyClient(policy, self.scheduler)
+        self.client = PolicyClient(policy, self.scheduler, timeout_s=cfg.get("client_timeout_s"))
+        self._request_timeout_s = float(cfg.get("request_timeout_s", 30.0) or 30.0)
+        # staleness alarm: weights older than this flip the probe to degraded
+        # (Serve/weights_stale counts the ok->stale transitions) so a wedged
+        # publisher is VISIBLE instead of silently serving old weights forever
+        _max_stale = cfg.get("max_staleness_s")
+        self._max_staleness_s = float(_max_stale) if _max_stale else None
+        self._was_stale = False
+        self._watch_publish_current = bool(cfg.get("watch_publish_current", False))
         # one supervisor over the serving workers (scheduler + watcher):
         # restart-on-crash with in-flight recovery, health-probe visibility
         self.supervisor = Supervisor.from_config(
@@ -244,12 +268,21 @@ class PolicyServer:
     def start(self, with_socket: Optional[bool] = None) -> "PolicyServer":
         self.scheduler.start(supervisor=self.supervisor)
         if self.watcher is not None:
-            self.watcher.start(supervisor=self.supervisor)
+            # publish_current (serve.watch_publish_current; fleet replicas set
+            # it): adopt the newest complete save immediately, so a RESPAWNED
+            # replica rejoins the fleet on the freshest weights instead of
+            # the checkpoint it was originally launched from
+            self.watcher.start(publish_current=self._watch_publish_current, supervisor=self.supervisor)
         self.supervisor.start_monitor(poll_s=0.5)
         want_socket = (self._port is not None) if with_socket is None else with_socket
         if want_socket:
             port = int(self._port or 0)
-            self._tcp = _TcpFrontEnd((self._host, port), self.client, health_fn=self.health)
+            self._tcp = _TcpFrontEnd(
+                (self._host, port),
+                self.client,
+                request_timeout_s=self._request_timeout_s,
+                health_fn=self.health,
+            )
             self._tcp_thread = threading.Thread(target=self._tcp.serve_forever, name="serve-tcp", daemon=True)
             self._tcp_thread.start()
         return self
@@ -261,7 +294,12 @@ class PolicyServer:
         sched_alive = self.scheduler.worker_alive()
         watcher_alive = self.watcher.alive() if self.watcher is not None else None
         fatal = self.supervisor.fatal
-        healthy = sched_alive and watcher_alive in (None, True) and fatal is None
+        staleness = self.weights.staleness_s
+        stale = self._max_staleness_s is not None and staleness > self._max_staleness_s
+        if stale and not self._was_stale:
+            self.stats.add("weights_stale", 1)
+        self._was_stale = stale
+        healthy = sched_alive and watcher_alive in (None, True) and fatal is None and not stale
         status = "draining" if self._draining else ("ok" if healthy else "degraded")
         workers = self.supervisor.snapshot()
         out: Dict[str, Any] = {
@@ -279,7 +317,13 @@ class PolicyServer:
             },
             "weights": {
                 "version": int(self.weights.version),
-                "staleness_s": round(self.weights.staleness_s, 3),
+                # fleet-comparable weight identity: per-replica version
+                # counters restart at 0 on a respawn, the published
+                # checkpoint STEP does not — the router's rolling-swap
+                # monotonicity rides this field
+                "step": int(self.watcher._last_step) if self.watcher is not None else int(self.weights.version),
+                "staleness_s": round(staleness, 3),
+                "stale": bool(stale),
             },
             "supervisor": {"fatal": str(fatal) if fatal is not None else None, "workers": workers},
         }

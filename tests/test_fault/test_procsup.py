@@ -1,0 +1,204 @@
+"""ProcessSupervisor unit drills: SIGKILL detection distinct from hangs,
+lease expiry over health-probe liveness, the restart→degrade→abort ladder at
+process granularity, and the SIGTERM-grace-then-SIGKILL drain. Children are
+tiny ``python -c`` processes — no serve stack, just lifecycle."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sheeprl_tpu.fault.procsup import ProcessSupervisor
+from sheeprl_tpu.fault.supervisor import AllWorkersDeadError, WorkerAbortError
+
+SLEEPER = [sys.executable, "-c", "import time; time.sleep(120)"]
+# exits rc=3 immediately: the crash (not kill) model
+CRASHER = [sys.executable, "-c", "import sys; sys.exit(3)"]
+# ignores SIGTERM: the drain straggler model
+STUBBORN = [
+    sys.executable,
+    "-c",
+    "import signal, time; signal.signal(signal.SIGTERM, signal.SIG_IGN); time.sleep(120)",
+]
+
+
+def _spawner(cmd, calls=None):
+    def spawn():
+        if calls is not None:
+            calls.append(time.monotonic())
+        return subprocess.Popen(cmd)
+
+    return spawn
+
+
+def _wait(predicate, timeout=10.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+@pytest.fixture()
+def sup():
+    s = ProcessSupervisor(lease_s=None, backoff=0.01, max_restarts=2, join_s=10.0)
+    yield s
+    s.request_stop()
+    s.terminate_all(grace_s=5.0)
+
+
+def test_sigkill_detected_as_kill_and_respawned(sup):
+    """rc == -SIGKILL is an external kill (preemption/OOM/chaos): counted in
+    ``kills`` with the signal NAMED, and the replica is respawned."""
+    calls = []
+    handle = sup.spawn("r0", _spawner(SLEEPER, calls))
+    os.kill(handle.pid(), signal.SIGKILL)
+    assert _wait(lambda: handle.proc.poll() is not None)
+    with pytest.warns(UserWarning, match="killed by SIGKILL"):
+        sup.check()
+    assert handle.deaths == 1 and handle.kills == 1 and handle.hangs == 0
+    assert handle.last_signal == "SIGKILL" and handle.last_rc == -signal.SIGKILL
+    assert _wait(lambda: (sup.check() or handle.is_alive()))
+    assert handle.restarts == 1 and len(calls) == 2
+
+
+def test_plain_exit_is_a_death_not_a_kill(sup):
+    """A child that exits rc != 0 on its own is a crash: ``deaths`` counts
+    it, ``kills``/``hangs`` do not, and the rc is recorded."""
+    handle = sup.spawn("r0", _spawner(CRASHER))
+    assert _wait(lambda: handle.proc.poll() is not None)
+    with pytest.warns(UserWarning, match="exited rc=3"):
+        sup.check()
+    assert handle.deaths == 1 and handle.kills == 0 and handle.hangs == 0
+    assert handle.last_rc == 3 and handle.last_signal is None
+
+
+def test_hang_lease_expiry_sigkills_and_counts_distinctly():
+    """No probe beats inside the lease while the process is ALIVE: that is a
+    HANG — counted in ``hangs`` (not ``kills``), the wedged process is
+    SIGKILLed by the supervisor itself, and a fresh one is spawned."""
+    sup = ProcessSupervisor(lease_s=0.15, grace_s=0.15, backoff=0.01, max_restarts=2)
+    try:
+        calls = []
+        handle = sup.spawn("r0", _spawner(SLEEPER, calls))
+        assert handle.is_alive()
+        time.sleep(0.3)  # lease (and spawn grace) expired, no beats arrived
+        with pytest.warns(UserWarning, match="hung: missed its 0.15s health-probe lease"):
+            sup.check()
+        assert handle.hangs == 1 and handle.kills == 0 and handle.deaths == 1
+        assert _wait(lambda: (sup.check() or handle.is_alive()))
+        assert handle.restarts == 1 and len(calls) == 2
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_beats_keep_a_silent_lease_alive():
+    """Probe-success beats renew the lease: a replica that keeps answering
+    its health probe is never declared hung."""
+    sup = ProcessSupervisor(lease_s=0.15, grace_s=0.15, backoff=0.01)
+    try:
+        handle = sup.spawn("r0", _spawner(SLEEPER))
+        for _ in range(6):
+            time.sleep(0.05)
+            sup.beat("r0")
+            sup.check()
+        assert handle.hangs == 0 and handle.deaths == 0 and handle.is_alive()
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_degrade_past_budget_then_all_dead_is_typed():
+    """Budget 0 + degrade: the first death drops the replica; when every
+    replica is degraded the pool raises AllWorkersDeadError (never a silent
+    routing loop over nothing)."""
+    sup = ProcessSupervisor(lease_s=None, backoff=0.01, max_restarts=0, escalation="degrade")
+    try:
+        h0 = sup.spawn("r0", _spawner(CRASHER))
+        h1 = sup.spawn("r1", _spawner(CRASHER))
+        assert _wait(lambda: h0.proc.poll() is not None and h1.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="DEGRADED"):
+            with pytest.raises(AllWorkersDeadError):
+                sup.check()
+        assert h0.state == "degraded" and h1.state == "degraded"
+        assert sup.alive_count() == 0
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_abort_escalation_names_the_replica():
+    sup = ProcessSupervisor(lease_s=None, backoff=0.01, max_restarts=0, escalation="abort")
+    try:
+        handle = sup.spawn("bad-replica", _spawner(CRASHER))
+        assert _wait(lambda: handle.proc.poll() is not None)
+        with pytest.raises(WorkerAbortError, match="bad-replica"):
+            sup.check()
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_restart_escalation_ignores_budget(sup):
+    sup.escalation = "restart"
+    sup.max_restarts = 0
+    handle = sup.spawn("r0", _spawner(CRASHER))
+    assert _wait(lambda: handle.proc.poll() is not None)
+    with pytest.warns(UserWarning, match="respawning"):
+        sup.check()
+    assert handle.state == "backoff"
+
+
+def test_on_restart_hook_runs_before_respawn(sup):
+    order = []
+    handle = sup.spawn(
+        "r0",
+        lambda: (order.append("spawn"), subprocess.Popen(SLEEPER))[1],
+        on_restart=lambda name: order.append(f"rehome:{name}"),
+    )
+    os.kill(handle.pid(), signal.SIGKILL)
+    assert _wait(lambda: handle.proc.poll() is not None)
+    with pytest.warns(UserWarning, match="respawning"):
+        sup.check()
+    assert _wait(lambda: (sup.check() or handle.restarts == 1))
+    assert order == ["spawn", "rehome:r0", "spawn"]
+
+
+def test_terminate_all_sigterm_grace_then_sigkill_by_name():
+    """Drain: a SIGTERM-honoring replica exits inside the grace; a stubborn
+    one is SIGKILLed and NAMED."""
+    sup = ProcessSupervisor(lease_s=None, backoff=0.01)
+    good = sup.spawn("good", _spawner(SLEEPER))
+    bad = sup.spawn("stubborn", _spawner(STUBBORN))
+    assert _wait(lambda: good.is_alive() and bad.is_alive())
+    time.sleep(0.2)  # let the stubborn child install its SIG_IGN handler
+    with pytest.warns(UserWarning, match="SIGKILLed replica.*stubborn"):
+        killed = sup.terminate_all(grace_s=2.0)
+    assert killed == ["stubborn"]
+    assert not good.is_alive() and not bad.is_alive()
+    assert good.state == "stopped" and bad.state == "stopped"
+
+
+def test_retired_replica_is_never_respawned(sup):
+    handle = sup.spawn("r0", _spawner(SLEEPER))
+    handle.retire()
+    os.kill(handle.pid(), signal.SIGKILL)
+    assert _wait(lambda: handle.proc.poll() is not None)
+    sup.check()
+    assert handle.state == "stopped" and handle.restarts == 0
+
+
+def test_from_config_knob_shape():
+    """serve.fleet knob shape: explicit keys win over defaults; lease null
+    disables hang detection — the fault.supervisor merge contract."""
+    sup = ProcessSupervisor.from_config(
+        {"max_restarts": 5, "escalation": "abort", "lease_s": 0, "grace_s": 7.0},
+        backoff=0.125,
+        name="serve-fleet",
+    )
+    assert sup.max_restarts == 5 and sup.escalation == "abort"
+    assert sup.lease_s is None and sup.grace_s == 7.0
+    assert sup.backoff == 0.125 and sup.name == "serve-fleet"
+    with pytest.raises(ValueError, match="escalation"):
+        ProcessSupervisor.from_config({"escalation": "explode"})

@@ -1,0 +1,146 @@
+"""Standalone toy replica process for the fleet tests (NOT a test module).
+
+Runs one real :class:`~sheeprl_tpu.serve.server.PolicyServer` — socket front
+end, supervised scheduler, optional shared-dir checkpoint watcher, SIGTERM
+graceful drain, exit 0 — around the same toy policies the serve conftest
+uses, so fleet drills pay toy-compile startup (a couple of seconds) instead
+of a full CLI checkpoint load per replica. The protocol, health probe, drain
+and watcher behavior are the production code paths; only the policy is toy.
+
+Usage::
+
+    python fleet_replica_main.py --port 0 [--stateful] [--watch DIR]
+        [--watch-poll 0.05] [--buckets 1,4] [--max-wait-ms 1]
+        [--queue-bound 64] [--request-timeout 30]
+
+Prints ``REPLICA_READY host:port`` once the socket is up (port 0 support for
+single-replica tests; fleet tests pass fixed ports so respawns rebind).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+# runnable from anywhere: the repo root (two levels up) onto sys.path
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+
+def build_policy(stateful: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.serve.policy import ServePolicy, StatefulServePolicy
+
+    if stateful:
+        # counter policy: action row = [count, w·obs_sum]; any reset, drop,
+        # reorder or cross-session mixup is visible in the action values
+        w = jnp.asarray(np.arange(4, dtype=np.float32).reshape(2, 2))
+
+        def step_fn(p, obs, state, key, greedy):
+            del key, greedy
+            count = state["count"][:, 0]
+            y = (obs["x"] @ p["w"]).sum(-1)
+            return jnp.stack([count, y], axis=-1), {"count": state["count"] + 1.0}
+
+        def init_fn(p, n):
+            del p
+            return {"count": jnp.zeros((n, 1), jnp.float32)}
+
+        return StatefulServePolicy(
+            name="toy_stateful",
+            params={"w": w},
+            obs_spec={"x": ((2,), np.float32)},
+            action_dim=2,
+            step_fn=step_fn,
+            init_fn=init_fn,
+            prepare=lambda obs, n: {"x": np.asarray(obs["x"], np.float32).reshape(n, 2)},
+            params_from_state=lambda state: jax.tree.map(jnp.asarray, state),
+        )
+
+    # linear map policy: actions scale with the params, so a weight swap is
+    # observable in the action values themselves
+    w = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    def greedy_fn(p, obs):
+        return obs["x"] @ p["w"]
+
+    def sample_fn(p, obs, key):
+        noise = jax.random.normal(key, (obs["x"].shape[0], 3), dtype=jnp.float32)
+        return obs["x"] @ p["w"] + 1e-3 * noise
+
+    return ServePolicy(
+        name="toy",
+        params={"w": w},
+        obs_spec={"x": ((2,), np.float32)},
+        action_dim=3,
+        greedy_fn=greedy_fn,
+        sample_fn=sample_fn,
+        prepare=lambda obs, n: {"x": np.asarray(obs["x"], dtype=np.float32).reshape(n, 2)},
+        params_from_state=lambda state: jax.tree.map(jnp.asarray, state),
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--stateful", action="store_true")
+    parser.add_argument("--watch", default=None)
+    parser.add_argument("--watch-poll", type=float, default=0.05)
+    parser.add_argument("--buckets", default="1,4")
+    parser.add_argument("--max-wait-ms", type=float, default=1.0)
+    parser.add_argument("--queue-bound", type=int, default=64)
+    parser.add_argument("--request-timeout", type=float, default=30.0)
+    parser.add_argument("--max-staleness", type=float, default=None)
+    args = parser.parse_args()
+
+    from sheeprl_tpu.utils.utils import pin_cpu_platform
+
+    pin_cpu_platform("cpu")
+
+    from sheeprl_tpu.serve.server import PolicyServer, install_drain_handlers
+
+    policy = build_policy(args.stateful)
+    buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    cfg = {
+        "buckets": buckets,
+        "host": args.host,
+        "port": args.port,
+        "max_wait_ms": args.max_wait_ms,
+        "queue_bound": args.queue_bound,
+        "request_timeout_s": args.request_timeout,
+        "watch_poll_s": args.watch_poll,
+        # a respawned replica must rejoin on the newest complete save
+        "watch_publish_current": True,
+        "supervisor": {"backoff": 0.02},
+    }
+    if args.max_staleness is not None:
+        cfg["max_staleness_s"] = args.max_staleness
+    if args.stateful:
+        cfg["session"] = {"buckets": buckets, "ttl_s": 300.0, "max_sessions": 64}
+    drain = threading.Event()
+    restore = install_drain_handlers(drain)
+    server = PolicyServer(policy, cfg, watch_dir=args.watch)
+    server.start()
+    host, port = server.address
+    print(f"REPLICA_READY {host}:{port}", flush=True)
+    try:
+        while not drain.is_set():
+            drain.wait(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()  # graceful drain: nothing admitted is dropped
+        restore()
+        print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}), flush=True)
+        if drain.is_set():
+            print("serve: drained cleanly", flush=True)
+
+
+if __name__ == "__main__":
+    main()

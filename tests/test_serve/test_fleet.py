@@ -1,0 +1,281 @@
+"""FleetRouter unit drills over IN-PROCESS replica servers (real sockets,
+real protocol, no subprocesses): least-loaded spread, monotone
+fleet_version annotation, session stickiness + counted client-visible
+re-homing, fleet-wide load shedding, replica-endpoint timeouts against a
+deliberately hung server, and the typed PolicyClient timeout. The
+process-lifecycle half (SIGKILL/respawn under load) lives in
+``test_fleet_chaos.py``."""
+
+import collections
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.serve.fleet import FleetReplicaError, FleetRouter, ReplicaEndpoint
+from sheeprl_tpu.serve.scheduler import ServeTimeoutError
+from sheeprl_tpu.serve.server import PolicyServer
+
+
+@pytest.fixture(autouse=True)
+def _inject_isolation():
+    inject.reset()
+    yield
+    inject.reset()
+
+
+def _wait(predicate, timeout=10.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+def _stand_up_fleet(policy, n=2, stateful=False, **router_cfg):
+    """N in-process PolicyServers with socket front ends + a router over
+    them (no process supervisor — lifecycle drills live in the chaos
+    module)."""
+    servers = []
+    endpoints = []
+    for i in range(n):
+        cfg = {"buckets": [1, 4], "port": 0, "max_wait_ms": 1.0}
+        if stateful:
+            cfg["session"] = {"buckets": [1, 4], "max_sessions": 32}
+        server = PolicyServer(policy, cfg).start()
+        host, port = server.address
+        servers.append(server)
+        endpoints.append(ReplicaEndpoint(f"replica-{i}", host, port, request_timeout_s=10.0))
+    cfg = {"health_poll_s": 0.05, "health_timeout_s": 2.0, "retry_budget": 2, **router_cfg}
+    router = FleetRouter(endpoints, fleet_cfg=cfg, port=None).start()
+    assert router.wait_ready(timeout_s=30)
+    return router, servers, endpoints
+
+
+def _teardown(router, servers):
+    router.stop()
+    for s in servers:
+        s.stop()
+
+
+def test_least_loaded_routing_spreads_and_annotates(toy_policy):
+    """Serial traffic spreads over the fleet (rotating tie-break), every
+    response names its replica and carries a per-connection monotone
+    fleet_version."""
+    router, servers, _eps = _stand_up_fleet(toy_policy, n=2)
+    try:
+        used = collections.Counter()
+        last_version = -10
+        for i in range(12):
+            resp = router.serve_request({"obs": {"x": [[1.0, float(i)]]}, "n": 1})
+            assert "error" not in resp, resp
+            assert resp["actions"] is not None
+            used[resp["replica"]] += 1
+            assert resp["fleet_version"] >= last_version
+            last_version = resp["fleet_version"]
+        assert set(used) == {"replica-0", "replica-1"}
+        assert min(used.values()) >= 4  # spread, not pinned
+    finally:
+        _teardown(router, servers)
+
+
+def test_aggregated_health_reflects_fleet_state(toy_policy):
+    router, servers, _eps = _stand_up_fleet(toy_policy, n=2)
+    try:
+        health = router.health()
+        assert health["status"] == "ok" and health["ready"] is True
+        assert health["fleet"]["replicas"] == 2 and health["fleet"]["ready"] == 2
+        assert set(health["replicas"]) == {"replica-0", "replica-1"}
+        for entry in health["replicas"].values():
+            assert entry["ready"] is True and entry["status"] == "ok"
+            assert "version" in entry and "step" in entry
+        # one replica down -> degraded; both -> down
+        servers[0].stop()
+        assert _wait(lambda: router.health()["status"] == "degraded", timeout=15)
+        servers[1].stop()
+        assert _wait(lambda: router.health()["status"] == "down", timeout=15)
+        assert router.health()["ready"] is False
+    finally:
+        router.stop()
+
+
+def test_sessions_stick_to_one_replica(toy_stateful_policy):
+    """A session's stream (actions[:, 0] counting 0,1,2,...) must ride ONE
+    replica even while stateless traffic rotates."""
+    router, servers, _eps = _stand_up_fleet(toy_stateful_policy, n=2, stateful=True)
+    try:
+        obs = {"obs": {"x": [[1.0, 2.0]]}, "n": 1}
+        homes = set()
+        for step in range(6):
+            resp = router.serve_request({**obs, "session_id": "user-a"})
+            assert "error" not in resp, resp
+            assert resp["actions"][0][0] == float(step)  # contiguous stream
+            homes.add(resp["replica"])
+            router.serve_request(obs)  # interleaved stateless traffic
+        assert len(homes) == 1
+    finally:
+        _teardown(router, servers)
+
+
+def test_session_rehome_on_replica_death_is_counted_and_visible(toy_stateful_policy):
+    """Home replica dies -> the session re-homes to a survivor with the
+    re-init COUNTED (sessions_rehomed) and CLIENT-VISIBLE (rehomed flag +
+    the stream restarting from its init state) — never silently wrong
+    state."""
+    router, servers, eps = _stand_up_fleet(toy_stateful_policy, n=2, stateful=True)
+    victim = None
+    try:
+        obs = {"obs": {"x": [[1.0, 2.0]]}, "n": 1}
+        for step in range(3):
+            resp = router.serve_request({**obs, "session_id": "user-a"})
+            assert resp["actions"][0][0] == float(step)
+        home = resp["replica"]
+        victim = next(s for s, ep in zip(servers, eps) if ep.name == home)
+        victim.stop()
+        assert _wait(lambda: not next(ep for ep in eps if ep.name == home).ready, timeout=15)
+        resp = router.serve_request({**obs, "session_id": "user-a"})
+        assert "error" not in resp, resp
+        assert resp["replica"] != home
+        assert resp.get("rehomed") is True
+        assert resp["actions"][0][0] == 0.0  # visible re-init, not silent state
+        assert router.counters["sessions_rehomed"] == 1
+        # the stream continues contiguously on the new home, no more rehomes
+        resp = router.serve_request({**obs, "session_id": "user-a"})
+        assert resp["actions"][0][0] == 1.0 and "rehomed" not in resp
+        assert router.counters["sessions_rehomed"] == 1
+    finally:
+        router.stop()
+        for s in servers:
+            if s is not victim:
+                s.stop()
+
+
+def test_midflight_failover_retries_within_budget(toy_policy):
+    """A replica that dies between the probe and the request: the router
+    retries toward a survivor inside the per-request budget instead of
+    erroring the caller."""
+    # one immediate tick marks everyone ready, then the loop sleeps for 30s:
+    # the router's view is frozen stale for the whole test window
+    router, servers, eps = _stand_up_fleet(toy_policy, n=2, health_poll_s=30.0)
+    try:
+        # kill replica-0's socket WITHOUT the health loop noticing
+        servers[0].stop()
+        with router._lock:
+            eps[0].ready = True  # stale view: the router still believes in it
+            eps[1].inflight = 1  # least-loaded MUST pick the dead replica first
+        resp = router.serve_request({"obs": {"x": [[1.0, 2.0]]}, "n": 1})
+        with router._lock:
+            eps[1].inflight = 0
+        assert "error" not in resp, resp
+        assert resp["replica"] == "replica-1"
+        assert router.counters["retries"] >= 1
+        assert router.counters["replica_errors"] >= 1
+    finally:
+        router.stop()
+        servers[1].stop()
+
+
+def test_fleet_wide_shed_propagates_overload_error(toy_policy):
+    """No READY replica -> ServeOverloadedError backpressure, counted, not
+    an unbounded router queue; recovery restores service."""
+    router, servers, _eps = _stand_up_fleet(toy_policy, n=2)
+    try:
+        for s in servers:
+            s.stop()
+        assert _wait(lambda: router.health()["status"] == "down", timeout=15)
+        resp = router.serve_request({"obs": {"x": [[1.0, 2.0]]}, "n": 1})
+        assert "ServeOverloadedError" in resp["error"]
+        assert router.counters["shed"] == 1
+    finally:
+        router.stop()
+
+
+def test_max_inflight_sheds_instead_of_queueing(toy_policy):
+    """Every READY replica at max_inflight -> immediate backpressure."""
+    router, servers, eps = _stand_up_fleet(toy_policy, n=2, max_inflight=1)
+    try:
+        with router._lock:
+            for ep in eps:
+                ep.inflight = 1  # saturate the router's view
+        resp = router.serve_request({"obs": {"x": [[1.0, 2.0]]}, "n": 1})
+        assert "ServeOverloadedError" in resp["error"]
+        assert router.counters["shed"] == 1
+    finally:
+        with router._lock:
+            for ep in eps:
+                ep.inflight = 0
+        _teardown(router, servers)
+
+
+def test_replica_endpoint_times_out_against_hung_server():
+    """The client-side half of the hung-replica bugfix: a server that
+    accepts but never answers fails the call with a TYPED error inside the
+    timeout instead of pinning the caller forever."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    try:
+        ep = ReplicaEndpoint("hung", "127.0.0.1", listener.getsockname()[1], request_timeout_s=0.3)
+        start = time.monotonic()
+        with pytest.raises(FleetReplicaError, match="no response within") as excinfo:
+            ep.request({"obs": {"x": [[1.0, 2.0]]}, "n": 1})
+        assert excinfo.value.timed_out is True
+        assert time.monotonic() - start < 5.0  # bounded, not forever
+        ep.close()
+    finally:
+        listener.close()
+
+
+def test_policy_client_timeout_s_is_typed_and_bounded(toy_policy):
+    """PolicyClient.timeout_s: a hung scheduler worker (chaos hang at the
+    batch point) raises the typed ServeTimeoutError inside the bound; the
+    pre-fix behavior (timeout=None) waited forever."""
+    server = PolicyServer(toy_policy, {"buckets": [1, 4], "port": None, "client_timeout_s": 0.3}).start()
+    try:
+        assert server.client.timeout_s == 0.3
+        inject.arm("serve.scheduler.batch", action="hang", at=1, hang_s=2.0)
+        start = time.monotonic()
+        with pytest.raises(ServeTimeoutError):
+            server.client.act({"x": np.ones((1, 2), np.float32)}, n=1)
+        assert time.monotonic() - start < 2.0
+        inject.release_hangs()
+    finally:
+        inject.reset()
+        server.stop()
+
+
+def test_staleness_alarm_flips_health_to_degraded(toy_policy):
+    """serve.max_staleness_s: weights older than the threshold flip the
+    probe to degraded (stale flagged, Serve/weights_stale counted); a fresh
+    publish recovers to ok."""
+    server = PolicyServer(toy_policy, {"buckets": [1], "port": None, "max_staleness_s": 0.1}).start()
+    try:
+        assert _wait(lambda: server.health()["status"] == "degraded", timeout=10)
+        health = server.health()
+        assert health["weights"]["stale"] is True
+        assert health["ready"] is True  # degraded still serves; it is VISIBLE
+        assert server.stats.snapshot()["Serve/weights_stale"] == 1
+        server.weights.publish_params(toy_policy.params)
+        health = server.health()
+        assert health["status"] == "ok" and health["weights"]["stale"] is False
+        # a second wedge counts a second transition
+        assert _wait(lambda: server.health()["status"] == "degraded", timeout=10)
+        assert server.stats.snapshot()["Serve/weights_stale"] == 2
+    finally:
+        server.stop()
+
+
+def test_router_drain_rejects_new_requests(toy_policy):
+    router, servers, _eps = _stand_up_fleet(toy_policy, n=2)
+    try:
+        router._draining = True
+        resp = router.serve_request({"obs": {"x": [[1.0, 2.0]]}, "n": 1})
+        assert "ServeClosedError" in resp["error"]
+    finally:
+        router._draining = False
+        _teardown(router, servers)

@@ -1,0 +1,489 @@
+"""Fleet-tier chaos drills — the ROADMAP sentence made into tests.
+
+The acceptance drill: 3 supervised replica PROCESSES under sustained
+closed-loop load from 7 clients (4 stateless + 3 stateful sessions), one
+replica SIGKILLed mid-run from the seeded ``fault.chaos.events`` schedule →
+supervised respawn on the shared checkpoint dir, with **zero dropped and
+zero errored admitted requests fleet-wide**, session re-inits exactly
+counted AND client-visible, router aggregated health walking ok → degraded
+→ ok, and a rolling checkpoint swap landing mid-drill with per-client
+monotone weight versions across the whole fleet.
+
+Also here: the hang-replica drill (SIGSTOP → probe-lease expiry → counted
+as a HANG, distinct from kills → SIGKILL + respawn), the stateful-session
+SIGTERM graceful drain (PR 10's drain proof was stateless-only), and the
+real ``serve --fleet`` CLI end-to-end (slow-marked)."""
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.fault.manager import CheckpointManager
+from sheeprl_tpu.fault.procsup import ProcessSupervisor
+from sheeprl_tpu.serve.fleet import FleetRouter, ReplicaEndpoint, free_port
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = str(Path(__file__).parents[2])
+REPLICA_MAIN = str(Path(__file__).parent / "fleet_replica_main.py")
+
+
+@pytest.fixture(autouse=True)
+def _inject_isolation():
+    inject.reset()
+    yield
+    inject.reset()
+
+
+def _wait(predicate, timeout=30.0, poll=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+def _spawner(port, extra=()):
+    cmd = [sys.executable, REPLICA_MAIN, "--port", str(port), *extra]
+
+    def spawn():
+        return subprocess.Popen(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    return spawn
+
+
+class _RouterClient:
+    """One persistent JSON-lines connection to the router front end."""
+
+    def __init__(self, addr):
+        self.sock = socket.create_connection(addr, timeout=60.0)
+        self.rfile = self.sock.makefile("rb")
+
+    def request(self, payload):
+        self.sock.sendall((json.dumps(payload) + "\n").encode())
+        line = self.rfile.readline()
+        if not line:
+            raise ConnectionResetError("router closed the connection")
+        return json.loads(line.decode())
+
+    def close(self):
+        try:
+            self.rfile.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _stand_up_fleet(n, ckpt_dir, extra=(), lease_s=2.0, request_timeout_s=15.0, max_restarts=3):
+    sup = ProcessSupervisor(
+        lease_s=lease_s, grace_s=60.0, backoff=0.05, max_restarts=max_restarts, name="serve-fleet"
+    )
+    endpoints = []
+    for i in range(n):
+        port = free_port()
+        name = f"replica-{i}"
+        args = list(extra)
+        if ckpt_dir is not None:
+            args += ["--watch", str(ckpt_dir)]
+        sup.spawn(name, _spawner(port, args))
+        endpoints.append(ReplicaEndpoint(name, "127.0.0.1", port, request_timeout_s=request_timeout_s))
+    router = FleetRouter(
+        endpoints,
+        fleet_cfg={
+            "health_poll_s": 0.05,
+            "health_timeout_s": 2.0,
+            "retry_budget": 3,
+            "request_timeout_s": request_timeout_s,
+        },
+        procsup=sup,
+        owns_replicas=True,
+        port=0,
+    ).start()
+    return router, sup, endpoints
+
+
+def test_fleet_chaos_drill_kill_one_of_three_zero_dropped(tmp_path):
+    """THE acceptance drill (ISSUE 14): kill 1 of 3 replicas under sustained
+    multi-client load and drop zero admitted requests fleet-wide, with a
+    rolling weight swap landing mid-drill."""
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    router, sup, eps = _stand_up_fleet(3, ckpt_dir, extra=["--stateful"])
+    try:
+        assert router.wait_ready(timeout_s=120)
+        addr = router.address
+
+        # background health sampler: the ok -> degraded -> ok trajectory
+        statuses = []
+        sample_stop = threading.Event()
+
+        def sampler():
+            while not sample_stop.is_set():
+                statuses.append(router.health()["status"])
+                sample_stop.wait(0.05)
+
+        sampler_thread = threading.Thread(target=sampler, daemon=True)
+        sampler_thread.start()
+
+        stop_clients = threading.Event()
+        errors = []  # (client, detail) — must stay EMPTY
+        stateless_results = [[] for _ in range(4)]  # per client: [(fleet_version, replica)]
+        session_results = [[] for _ in range(3)]  # per session: [(count, rehomed, replica, fleet_version)]
+
+        def stateless_client(i):
+            client = _RouterClient(addr)
+            try:
+                while not stop_clients.is_set():
+                    resp = client.request({"obs": {"x": [[1.0, float(i)]]}, "n": 1})
+                    if "error" in resp:
+                        errors.append((f"stateless-{i}", resp["error"]))
+                    else:
+                        stateless_results[i].append((resp["fleet_version"], resp["replica"]))
+                    time.sleep(0.02)
+                for _ in range(5):  # post-drill settle requests
+                    resp = client.request({"obs": {"x": [[1.0, float(i)]]}, "n": 1})
+                    if "error" in resp:
+                        errors.append((f"stateless-{i}", resp["error"]))
+                    else:
+                        stateless_results[i].append((resp["fleet_version"], resp["replica"]))
+            except Exception as e:  # any transport failure IS a dropped request
+                errors.append((f"stateless-{i}", repr(e)))
+            finally:
+                client.close()
+
+        def session_client(i):
+            client = _RouterClient(addr)
+            sid = f"user-{i}"
+            try:
+                while not stop_clients.is_set():
+                    resp = client.request({"obs": {"x": [[1.0, 2.0]]}, "n": 1, "session_id": sid})
+                    if "error" in resp:
+                        errors.append((sid, resp["error"]))
+                    else:
+                        session_results[i].append(
+                            (resp["actions"][0][0], bool(resp.get("rehomed")), resp["replica"], resp["fleet_version"])
+                        )
+                    time.sleep(0.02)
+                for _ in range(5):
+                    resp = client.request({"obs": {"x": [[1.0, 2.0]]}, "n": 1, "session_id": sid})
+                    if "error" in resp:
+                        errors.append((sid, resp["error"]))
+                    else:
+                        session_results[i].append(
+                            (resp["actions"][0][0], bool(resp.get("rehomed")), resp["replica"], resp["fleet_version"])
+                        )
+            except Exception as e:
+                errors.append((sid, repr(e)))
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=stateless_client, args=(i,)) for i in range(4)]
+        threads += [threading.Thread(target=session_client, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+
+        # let every session open and settle on a home
+        assert _wait(lambda: all(len(r) >= 3 for r in session_results), timeout=30)
+        homes_before_kill = {f"user-{i}": session_results[i][-1][2] for i in range(3)}
+
+        # ARM the process-tier chaos from the seeded schedule: SIGKILL one
+        # replica (the first live one: replica-0) 20 router ticks from now
+        inject.arm_from_cfg(
+            {"fault": {"chaos": {"enabled": True, "seed": 7, "events": ["serve.fleet.tick:kill-replica:20"]}}}
+        )
+        assert _wait(lambda: sup.replica("replica-0").kills >= 1, timeout=30), sup.describe()
+        killed = "replica-0"
+
+        # rolling swap lands MID-DRILL: a new complete save in the shared dir
+        CheckpointManager().save(
+            ckpt_dir / "ckpt_10_0.ckpt", {"agent": {"w": 2 * np.ones((2, 2), np.float32)}}, step=10
+        )
+        assert _wait(lambda: router.health()["fleet"]["fleet_version"] >= 10, timeout=30)
+        # the killed replica respawns on the SAME checkpoint dir and adopts
+        # the newest save (publish_current): the whole fleet converges on 10
+        assert _wait(
+            lambda: all(ep.ready for ep in eps) and all(ep.step >= 10 for ep in eps), timeout=60
+        ), router.health()
+        time.sleep(0.5)  # post-recovery traffic under the swapped weights
+        stop_clients.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        sample_stop.set()
+        sampler_thread.join(timeout=5)
+
+        # ZERO dropped, ZERO errors for every admitted request fleet-wide
+        assert errors == []
+        assert all(len(r) > 0 for r in stateless_results)
+
+        # per-client monotone weight versions fleet-wide, reaching the swap
+        for rows in stateless_results:
+            versions = [v for v, _r in rows]
+            assert versions == sorted(versions)
+            assert versions[-1] >= 10
+        for rows in session_results:
+            versions = [v for _c, _h, _r, v in rows]
+            assert versions == sorted(versions)
+            assert versions[-1] >= 10
+
+        # session streams: contiguous counts; exactly one REHOMED re-init
+        # (count back to 0, flagged) for sessions that lived on the killed
+        # replica, none anywhere else — never silently wrong state
+        rehomed_sessions = set()
+        for i in range(3):
+            sid = f"user-{i}"
+            rows = session_results[i]
+            rehomes = [k for k, (_c, rehomed, _r, _v) in enumerate(rows) if rehomed]
+            assert len(rehomes) <= 1, f"{sid}: multiple rehomes {rehomes}"
+            expected = 0.0
+            for k, (count, rehomed, _r, _v) in enumerate(rows):
+                if rehomed:
+                    expected = 0.0  # client-visible counted re-init
+                    rehomed_sessions.add(sid)
+                assert count == expected, f"{sid} step {k}: count {count} != {expected} (rows={rows[:k+1]})"
+                expected += 1.0
+        victims = {sid for sid, home in homes_before_kill.items() if home == killed}
+        assert rehomed_sessions == victims
+
+        # router counters: rehomed == sessions that lived on the killed
+        # replica; supervised respawn happened; SIGKILL detected AS a kill
+        health = router.health()
+        assert health["fleet"]["sessions_rehomed"] == len(victims)
+        handle = sup.replica(killed)
+        assert handle.restarts >= 1 and handle.kills >= 1 and handle.hangs == 0
+        assert handle.last_signal == "SIGKILL" or handle.restarts >= 1
+
+        # aggregated health walked ok -> degraded -> ok
+        assert statuses[0] == "ok"
+        assert "degraded" in statuses or "down" in statuses
+        assert health["status"] == "ok"
+    finally:
+        router.stop()
+
+
+def test_hang_replica_lease_expiry_is_counted_as_hang_not_kill(tmp_path):
+    """hang-replica chaos (SIGSTOP): the replica is ALIVE but silent — the
+    probe lease expires, the supervisor counts a HANG (not a kill),
+    SIGKILLs the wedged process itself and respawns it; traffic keeps
+    flowing on the survivor throughout."""
+    # lease/probe timeouts stay SHORT (they drive the hang detection);
+    # the request timeout stays generous — a respawning replica's jax
+    # import spikes this box's CPU and a tight request budget turns that
+    # into spurious failovers on the healthy survivor
+    router, sup, eps = _stand_up_fleet(2, None, lease_s=1.0, request_timeout_s=10.0)
+    try:
+        assert router.wait_ready(timeout_s=120)
+        inject.arm_from_cfg(
+            {"fault": {"chaos": {"enabled": True, "events": ["serve.fleet.tick:hang-replica:5"]}}}
+        )
+        # the wedged replica is detected and respawned
+        assert _wait(
+            lambda: sup.replica("replica-0").hangs >= 1 or sup.replica("replica-1").hangs >= 1,
+            timeout=30,
+        ), sup.describe()
+        hung = next(h for h in sup.replicas() if h.hangs >= 1)
+        assert hung.kills == 0  # distinct detection: a hang is not an external kill
+        # traffic flows throughout (the survivor carries it; the hung one rejoins)
+        for _ in range(10):
+            resp = router.serve_request({"obs": {"x": [[1.0, 2.0]]}, "n": 1})
+            assert "error" not in resp, resp
+            time.sleep(0.05)
+        assert _wait(lambda: all(ep.ready for ep in eps), timeout=60)
+        assert _wait(lambda: router.health()["status"] == "ok", timeout=30)
+        assert hung.restarts >= 1
+    finally:
+        router.stop()
+
+
+def test_stateful_sigterm_graceful_drain_exits_zero():
+    """Satellite: PR 10's drain proof was stateless-only. A STATEFUL session
+    server under SIGTERM must settle every admitted in-flight session batch
+    (contiguous per-session streams to the last served step), keep its
+    session counters coherent, and exit 0."""
+    proc = subprocess.Popen(
+        [sys.executable, REPLICA_MAIN, "--port", "0", "--stateful", "--max-wait-ms", "5"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    clients = []
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("REPLICA_READY"), line
+        host, port = line.split()[1].split(":")
+        addr = (host, int(port))
+
+        n_sessions = 3
+        per_session = [[] for _ in range(n_sessions)]
+        closed_errors = [0]
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def session_loop(i):
+            client = _RouterClient(addr)  # plain JSON-lines: same protocol
+            clients.append(client)
+            try:
+                while True:
+                    resp = client.request({"obs": {"x": [[1.0, 2.0]]}, "n": 1, "session_id": f"user-{i}"})
+                    if "error" in resp:
+                        # after the drain flag the ONLY acceptable error is
+                        # the typed closed-for-admission one
+                        assert "ServeClosedError" in resp["error"], resp
+                        with lock:
+                            closed_errors[0] += 1
+                        if stop.is_set():
+                            return
+                    else:
+                        per_session[i].append(resp["actions"][0][0])
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                return  # server fully gone after drain: EOF is clean
+
+        threads = [threading.Thread(target=session_loop, args=(i,)) for i in range(n_sessions)]
+        for t in threads:
+            t.start()
+        assert _wait(lambda: all(len(s) >= 5 for s in per_session), timeout=30)
+
+        proc.send_signal(signal.SIGTERM)  # mid-flight: requests are in the air
+        stop.set()
+        out, _ = proc.communicate(timeout=60)
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        for c in clients:
+            c.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+
+    assert proc.returncode == 0, f"non-zero exit after SIGTERM:\n{out}"
+    assert "received SIGTERM — graceful drain" in out
+    assert "serve: drained cleanly" in out
+    # every settled response extended its session stream CONTIGUOUSLY — the
+    # drain served in-flight session batches, it did not drop or reorder them
+    for i, counts in enumerate(per_session):
+        assert counts == [float(k) for k in range(len(counts))], f"user-{i}: {counts}"
+    # the final stats line is coherent: sessions opened == live clients, and
+    # the served totals cover every client-observed response
+    stats_line = next(l for l in out.splitlines() if l.startswith("{"))
+    stats = json.loads(stats_line)
+    assert stats["Serve/sessions_live"] == n_sessions
+    assert stats["Serve/sessions_opened"] == n_sessions
+    assert stats["Serve/rows"] >= sum(len(s) for s in per_session)
+    assert stats["Serve/sessions_reset"] == 0  # no silent re-inits during drain
+
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.run_test=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+]
+
+
+def _probe(addr, timeout=5.0):
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(b'{"health": true}\n')
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+@pytest.mark.slow
+def test_serve_fleet_cli_e2e_sigterm_drains_everything(tmp_path):
+    """The real CLI verb: ``serve --fleet 2`` on a trained checkpoint stands
+    up 2 supervised replica processes + the router, serves requests with
+    replica/fleet_version annotations, and SIGTERM drains the router then
+    every replica and exits 0."""
+    from sheeprl_tpu.cli import run
+
+    run(PPO_TINY + [f"log_root={tmp_path}/train", "dry_run=True", "checkpoint.save_last=True"])
+    ckpts = sorted(glob.glob(f"{tmp_path}/train/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+    assert ckpts
+    port = free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu",
+            "serve",
+            "--fleet",
+            "2",
+            f"checkpoint_path={ckpts[-1]}",
+            "fabric.accelerator=cpu",
+            f"serve.port={port}",
+            "serve.buckets=[1,2]",
+            "serve.log_every_s=60",
+            "serve.fleet.health_poll_s=0.2",
+        ],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        # replicas inherit the stdout pipe: a failure-path kill must sweep
+        # the whole process group or communicate() blocks on their write end
+        start_new_session=True,
+    )
+    try:
+        addr = ("127.0.0.1", port)
+        deadline = time.monotonic() + 300
+        while True:  # router is up once the socket answers; replicas follow
+            try:
+                health = _probe(addr)
+                if health.get("ready"):
+                    break
+            except (ConnectionRefusedError, OSError):
+                pass
+            assert proc.poll() is None, f"fleet died early:\n{proc.stdout.read()}"
+            assert time.monotonic() < deadline, "fleet never became ready"
+            time.sleep(0.5)
+        assert health["fleet"]["replicas"] == 2
+        assert _wait(lambda: _probe(addr)["fleet"]["ready"] == 2, timeout=240)
+        # one real request through router -> replica -> checkpointed policy
+        # (the dummy env observes a 10-dim "state" row)
+        with socket.create_connection(addr, timeout=30) as s:
+            s.sendall((json.dumps({"obs": {"state": [[0.1] * 10]}, "n": 1}) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                buf += s.recv(65536)
+        resp = json.loads(buf.decode())
+        assert "actions" in resp and "replica" in resp and "fleet_version" in resp, resp
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
+            proc.communicate(timeout=30)
+    assert proc.returncode == 0, f"non-zero exit after SIGTERM:\n{out}"
+    assert "received SIGTERM — graceful drain" in out
+    assert "serve: drained cleanly" in out
