@@ -68,6 +68,7 @@ import numpy as np
 from sheeprl_tpu.algos.dreamer_v3.agent import actor_sample, build_agent, extract_obs_masks
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
 from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, prepare_obs, test
+from sheeprl_tpu.analysis.lockstats import sync_lock
 from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.data.ring import pack_burst_blob
 from sheeprl_tpu.envs.factory import vectorize_env
@@ -399,7 +400,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # shared prefill account: actors act randomly until the GLOBAL number of
     # produced env-step rows passes learning_starts (coupled-loop semantics)
-    produced_lock = threading.Lock()
+    produced_lock = sync_lock("dreamer_sebulba.produced_lock")
     produced = {"iters": start_iter - 1}
 
     # -- actor-side jitted program -------------------------------------------

@@ -256,6 +256,9 @@ def main(fabric, cfg: Dict[str, Any]):
             player_errors.append(e)
             rollout_q.put(None)
 
+    # graft-sync: disable-next-line=GS004 — legacy decoupled driver (superseded by
+    # ppo_sebulba's supervised actor pool); its crash path already ferries the
+    # error to the trainer through player_errors + the queue sentinel
     player_thread = threading.Thread(target=player_fn, name="ppo-player", daemon=True)
     player_thread.start()
 

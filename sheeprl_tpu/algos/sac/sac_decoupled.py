@@ -254,6 +254,9 @@ def main(fabric, cfg: Dict[str, Any]):
             player_errors.append(e)
             batch_q.put(None)
 
+    # graft-sync: disable-next-line=GS004 — deprecated decoupled driver (superseded
+    # by sac_sebulba's supervised actor pool); its crash path already ferries the
+    # error to the trainer through player_errors + the queue sentinel
     player_thread = threading.Thread(target=player_fn, name="sac-player", daemon=True)
     player_thread.start()
 

@@ -84,6 +84,7 @@ import numpy as np
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.sac import make_resident_train_step, restore_train_state
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.analysis.lockstats import sync_lock
 from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.fault.inject import arm_from_cfg, fault_point
@@ -349,7 +350,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # shared prefill account: actors act randomly until the GLOBAL number of
     # produced env-step rows passes learning_starts (coupled-loop semantics)
-    produced_lock = threading.Lock()
+    produced_lock = sync_lock("sac_sebulba.produced_lock")
     produced = {"iters": start_iter - 1}
 
     # -- actor-side jitted program -------------------------------------------

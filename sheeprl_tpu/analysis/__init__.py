@@ -23,6 +23,20 @@ correctness):
     the checked-in per-program budget manifest (rules AUD001-AUD005). Run it
     as ``python -m sheeprl_tpu.analysis audit``.
 
+:mod:`sheeprl_tpu.analysis.sync` (+ ``syncgraph``, ``lockstats``)
+    The concurrency tier: a lockset/lock-order analysis over the async host
+    runtime (rules GS001-GS005) — per-class shared-state models, the
+    corpus-wide lock-acquisition-order graph (AB-BA cycles, incl.
+    call-mediated and cross-module), blocking-under-lock, raw threads
+    outside the supervisor wiring, if-guarded condition waits. Run it as
+    ``python -m sheeprl_tpu.analysis sync``. Its runtime twin is
+    :mod:`~sheeprl_tpu.analysis.lockstats`: instrumented lock wrappers the
+    hot concurrency classes construct through (opt-in via
+    ``SHEEPRL_TPU_SYNC_SANITIZE=1``, plain primitives when off) that record
+    the live acquisition-order graph and per-lock hold times, exported as a
+    dump (``SHEEPRL_TPU_SYNC_DUMP``) for ``analysis sync-validate`` — so
+    the seeded chaos drills double as sanitizer runs.
+
 :mod:`sheeprl_tpu.analysis.tracecheck`
     Runtime sentinel for what the static passes can't see: registered jit
     entry points record compilations per (function, abstract signature) and
@@ -33,9 +47,13 @@ correctness):
     artifact (``SHEEPRL_TPU_TRACECHECK_DUMP``). The Podracer line (Sebulba /
     Anakin, arXiv:2104.06272) attributes its throughput to exactly these
     invariants holding in the steady state.
+
+``python -m sheeprl_tpu.analysis all`` runs lint + sync + audit with one
+merged exit code and a single ``--format=github`` annotation stream.
 """
 
 from sheeprl_tpu.analysis.lint import Finding, RULES, analyze_paths, analyze_source
+from sheeprl_tpu.analysis.lockstats import LockStats, lockstats, sync_condition, sync_lock, sync_rlock
 from sheeprl_tpu.analysis.tracecheck import RetraceError, TraceCheck, tracecheck
 
 __all__ = [
@@ -46,6 +64,13 @@ __all__ = [
     "RetraceError",
     "TraceCheck",
     "tracecheck",
+    "LockStats",
+    "lockstats",
+    "sync_lock",
+    "sync_rlock",
+    "sync_condition",
+    # sync tier AST half (imported lazily to keep bare-lint startup light):
+    # sheeprl_tpu.analysis.sync / .syncgraph
     # audit tier (imported lazily — pulls jax + the algo registry):
     # sheeprl_tpu.analysis.audit / .programs / .budgets / .hlo
 ]

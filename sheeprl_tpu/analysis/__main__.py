@@ -1,11 +1,17 @@
-"""``python -m sheeprl_tpu.analysis`` — the graft-lint / graft-audit CLI.
+"""``python -m sheeprl_tpu.analysis`` — the graft-lint/audit/sync CLI.
 
-Three subcommands, one exit-code contract (CI relies on it):
+Subcommands, one exit-code contract (CI relies on it):
 
 - ``lint`` (the default — bare paths keep working): AST rules GL001-GL008;
 - ``audit``: AOT-lower every registered hot-path program on a virtual mesh
   and check donation aliasing, sharding declarations, dtype policy, baked
   constants, and the checked-in budget manifest (rules AUD001-AUD005);
+- ``sync``: race & deadlock analysis of the async host runtime — per-class
+  lockset model, lock-order graph, blocking-under-lock (rules GS001-GS005);
+- ``sync-validate``: judge a runtime lock-sanitizer dump
+  (``SHEEPRL_TPU_SYNC_DUMP``) — order cycles, inversions, over-budget holds;
+- ``all``: lint + sync + audit with one merged exit code and a single
+  ``--format=github`` annotation stream (the CI front door);
 - ``tracecheck``: validate a runtime trace-event dump
   (``SHEEPRL_TPU_TRACECHECK_DUMP``) — post-warmup retraces are findings.
 
@@ -42,13 +48,14 @@ from sheeprl_tpu.analysis.lint import (
 DEFAULT_BASELINE = ".graft-lint-baseline.json"
 
 
-def _parse_rules(spec: Optional[str]) -> Optional[set]:
+def _parse_rules(spec: Optional[str], catalog: Optional[Dict[str, str]] = None) -> Optional[set]:
+    catalog = RULES if catalog is None else catalog
     if not spec:
         return None
     rules = {r.strip().upper() for r in spec.split(",") if r.strip()}
-    unknown = rules - set(RULES)
+    unknown = rules - set(catalog)
     if unknown:
-        raise SystemExit2(f"unknown rule(s): {', '.join(sorted(unknown))} (known: {', '.join(sorted(RULES))})")
+        raise SystemExit2(f"unknown rule(s): {', '.join(sorted(unknown))} (known: {', '.join(sorted(catalog))})")
     return rules
 
 
@@ -61,20 +68,20 @@ def _emit_text(findings: List[Finding], out) -> None:
         print(f.render(), file=out)
 
 
-def _emit_github(findings: List[Finding], out) -> None:
+def _emit_github(findings: List[Finding], out, tool: str = "graft-lint") -> None:
     for f in findings:
         # '%' ',' and newlines must be escaped in workflow-command payloads
         msg = f.message.replace("%", "%25").replace("\r", "").replace("\n", "%0A")
         print(
-            f"::error file={f.path},line={f.line},col={f.col},title=graft-lint {f.rule}::{msg} [in {f.function}]",
+            f"::error file={f.path},line={f.line},col={f.col},title={tool} {f.rule}::{msg} [in {f.function}]",
             file=out,
         )
 
 
-def _emit_json(findings: List[Finding], baselined: int, out) -> None:
+def _emit_json(findings: List[Finding], baselined: int, out, tool: str = "graft-lint", rules=None) -> None:
     payload = {
-        "tool": "graft-lint",
-        "rules": RULES,
+        "tool": tool,
+        "rules": RULES if rules is None else rules,
         "baselined": baselined,
         "findings": [
             {
@@ -428,6 +435,128 @@ def audit_main(argv: List[str]) -> int:
 
 
 # --------------------------------------------------------------------------- #
+# sync subcommand (graft-sync: race & deadlock analysis, rules GS001-GS005)
+# --------------------------------------------------------------------------- #
+
+
+def sync_main(argv: List[str]) -> int:
+    from sheeprl_tpu.analysis.sync import SYNC_RULES, analyze_sync_paths
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis sync",
+        description="graft-sync: race & deadlock static analysis over the async host runtime (GS001-GS005).",
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/dirs to analyze")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
+    parser.add_argument("--select", help="comma-separated rules to run (default: all)")
+    parser.add_argument("--ignore", help="comma-separated rules to skip")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(SYNC_RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    try:
+        select = _parse_rules(args.select, catalog=SYNC_RULES)
+        ignore = _parse_rules(args.ignore, catalog=SYNC_RULES)
+    except SystemExit2 as e:
+        print(f"graft-sync: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        findings = analyze_sync_paths(args.paths, select=select, ignore=ignore)
+    except Exception as e:  # pragma: no cover - internal error contract
+        print(f"graft-sync: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        _emit_json(findings, 0, sys.stdout, tool="graft-sync", rules=SYNC_RULES)
+    elif args.format == "github":
+        _emit_github(findings, sys.stdout, tool="graft-sync")
+    else:
+        _emit_text(findings, sys.stdout)
+    print(f"graft-sync: {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+def sync_validate_main(argv: List[str]) -> int:
+    from sheeprl_tpu.analysis.lockstats import validate_payload
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis sync-validate",
+        description=(
+            "Validate a graft-sync runtime-sanitizer dump (SHEEPRL_TPU_SYNC_DUMP): "
+            "lock-order cycles, recorded inversions and over-budget holds are findings."
+        ),
+    )
+    parser.add_argument("dump", help="path to the JSON dump a sanitized run exported")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.dump, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        if payload.get("tool") != "graft-sync":
+            raise ValueError(f"not a graft-sync dump (tool={payload.get('tool')!r})")
+        problems, summary = validate_payload(payload)
+    except (OSError, ValueError, json.JSONDecodeError, AttributeError) as e:
+        print(f"sync-validate: unreadable dump {args.dump}: {e}", file=sys.stderr)
+        return 2
+    for p in problems:
+        print(f"SYNC {p}")
+    print(
+        "sync-validate: {locks} lock(s), {edges} order edge(s) — {cycles} cycle(s), "
+        "{inversions} inversion(s), {over_budget_locks} over-budget lock(s)".format(**summary),
+        file=sys.stderr,
+    )
+    return 1 if problems else 0
+
+
+# --------------------------------------------------------------------------- #
+# all subcommand: lint + sync + audit, one merged exit code / annotation stream
+# --------------------------------------------------------------------------- #
+
+
+def all_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis all",
+        description=(
+            "Run every static tier — graft-lint (GL), graft-sync (GS), graft-audit (AUD) — "
+            "with one merged exit code and a single --format stream (CI runs exactly this)."
+        ),
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/dirs for the AST tiers")
+    parser.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="text or github (line-oriented streams that concatenate cleanly); "
+        "for machine-readable JSON run the individual tiers, each emits one document",
+    )
+    parser.add_argument("--mesh", default="dp=2", help="virtual audit mesh (default dp=2)")
+    parser.add_argument("--tolerance", type=float, default=None, help="audit budget tolerance override")
+    parser.add_argument("--skip-audit", action="store_true", help="AST tiers only (no compile pass)")
+    args = parser.parse_args(argv)
+
+    rcs = [lint_main(list(args.paths) + ["--format", args.format])]
+    rcs.append(sync_main(list(args.paths) + ["--format", args.format]))
+    if not args.skip_audit:
+        audit_argv = ["--format", args.format, "--mesh", args.mesh]
+        if args.tolerance is not None:
+            audit_argv += ["--tolerance", str(args.tolerance)]
+        rcs.append(audit_main(audit_argv))
+    print(
+        "analysis all: lint={} sync={}{}".format(
+            rcs[0], rcs[1], f" audit={rcs[2]}" if len(rcs) > 2 else " audit=skipped"
+        ),
+        file=sys.stderr,
+    )
+    if any(rc == 2 for rc in rcs):
+        return 2
+    return 1 if any(rc == 1 for rc in rcs) else 0
+
+
+# --------------------------------------------------------------------------- #
 # tracecheck-dump subcommand
 # --------------------------------------------------------------------------- #
 
@@ -471,6 +600,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return audit_main(argv[1:])
     if argv and argv[0] == "tracecheck":
         return tracecheck_main(argv[1:])
+    if argv and argv[0] == "sync":
+        return sync_main(argv[1:])
+    if argv and argv[0] == "sync-validate":
+        return sync_validate_main(argv[1:])
+    if argv and argv[0] == "all":
+        return all_main(argv[1:])
     if argv and argv[0] == "lint":
         argv = argv[1:]
     return lint_main(argv)

@@ -73,6 +73,7 @@ __all__ = [
     "apply_baseline",
     "fingerprint",
     "iter_python_files",
+    "collect_suppressions",
 ]
 
 RULES: Dict[str, str] = {
@@ -117,7 +118,65 @@ _TRACE_WRAPPERS = {
 # ``lax.map``/``jax.tree.map`` deliberately excluded: ``tree.map`` callbacks
 # run eagerly on host in host code, and bare ``map`` is the builtin.
 
-_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*(disable(?:-next-line)?)\s*(?:=\s*([A-Z0-9,\s]+))?")
+def _suppress_re(tool: str) -> "re.Pattern[str]":
+    return re.compile(rf"#\s*{tool}:\s*(disable(?:-next-line)?)\s*(?:=\s*([A-Z0-9,\s]+))?")
+
+
+_SUPPRESS_RE = _suppress_re("graft-lint")
+
+
+def collect_suppressions(src: str, tool: str = "graft-lint") -> Dict[int, Optional[Set[str]]]:
+    """``line -> suppressed rules`` (``None`` = all) for ``# <tool>: disable``
+    comments. ONE implementation for every AST tier (graft-lint, graft-sync)
+    so the directive semantics cannot drift: ``disable-next-line`` skips over
+    continuation COMMENT lines to the next code line, because suppressions
+    are required to carry a justification comment and justifications wrap."""
+    pattern = _suppress_re(tool)
+    lines: Dict[int, Optional[Set[str]]] = {}
+    code_lines: Set[int] = set()
+    pending: List[Tuple[int, Optional[Set[str]]]] = []
+
+    def merge(line: int, rules: Optional[Set[str]]) -> None:
+        prev = lines.get(line)
+        if prev is None and line in lines:
+            return  # already suppress-all
+        if rules is None:
+            lines[line] = None
+        else:
+            lines[line] = (prev or set()) | rules
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type not in (
+                tokenize.COMMENT,
+                tokenize.NL,
+                tokenize.NEWLINE,
+                tokenize.INDENT,
+                tokenize.DEDENT,
+                tokenize.ENDMARKER,
+            ):
+                code_lines.add(tok.start[0])
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = pattern.search(tok.string)
+            if not m:
+                continue
+            rules = None
+            if m.group(2):
+                rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-next-line":
+                pending.append((tok.start[0], rules))
+            else:
+                merge(tok.start[0], rules)
+    except tokenize.TokenError:  # pragma: no cover - half-written files
+        pass
+    max_line = max(code_lines, default=0)
+    for start, rules in pending:
+        line = start + 1
+        while line <= max_line and line not in code_lines:
+            line += 1
+        merge(line, rules)
+    return lines
 
 
 @dataclass(frozen=True)
@@ -155,27 +214,7 @@ class _ModuleContext:
         self._collect_suppressions()
 
     def _collect_suppressions(self) -> None:
-        try:
-            tokens = tokenize.generate_tokens(io.StringIO(self.src).readline)
-            for tok in tokens:
-                if tok.type != tokenize.COMMENT:
-                    continue
-                m = _SUPPRESS_RE.search(tok.string)
-                if not m:
-                    continue
-                line = tok.start[0] + (1 if m.group(1) == "disable-next-line" else 0)
-                rules = None
-                if m.group(2):
-                    rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
-                prev = self.suppressed.get(line)
-                if prev is None and line in self.suppressed:
-                    continue  # already suppress-all
-                if rules is None:
-                    self.suppressed[line] = None
-                else:
-                    self.suppressed[line] = (prev or set()) | rules
-        except tokenize.TokenError:  # pragma: no cover - half-written files
-            pass
+        self.suppressed = collect_suppressions(self.src, tool="graft-lint")
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         if line not in self.suppressed:

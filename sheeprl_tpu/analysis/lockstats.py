@@ -1,0 +1,448 @@
+"""graft-sync runtime sanitizer: instrumented locks with order + hold tracking.
+
+The static tier (:mod:`sheeprl_tpu.analysis.sync`) proves lock-order and
+lockset properties from the AST; this module is its runtime twin — the
+tracecheck of the concurrency layer. The hot concurrency classes (the thread
+and process supervisors, the fleet router, the serve scheduler's stats, the
+session cache/engine, ``ParamServer``, the burst trainer) construct their
+locks through the factories here:
+
+- :func:`sync_lock` / :func:`sync_rlock` / :func:`sync_condition`
+
+With ``SHEEPRL_TPU_SYNC_SANITIZE`` unset (the default) each factory returns
+the plain ``threading`` primitive — zero wrapper, zero cost, byte-identical
+behavior. With ``SHEEPRL_TPU_SYNC_SANITIZE=1`` they return instrumented
+wrappers that record, process-wide:
+
+- the **acquisition-order graph**: attempting lock B while holding lock A
+  records the directed edge A→B (at ATTEMPT time, so an acquire that times
+  out against a deadlock still leaves its evidence);
+- **order inversions**, live: an attempt whose edge closes a cycle against
+  the already-recorded graph (the AB-BA shape) warns immediately and is
+  counted — a chaos drill that interleaves the race trips it, and a drill
+  that doesn't STILL records both edges for the dump-time cycle check;
+- **per-lock hold times**: max hold per lock and a count of holds past the
+  budget (``SHEEPRL_TPU_SYNC_HOLD_BUDGET_S``, default 5.0 s) — the
+  blocking-under-lock class (GS003) measured instead of inferred.
+
+The ledger exports as a JSON dump (``SHEEPRL_TPU_SYNC_DUMP=path``, written
+atomically at process exit; a literal ``{pid}`` in the path is substituted so
+supervised replica subprocesses don't clobber each other) and is validated by
+``python -m sheeprl_tpu.analysis sync-validate <dump>`` — exit 1 on any
+cycle, recorded inversion, or over-budget hold. The chaos pytest lane runs
+with the sanitizer armed and asserts a clean ledger at session end
+(``tests/conftest.py``), so every seeded drill doubles as a sanitizer run.
+
+Dependency-free by design (stdlib only): the supervision runtime imports
+this, and it must stay importable before/without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockStats",
+    "lockstats",
+    "sync_lock",
+    "sync_rlock",
+    "sync_condition",
+    "validate_payload",
+]
+
+_ENV_ENABLE = "SHEEPRL_TPU_SYNC_SANITIZE"
+_ENV_BUDGET = "SHEEPRL_TPU_SYNC_HOLD_BUDGET_S"
+_ENV_DUMP = "SHEEPRL_TPU_SYNC_DUMP"
+
+
+class LockStats:
+    """One process-wide ledger of lock acquisitions (see module docstring).
+
+    All registry state is guarded by one RAW ``threading.Lock`` (never an
+    instrumented one — the sanitizer must not recurse into itself); the
+    per-thread held-lock stack rides a ``threading.local``.
+    """
+
+    def __init__(self, enabled: Optional[bool] = None, budget_s: Optional[float] = None) -> None:
+        self.enabled = (
+            os.environ.get(_ENV_ENABLE, "").strip() == "1" if enabled is None else bool(enabled)
+        )
+        if budget_s is not None:
+            self.budget_s = float(budget_s)
+        else:
+            env_budget = os.environ.get(_ENV_BUDGET, "").strip()
+            try:
+                self.budget_s = float(env_budget) if env_budget else 5.0
+            except ValueError:
+                # the singleton constructs at package import: a typo'd env var
+                # must degrade to the default, not kill every training run
+                warnings.warn(
+                    f"graft-sync: ignoring malformed {_ENV_BUDGET}={env_budget!r} "
+                    "(not a float) — using the 5.0s default",
+                    RuntimeWarning,
+                )
+                self.budget_s = 5.0
+        self._guard = threading.Lock()
+        self._tls = threading.local()
+        self._edges: Dict[Tuple[str, str], int] = {}  # (held, acquired) -> count
+        self._locks: Dict[str, Dict[str, Any]] = {}  # name -> counters
+        self._inversions: List[Dict[str, Any]] = []
+        self._inverted_pairs: Set[Tuple[str, str]] = set()  # dedup (sorted pair)
+
+    # -- configuration ------------------------------------------------------- #
+
+    def configure(self, enabled: Optional[bool] = None, budget_s: Optional[float] = None) -> None:
+        """Flip the sanitizer for locks constructed AFTER this call (the
+        factories decide plain-vs-instrumented at construction)."""
+        with self._guard:  # budget_s is read under the guard in note_released
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if budget_s is not None:
+                self.budget_s = float(budget_s)
+
+    def reset(self) -> None:
+        with self._guard:
+            self._edges.clear()
+            self._locks.clear()
+            self._inversions.clear()
+            self._inverted_pairs.clear()
+
+    # -- per-thread stack ---------------------------------------------------- #
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    # -- recording (called by the wrappers) ---------------------------------- #
+
+    def _lock_row_locked(self, name: str) -> Dict[str, Any]:
+        row = self._locks.get(name)
+        if row is None:
+            row = self._locks[name] = {
+                "acquisitions": 0,
+                "contended": 0,
+                "max_hold_s": 0.0,
+                "over_budget": 0,
+            }
+        return row
+
+    def note_attempt(self, name: str) -> None:
+        """Record the order edges of an acquisition ATTEMPT (held -> name) and
+        detect inversions live. Runs before blocking, so a timed-out acquire
+        against a real deadlock still records its half of the cycle."""
+        held = self._held()
+        if not held or held[-1] == name:
+            return
+        new_inversions: List[Tuple[str, str]] = []
+        with self._guard:
+            for h in held:
+                if h == name:
+                    continue  # re-entrant / condition re-acquire
+                edge = (h, name)
+                self._edges[edge] = self._edges.get(edge, 0) + 1
+                if (name, h) in self._edges:
+                    pair = (min(h, name), max(h, name))
+                    if pair not in self._inverted_pairs:
+                        self._inverted_pairs.add(pair)
+                        self._inversions.append(
+                            {"a": h, "b": name, "thread": threading.current_thread().name}
+                        )
+                        new_inversions.append((h, name))
+        for h, n in new_inversions:
+            warnings.warn(
+                f"graft-sync sanitizer: lock-order INVERSION — this thread acquires "
+                f"'{n}' while holding '{h}', but the opposite order '{n}' -> '{h}' was "
+                "also recorded in this process (AB-BA deadlock shape)",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+
+    def note_acquired(self, name: str, contended: bool) -> None:
+        self._held().append(name)
+        with self._guard:
+            row = self._lock_row_locked(name)
+            row["acquisitions"] += 1
+            row["contended"] += int(contended)
+
+    def note_released(self, name: str, hold_s: float) -> None:
+        held = self._held()
+        # release order may not be LIFO (rare but legal): drop the newest match
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+        with self._guard:
+            row = self._lock_row_locked(name)
+            row["max_hold_s"] = max(row["max_hold_s"], hold_s)
+            budget = self.budget_s
+            over = hold_s > budget
+            if over:
+                row["over_budget"] += 1
+        if over:  # the GUARDED verdict: warning and counter can never disagree
+            warnings.warn(
+                f"graft-sync sanitizer: lock '{name}' held for {hold_s:.3f}s "
+                f"(budget {budget:g}s)",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+
+    # -- factories ----------------------------------------------------------- #
+
+    def lock(self, name: str):
+        if not self.enabled:
+            return threading.Lock()
+        return _InstrumentedLock(self, name, threading.Lock(), reentrant=False)
+
+    def rlock(self, name: str):
+        if not self.enabled:
+            return threading.RLock()
+        return _InstrumentedLock(self, name, threading.RLock(), reentrant=True)
+
+    def condition(self, name: str):
+        if not self.enabled:
+            return threading.Condition()
+        return threading.Condition(_InstrumentedLock(self, name, threading.Lock(), reentrant=False))
+
+    # -- reporting ----------------------------------------------------------- #
+
+    def report(self) -> Dict[str, Any]:
+        with self._guard:
+            return {
+                "tool": "graft-sync",
+                "budget_s": self.budget_s,
+                "edges": [
+                    {"from": a, "to": b, "count": n} for (a, b), n in sorted(self._edges.items())
+                ],
+                "locks": {name: dict(row) for name, row in sorted(self._locks.items())},
+                "inversions": [dict(v) for v in self._inversions],
+            }
+
+    def dump(self, path: str) -> Dict[str, Any]:
+        """Atomic JSON export (tmp + rename — a killed process leaves the
+        previous artifact intact); ``{pid}`` in ``path`` is substituted."""
+        payload = self.report()
+        path = path.replace("{pid}", str(os.getpid()))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=2)
+                fh.write("\n")
+            os.replace(tmp, path)
+        except OSError as e:  # pragma: no cover - exit-path best effort
+            warnings.warn(f"graft-sync: could not write dump {path}: {e}", RuntimeWarning)
+        return payload
+
+
+class _InstrumentedLock:
+    """Lock/RLock wrapper feeding a :class:`LockStats` ledger.
+
+    Condition-compatible: exposes ``_is_owned`` so ``threading.Condition``
+    never probes ownership with a spurious ``acquire(0)``, and ``wait()``'s
+    release/re-acquire cycles flow through the instrumented acquire/release
+    (each wait re-acquisition re-records the hold window).
+    """
+
+    __slots__ = ("_stats", "_name", "_raw", "_reentrant", "_tls")
+
+    def __init__(self, stats: LockStats, name: str, raw: Any, reentrant: bool) -> None:
+        self._stats = stats
+        self._name = name
+        self._raw = raw
+        self._reentrant = reentrant
+        self._tls = threading.local()  # depth + acquire stamp, per thread
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        depth = self._depth()
+        if depth == 0:
+            self._stats.note_attempt(self._name)
+        contended = False
+        if blocking and timeout == -1:
+            # fast path probe so contention is observable without timing
+            got = self._raw.acquire(blocking=False)
+            if not got:
+                contended = True
+                got = self._raw.acquire()
+        else:
+            got = self._raw.acquire(blocking, timeout)
+        if not got:
+            return False
+        if depth == 0:
+            self._stats.note_acquired(self._name, contended)
+            self._tls.t0 = time.monotonic()
+        self._tls.depth = depth + 1
+        return True
+
+    def release(self) -> None:
+        depth = self._depth()
+        self._raw.release()
+        if depth <= 0:
+            # cross-thread release (a Lock handoff): legal for threading.Lock
+            # but unattributable here — the acquirer's hold window stays open
+            # in its own thread-local state. Don't corrupt THIS thread's depth
+            # (a negative depth would silently disable its future recording).
+            return
+        self._tls.depth = depth - 1
+        if depth == 1:
+            self._stats.note_released(self._name, time.monotonic() - getattr(self._tls, "t0", time.monotonic()))
+
+    def locked(self) -> bool:
+        probe = getattr(self._raw, "locked", None)
+        if probe is not None:
+            return probe()
+        return self._depth() > 0  # RLock pre-3.12 has no locked()
+
+    def _is_owned(self) -> bool:  # threading.Condition ownership probe
+        return self._depth() > 0
+
+    def __enter__(self) -> "_InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<graft-sync {'RLock' if self._reentrant else 'Lock'} {self._name!r} depth={self._depth()}>"
+
+
+# --------------------------------------------------------------------------- #
+# dump validation (shared by the CLI verb and the pytest session hook)
+# --------------------------------------------------------------------------- #
+
+
+def _graph_cycles(edges: Dict[Tuple[str, str], int]) -> List[List[str]]:
+    """Strongly connected components of size >= 2 in the order graph (a
+    2-cycle IS the AB-BA shape; longer cycles are the generalized inversion).
+    Self-edges never exist (the recorder skips re-entrant holds)."""
+    adj: Dict[str, List[str]] = {}
+    nodes: List[str] = []
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        for n in (a, b):
+            if n not in adj or n not in nodes:
+                if n not in nodes:
+                    nodes.append(n)
+                adj.setdefault(n, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the graph is tiny; recursion limits still avoided)
+        work = [(v, iter(adj[v]))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) >= 2:
+                    sccs.append(sorted(comp))
+
+    for n in nodes:
+        if n not in index:
+            strongconnect(n)
+    return sccs
+
+
+def validate_payload(payload: Dict[str, Any]) -> Tuple[List[str], Dict[str, Any]]:
+    """Judge one sanitizer dump; returns ``(problems, summary)`` where an
+    empty ``problems`` list means the ledger is clean (exit 0)."""
+    edges = {(e["from"], e["to"]): int(e.get("count", 1)) for e in payload.get("edges", ())}
+    cycles = _graph_cycles(edges)
+    inversions = list(payload.get("inversions", ()))
+    over_budget = {
+        name: int(row.get("over_budget", 0))
+        for name, row in payload.get("locks", {}).items()
+        if int(row.get("over_budget", 0)) > 0
+    }
+    problems: List[str] = []
+    for cyc in cycles:
+        problems.append(f"lock-order cycle: {' -> '.join(cyc + [cyc[0]])}")
+    for inv in inversions:
+        problems.append(
+            f"recorded inversion: '{inv.get('a')}' <-> '{inv.get('b')}' (thread {inv.get('thread')})"
+        )
+    for name, n in sorted(over_budget.items()):
+        row = payload.get("locks", {}).get(name, {})
+        problems.append(
+            f"over-budget hold: '{name}' x{n} (max {row.get('max_hold_s', 0):.3f}s "
+            f"> budget {payload.get('budget_s', 0):g}s)"
+        )
+    summary = {
+        "locks": len(payload.get("locks", {})),
+        "edges": len(edges),
+        "cycles": len(cycles),
+        "inversions": len(inversions),
+        "over_budget_locks": len(over_budget),
+    }
+    return problems, summary
+
+
+#: process-wide singleton — the production classes build their locks on it.
+lockstats = LockStats()
+
+if os.environ.get(_ENV_DUMP, "").strip():
+    import atexit
+
+    atexit.register(lockstats.dump, os.environ[_ENV_DUMP].strip())
+
+
+def sync_lock(name: str):
+    """A ``threading.Lock`` (plain when the sanitizer is off, instrumented
+    under ``SHEEPRL_TPU_SYNC_SANITIZE=1``). ``name`` should be the owning
+    ``Class.attr`` so dumps read like the static tier's lock tokens."""
+    return lockstats.lock(name)
+
+
+def sync_rlock(name: str):
+    """The re-entrant twin of :func:`sync_lock`."""
+    return lockstats.rlock(name)
+
+
+def sync_condition(name: str):
+    """A ``threading.Condition`` over an instrumented lock (plain when off)."""
+    return lockstats.condition(name)

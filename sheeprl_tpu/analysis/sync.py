@@ -1,0 +1,311 @@
+"""graft-sync: race & deadlock static analysis for the async host runtime.
+
+The fourth analysis tier. graft-lint sees JAX ASTs, tracecheck sees runtime
+retraces, graft-audit sees lowered HLO — and none of them sees the Python
+concurrency layer where Sample Factory-style architectures (arXiv
+2006.11751) put all their subtle bugs: the thread/process supervisors, the
+fleet router, the serve scheduler, session slabs, param servers and
+deadline-guarded queues. GA3C (arXiv 1611.06256) is explicit that the
+predictor-queue tier's correctness is an ORDERING property — exactly the
+class a lockset/lock-order analysis proves statically instead of sampling
+dynamically. The models come from :mod:`sheeprl_tpu.analysis.syncgraph`;
+this module owns the rules, suppressions and findings:
+
+GS001  Unguarded shared mutable state: within a class that owns a lock, an
+       ``__init__``-declared attribute is accessed under the class's lock in
+       one place and WRITTEN outside it in another — the lockset says the
+       author believes the field needs the lock, and the unguarded write is
+       the torn update the chaos drills can only sample.
+GS002  Potential AB-BA deadlock: a cycle in the corpus-wide lock-acquisition
+       -order graph (direct nesting or call-mediated, across classes), or a
+       non-reentrant lock re-acquired while already held (self-deadlock).
+GS003  Blocking call under a held lock: ``queue.get/put`` without a timeout,
+       ``.join()`` / ``.result()`` without a timeout, socket
+       ``recv/recvfrom/accept``, ``jax.block_until_ready`` — each one turns
+       every other acquirer of that lock into a hostage of the blocked
+       operation (and under GS002's graph, into a deadlock candidate).
+GS004  Raw ``threading.Thread`` outside the supervisor wiring: PR 10 put
+       every async worker under heartbeat leases and the
+       restart→degrade→abort ladder; a raw thread dies silently and hangs
+       invisibly. (The supervisor's own spawn site is the one allowlisted
+       place threads may be born.)
+GS005  ``Condition.wait`` without an enclosing ``while`` predicate loop:
+       condition waits are specified to allow spurious wakeups, and a
+       notify can race the predicate — an ``if``-guarded (or bare) wait
+       proceeds on a stale predicate. ``wait_for`` is exempt (it loops
+       internally).
+
+Suppression: append ``# graft-sync: disable=GSxxx[,GSyyy]`` (or a bare
+``disable``) to the offending line, or ``# graft-sync: disable-next-line=...``
+on the line above. The shipped tree carries an EMPTY baseline by policy:
+every suppression needs an inline justification comment (PR 9's precedent),
+and real findings get fixed, not baselined. The runtime twin of this tier is
+:mod:`sheeprl_tpu.analysis.lockstats` — wrappers the hot classes construct
+their locks through, turning every chaos drill into a sanitizer run.
+
+CLI (same contract as graft-lint — exit 0 clean / 1 findings / 2 error):
+
+    python -m sheeprl_tpu.analysis sync [paths] [--format=text|json|github]
+    python -m sheeprl_tpu.analysis sync --list-rules
+    python -m sheeprl_tpu.analysis sync-validate <sanitizer-dump.json>
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.lint import Finding, collect_suppressions, iter_python_files
+from sheeprl_tpu.analysis.syncgraph import Corpus
+
+__all__ = [
+    "SYNC_RULES",
+    "analyze_sync_sources",
+    "analyze_sync_paths",
+    "analyze_source_sync",
+]
+
+SYNC_RULES: Dict[str, str] = {
+    "GS001": "shared attribute written outside the class's lock that guards it elsewhere",
+    "GS002": "cycle in the lock-acquisition-order graph (potential AB-BA deadlock)",
+    "GS003": "blocking call while holding a lock",
+    "GS004": "raw threading.Thread spawned outside the supervisor wiring",
+    "GS005": "Condition.wait without an enclosing while-predicate loop",
+}
+
+# the one place raw threads may be born: the supervisor IS the wiring every
+# other thread must ride
+_GS004_ALLOW = ("sheeprl_tpu/fault/supervisor.py",)
+
+class _Suppressions:
+    """Per-file ``# graft-sync: disable=...`` comment map — the SHARED
+    :func:`~sheeprl_tpu.analysis.lint.collect_suppressions` machinery with
+    the graft-sync tool tag, so directive semantics are identical across
+    tiers (incl. ``disable-next-line`` skipping continuation comments)."""
+
+    def __init__(self, src: str) -> None:
+        self.lines = collect_suppressions(src, tool="graft-sync")
+
+    def active(self, rule: str, line: int) -> bool:
+        if line not in self.lines:
+            return False
+        rules = self.lines[line]
+        return rules is None or rule in rules
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def analyze_sync_sources(
+    sources: Sequence[Tuple[str, str]],
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run the GS rules over ``(src, path)`` pairs as ONE corpus (GS002's
+    order graph is cross-module by design)."""
+    corpus = Corpus()
+    suppressions: Dict[str, _Suppressions] = {}
+    findings: List[Finding] = []
+    for src, path in sources:
+        suppressions[path] = _Suppressions(src)
+        err = corpus.add_source(src, path)
+        if err is not None:
+            findings.append(Finding("GS000", path, err[0], 1, f"syntax error: {err[1]}", "<module>"))
+    corpus.finalize()
+
+    def report(rule: str, path: str, line: int, col: int, message: str, qualname: str) -> None:
+        if select is not None and rule not in select:
+            return
+        if ignore is not None and rule in ignore:
+            return
+        sup = suppressions.get(path)
+        if sup is not None and sup.active(rule, line):
+            return
+        findings.append(Finding(rule, path, line, col, message, qualname))
+
+    _rule_gs001(corpus, report)
+    _rule_gs002(corpus, report)
+    for module in corpus.modules:
+        for b in module.blocking:
+            report(
+                "GS003",
+                module.path,
+                b.line,
+                b.col,
+                f"blocking {b.desc} while holding {_fmt_locks(b.held)} — every other "
+                "acquirer is a hostage of this wait (bound it with a timeout or move it "
+                "outside the lock)",
+                b.qualname,
+            )
+        for s in module.spawns:
+            if any(_norm(module.path).endswith(allow) for allow in _GS004_ALLOW):
+                continue
+            report(
+                "GS004",
+                module.path,
+                s.line,
+                s.col,
+                "raw threading.Thread outside the supervisor wiring — it dies silently and "
+                "hangs invisibly; spawn it through fault.supervisor.Supervisor (heartbeat "
+                "lease + restart ladder) instead",
+                s.qualname,
+            )
+        for w in module.waits:
+            if w.in_while:
+                continue
+            report(
+                "GS005",
+                module.path,
+                w.line,
+                w.col,
+                f"{w.token}.wait() without an enclosing while-predicate loop — condition "
+                "waits allow spurious wakeups and notify can race the predicate; use "
+                "`while not pred: cond.wait()` (or wait_for)",
+                w.qualname,
+            )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _fmt_locks(held: Tuple[str, ...]) -> str:
+    return " + ".join(f"'{t}'" for t in held)
+
+
+def _rule_gs001(corpus: Corpus, report) -> None:
+    for module in corpus.modules:
+        for cls in module.classes.values():
+            eff = corpus.effective_lock_attrs(cls)
+            if not eff:
+                continue
+            class_tokens = {token for token, _kind in eff.values()}
+            shared = cls.init_attrs - set(eff.keys())
+            entries = sorted(cls.thread_entries)
+            for attr in sorted(shared):
+                guarded: List = []
+                unguarded_writes: List = []
+                for method in cls.methods.values():
+                    for a in method.accesses:
+                        if a.attr != attr:
+                            continue
+                        if set(a.held) & class_tokens:
+                            guarded.append(a)
+                        elif a.write and not a.init_scope:
+                            unguarded_writes.append(a)
+                if not guarded or not unguarded_writes:
+                    continue
+                site = min(unguarded_writes, key=lambda a: (a.line, a.col))
+                gsite = min(guarded, key=lambda a: (a.line, a.col))
+                via = f" (thread entries: {', '.join(entries)})" if entries else ""
+                report(
+                    "GS001",
+                    module.path,
+                    site.line,
+                    site.col,
+                    f"`self.{attr}` is written here without {_fmt_locks(tuple(sorted(class_tokens)))} "
+                    f"but is accessed under it at line {gsite.line} ({gsite.qualname}) — an "
+                    f"unguarded write to lock-guarded shared state{via}",
+                    site.qualname,
+                )
+
+
+def _rule_gs002(corpus: Corpus, report) -> None:
+    # self-deadlock: a non-reentrant lock (or a Condition, which wraps one by
+    # default) re-acquired while already held — directly nested, or reached
+    # through a resolvable call made under the lock
+    memo: Dict = {}
+    for module in corpus.modules:
+        for cls in module.classes.values():
+            for method in cls.methods.values():
+                for acq in method.acquisitions:
+                    if acq.kind in ("lock", "condition") and acq.token in acq.held_before:
+                        report(
+                            "GS002",
+                            module.path,
+                            acq.line,
+                            acq.col,
+                            f"'{acq.token}' is a non-reentrant "
+                            f"{'Condition' if acq.kind == 'condition' else 'Lock'} already "
+                            "held here — re-acquiring it self-deadlocks (use an RLock or "
+                            "restructure)",
+                            acq.qualname,
+                        )
+                for call in method.calls:
+                    if not call.held:
+                        continue
+                    callee = corpus._resolve_call(cls, call)
+                    if callee is None:
+                        continue
+                    for token, kind in corpus.may_acquire(callee[0], callee[1], memo):
+                        if kind in ("lock", "condition") and token in call.held:
+                            report(
+                                "GS002",
+                                module.path,
+                                call.line,
+                                call.col,
+                                f"this call re-acquires the non-reentrant "
+                                f"{'Condition' if kind == 'condition' else 'Lock'} "
+                                f"'{token}' already held here (via "
+                                f"{callee[0].name}.{callee[1]}) — a guaranteed "
+                                "self-deadlock (use an RLock or restructure)",
+                                call.qualname,
+                            )
+    # AB-BA: cycles in the corpus-wide order graph
+    from sheeprl_tpu.analysis.lockstats import _graph_cycles
+
+    edges = corpus.lock_order_edges()
+    cycles = _graph_cycles({k: len(v) for k, v in edges.items()})
+    for cyc in cycles:
+        members = set(cyc)
+        sites: List[Tuple[str, str, int, str, str]] = []  # (path, qual, line, held, acquired)
+        for (held, acquired), locs in sorted(edges.items()):
+            if held in members and acquired in members:
+                path, qual, line = locs[0]
+                sites.append((path, qual, line, held, acquired))
+        if not sites:
+            continue
+        anchor = min(sites, key=lambda s: (s[0], s[2]))
+        detail = "; ".join(
+            f"{held} -> {acquired} at {path}:{line} ({qual})"
+            for path, qual, line, held, acquired in sites[:4]
+        )
+        report(
+            "GS002",
+            anchor[0],
+            anchor[2],
+            1,
+            f"lock-acquisition-order cycle {' -> '.join(cyc + [cyc[0]])} — two threads "
+            f"taking opposite orders deadlock (AB-BA). Edges: {detail}",
+            anchor[1],
+        )
+
+
+def analyze_source_sync(
+    src: str,
+    path: str = "<string>",
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Single-module convenience wrapper (tests, fixtures)."""
+    return analyze_sync_sources([(src, path)], select=select, ignore=ignore)
+
+
+def analyze_sync_paths(
+    paths: Sequence[str],
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+) -> List[Finding]:
+    sources: List[Tuple[str, str]] = []
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as e:  # pragma: no cover
+            findings.append(Finding("GS000", path, 0, 1, f"unreadable: {e}", "<module>"))
+            continue
+        sources.append((src, os.path.relpath(path)))
+    findings.extend(analyze_sync_sources(sources, select=select, ignore=ignore))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,887 @@
+"""graft-sync model builder: per-class shared-state + lock-acquisition graphs.
+
+This module turns Python source into the three models the GS rules
+(:mod:`sheeprl_tpu.analysis.sync`) judge:
+
+- a **per-class concurrency model** (:class:`ClassModel`): which attributes
+  are locks (``threading.Lock/RLock/Condition`` or the
+  :mod:`~sheeprl_tpu.analysis.lockstats` factories), which attributes form
+  the shared state (assigned in ``__init__``), which methods are thread
+  entry points (``Thread(target=self.m)`` / ``supervisor.spawn(..., self.m)``),
+  and every attribute access/call annotated with the lockset held at that
+  point;
+- the **lock-acquisition-order graph** across the whole corpus: acquiring
+  lock B while holding lock A is the edge A→B; edges flow through calls
+  (``self.m()``, typed-attribute calls like ``self.cache.rebuild_slab()``
+  when ``self.cache = SessionCache(...)`` was seen in ``__init__``, and
+  corpus-unique method names), so an AB-BA cycle split across two classes is
+  still a cycle;
+- **event streams** for the pointwise rules: blocking calls under a held
+  lock, raw ``threading.Thread`` construction sites, ``Condition.wait``
+  calls and whether a ``while`` predicate loop encloses them.
+
+Lock identity is a string token: ``ClassName.attr`` for class locks
+(inherited locks resolve to the DECLARING class, so a subclass holding
+``self._lock`` and its base guard the same token), ``<func>.var`` for
+function-local locks, and ``?.attr`` for foreign references that cannot be
+typed statically (``handle.supervisor._lock``) — unresolved tokens still
+count as "a lock is held" for the blocking rule but never join the order
+graph (no guessed edges, no false cycles). Foreign ``obj.attr`` lock
+references DO resolve when ``attr`` names a lock in exactly one analyzed
+class — unique-attribute resolution, the same trick used for unique method
+names on call edges.
+
+Everything here is pure stdlib ``ast``; :mod:`sheeprl_tpu.analysis.sync`
+owns rule judgment, suppressions and the CLI.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Access",
+    "Acquisition",
+    "BlockingCall",
+    "CallSite",
+    "ClassModel",
+    "CondWait",
+    "Corpus",
+    "MethodModel",
+    "ModuleModel",
+    "ThreadSpawn",
+    "LOCK_CTORS",
+]
+
+# constructor (resolved dotted name) -> lock kind
+LOCK_CTORS: Dict[str, str] = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    # the runtime-sanitizer factories (sheeprl_tpu.analysis.lockstats)
+    "sync_lock": "lock",
+    "sync_rlock": "rlock",
+    "sync_condition": "condition",
+}
+
+_QUEUE_TYPES = ("queue.Queue", "queue.LifoQueue", "queue.PriorityQueue", "queue.SimpleQueue")
+
+# method names whose call on `self.attr` mutates the container behind `attr`
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "appendleft", "remove",
+    "clear", "update", "setdefault", "add", "discard",
+}
+
+
+def _resolve_ctor(resolved: Optional[str]) -> Optional[str]:
+    """Lock kind for a constructor call's resolved name (handles both the
+    fully-qualified ``threading.*`` forms and the bare factory names that
+    ``from ...lockstats import sync_lock`` resolves to)."""
+    if not resolved:
+        return None
+    if resolved in LOCK_CTORS:
+        return LOCK_CTORS[resolved]
+    tail = resolved.rsplit(".", 1)[-1]
+    if tail in ("sync_lock", "sync_rlock", "sync_condition"):
+        return LOCK_CTORS[tail]
+    return None
+
+
+@dataclass(frozen=True)
+class Access:
+    attr: str
+    write: bool
+    held: Tuple[str, ...]
+    method: str  # method NAME within the class ("__init__", "check", ...)
+    qualname: str
+    line: int
+    col: int
+    # True only for __init__'s OWN frame (construction is single-threaded);
+    # a closure defined in __init__ and handed to a thread runs
+    # post-publication and gets no such exemption
+    init_scope: bool = False
+
+
+@dataclass(frozen=True)
+class Acquisition:
+    token: str
+    kind: str  # lock | rlock | condition | unknown
+    held_before: Tuple[str, ...]
+    qualname: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class CallSite:
+    recv_kind: str  # "self" | "attr" | "name" | "other"
+    recv: str  # attribute/name text ("" for other)
+    method: str  # called method name
+    held: Tuple[str, ...]
+    qualname: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class BlockingCall:
+    desc: str
+    held: Tuple[str, ...]
+    qualname: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class ThreadSpawn:
+    qualname: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class CondWait:
+    token: str
+    in_while: bool
+    qualname: str
+    line: int
+    col: int
+
+
+@dataclass
+class MethodModel:
+    name: str
+    qualname: str
+    accesses: List[Access] = field(default_factory=list)
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+
+
+@dataclass
+class ClassModel:
+    name: str
+    path: str
+    bases: Tuple[str, ...]
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> type tail
+    init_attrs: Set[str] = field(default_factory=set)
+    methods: Dict[str, MethodModel] = field(default_factory=dict)
+    thread_entries: Set[str] = field(default_factory=set)
+
+    def lock_token(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+@dataclass
+class ModuleModel:
+    path: str
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    blocking: List[BlockingCall] = field(default_factory=list)
+    spawns: List[ThreadSpawn] = field(default_factory=list)
+    waits: List[CondWait] = field(default_factory=list)
+
+
+class _ImportContext:
+    """Alias resolution (``import threading as t`` → ``t.Lock`` =
+    ``threading.Lock``) — the same resolution contract as graft-lint's
+    module context, re-stated here so the sync tier has no import-order
+    coupling with the lint internals."""
+
+    def __init__(self) -> None:
+        self.aliases: Dict[str, str] = {}
+
+    def add_import(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(self.aliases.get(cur.id, cur.id))
+        return ".".join(reversed(parts))
+
+
+class Corpus:
+    """All analyzed modules plus the cross-module resolution maps."""
+
+    def __init__(self) -> None:
+        self.modules: List[ModuleModel] = []
+        self._pending: List[Tuple[str, ast.Module, _ImportContext]] = []
+        self.classes: Dict[str, List[ClassModel]] = {}  # name -> defs (usually 1)
+        self.lock_attr_owners: Dict[str, List[Tuple[ClassModel, str]]] = {}
+        self.method_owners: Dict[str, List[ClassModel]] = {}
+
+    # -- phase 1: declarations ------------------------------------------------
+
+    def add_source(self, src: str, path: str) -> Optional[Tuple[int, str]]:
+        """Parse + collect declarations; returns ``(lineno, msg)`` on a syntax
+        error (the caller reports it as a finding)."""
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return (e.lineno or 0, e.msg or "syntax error")
+        ctx = _ImportContext()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                ctx.add_import(node)
+        module = ModuleModel(path=path)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                module.classes[node.name] = self._declare_class(node, ctx, path)
+        self.modules.append(module)
+        self._pending.append((path, tree, ctx))
+        return None
+
+    def _declare_class(self, node: ast.ClassDef, ctx: _ImportContext, path: str) -> ClassModel:
+        bases = tuple(b.id for b in node.bases if isinstance(b, ast.Name))
+        cls = ClassModel(name=node.name, path=path, bases=bases)
+        for stmt in node.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls.methods[stmt.name] = MethodModel(stmt.name, f"{node.name}.{stmt.name}")
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets, value = [sub.target], sub.value
+                else:
+                    continue
+                attrs = [a for t in targets for a in _self_attr_targets(t)]
+                if not attrs:
+                    continue
+                if stmt.name == "__init__":
+                    cls.init_attrs.update(attrs)
+                if not isinstance(value, ast.Call):
+                    continue
+                resolved = ctx.resolve(value.func)
+                kind = _resolve_ctor(resolved)
+                type_tail = resolved.rsplit(".", 1)[-1] if resolved else None
+                for a in attrs:
+                    if kind is not None:
+                        cls.lock_attrs[a] = kind
+                    elif resolved is not None:
+                        # remember the constructor: queue.Queue for the
+                        # blocking rule, corpus classes for call edges
+                        cls.attr_types[a] = resolved if resolved in _QUEUE_TYPES else (type_tail or "")
+        return cls
+
+    # -- phase 2: bodies ------------------------------------------------------
+
+    def finalize(self) -> None:
+        for module in self.modules:
+            for cls in module.classes.values():
+                self.classes.setdefault(cls.name, []).append(cls)
+                for attr, kind in cls.lock_attrs.items():
+                    self.lock_attr_owners.setdefault(attr, []).append((cls, kind))
+                for mname in cls.methods:
+                    self.method_owners.setdefault(mname, []).append(cls)
+        for (path, tree, ctx), module in zip(self._pending, self.modules):
+            walker = _BodyWalker(self, module, ctx)
+            walker.walk_module(tree)
+        self._pending.clear()
+
+    def held_by_convention(self, cls: ClassModel, method_name: str) -> Tuple[Tuple[str, str], ...]:
+        """The ``*_locked`` suffix convention (CPython's own): a method named
+        ``_evict_lru_locked`` is specified to run with the class's lock(s)
+        already held by its caller — analyze its body under that lockset."""
+        if not method_name.endswith("_locked"):
+            return ()
+        return tuple(self.effective_lock_attrs(cls).values())
+
+    # -- resolution helpers ---------------------------------------------------
+
+    def effective_lock_attrs(self, cls: ClassModel) -> Dict[str, Tuple[str, str]]:
+        """attr -> (token, kind) including single-inheritance bases found in
+        the corpus; the token names the DECLARING class."""
+        out: Dict[str, Tuple[str, str]] = {}
+        seen: Set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            for attr, kind in c.lock_attrs.items():
+                out.setdefault(attr, (c.lock_token(attr), kind))
+            for b in c.bases:
+                for bc in self.classes.get(b, ()):
+                    stack.append(bc)
+        return out
+
+    def unique_lock_owner(self, attr: str) -> Optional[Tuple[ClassModel, str]]:
+        owners = self.lock_attr_owners.get(attr, ())
+        return owners[0] if len(owners) == 1 else None
+
+    def unique_method_owner(self, mname: str) -> Optional[ClassModel]:
+        owners = self.method_owners.get(mname, ())
+        return owners[0] if len(owners) == 1 else None
+
+    def class_by_name(self, name: str) -> Optional[ClassModel]:
+        defs = self.classes.get(name, ())
+        return defs[0] if len(defs) == 1 else None
+
+    # -- lock-order graph ------------------------------------------------------
+
+    def may_acquire(
+        self,
+        cls: Optional[ClassModel],
+        mname: str,
+        _memo: Optional[Dict[Tuple[str, str], Set[Tuple[str, str]]]] = None,
+    ) -> Set[Tuple[str, str]]:
+        """Resolved ``(token, kind)`` locks method ``cls.mname`` may acquire,
+        directly or through resolvable calls (depth-capped)."""
+        memo = _memo if _memo is not None else {}
+        out, _complete = self._may_acquire(cls, mname, memo, set(), 0)
+        return out
+
+    def _may_acquire(
+        self,
+        cls: Optional[ClassModel],
+        mname: str,
+        memo: Dict[Tuple[str, str], Set[Tuple[str, str]]],
+        stack: Set[Tuple[str, str]],
+        depth: int,
+    ) -> Tuple[Set[Tuple[str, str]], bool]:
+        """``(locks, complete)`` — a result computed under a recursion-stack
+        or depth cut is INCOMPLETE and must not be memoized: caching it would
+        make the analysis order-dependent (whichever unrelated caller queried
+        the cycle first would poison every later query and silently drop real
+        AB-BA cycles)."""
+        if cls is None or mname not in cls.methods:
+            return set(), True
+        if depth > 6:
+            return set(), False
+        key = (cls.name, mname)
+        if key in memo:
+            return memo[key], True
+        if key in stack:
+            return set(), False
+        stack.add(key)
+        method = cls.methods[mname]
+        out: Set[Tuple[str, str]] = set()
+        complete = True
+        for acq in method.acquisitions:
+            if not acq.token.startswith("?."):
+                out.add((acq.token, acq.kind))
+        for call in method.calls:
+            callee = self._resolve_call(cls, call)
+            if callee is not None:
+                sub, sub_complete = self._may_acquire(callee[0], callee[1], memo, stack, depth + 1)
+                out |= sub
+                complete = complete and sub_complete
+        stack.discard(key)
+        if complete:
+            memo[key] = out
+        return out, complete
+
+    def _resolve_call(self, cls: Optional[ClassModel], call: CallSite) -> Optional[Tuple[ClassModel, str]]:
+        if call.recv_kind == "self" and cls is not None and call.method in cls.methods:
+            return (cls, call.method)
+        if call.recv_kind == "attr" and cls is not None:
+            type_tail = cls.attr_types.get(call.recv, "")
+            target = self.class_by_name(type_tail)
+            if target is not None and call.method in target.methods:
+                return (target, call.method)
+        if call.recv_kind in ("name", "attr", "other"):
+            target = self.unique_method_owner(call.method)
+            if target is not None:
+                return (target, call.method)
+        return None
+
+    def lock_order_edges(self) -> Dict[Tuple[str, str], List[Tuple[str, str, int]]]:
+        """(held, acquired) -> sites [(path, qualname, line)]. Direct nesting
+        plus call-mediated acquisition; same-token edges are skipped for
+        re-entrant kinds and surfaced separately by the GS002 self-deadlock
+        check in :mod:`.sync`."""
+        edges: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = {}
+        memo: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for module in self.modules:
+            for cls in module.classes.values():
+                for method in cls.methods.values():
+                    for acq in method.acquisitions:
+                        if acq.token.startswith("?."):
+                            continue
+                        for held in acq.held_before:
+                            if held.startswith("?.") or held == acq.token:
+                                continue
+                            edges.setdefault((held, acq.token), []).append(
+                                (module.path, acq.qualname, acq.line)
+                            )
+                    for call in method.calls:
+                        if not call.held:
+                            continue
+                        callee = self._resolve_call(cls, call)
+                        if callee is None:
+                            continue
+                        for token, _kind in self.may_acquire(callee[0], callee[1], memo):
+                            for held in call.held:
+                                if held.startswith("?.") or held == token:
+                                    continue
+                                edges.setdefault((held, token), []).append(
+                                    (module.path, call.qualname, call.line)
+                                )
+        return edges
+
+
+def _self_attr_targets(target: ast.expr) -> List[str]:
+    """Attribute names in ``self.X`` (incl. tuple unpacking) store targets."""
+    out: List[str] = []
+    for sub in ast.walk(target):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.ctx, ast.Store)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            out.append(sub.attr)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# body walker
+# --------------------------------------------------------------------------- #
+
+
+class _BodyWalker:
+    """Second pass: walk every function body with a live lockset, recording
+    accesses/acquisitions/calls into the models and module event streams."""
+
+    def __init__(self, corpus: Corpus, module: ModuleModel, ctx: _ImportContext) -> None:
+        self.corpus = corpus
+        self.module = module
+        self.ctx = ctx
+
+    def walk_module(self, tree: ast.Module) -> None:
+        # module-level statements form a synthetic frame
+        frame = _Frame(self, None, None, "<module>", {}, {})
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                cls = self.module.classes.get(stmt.name)
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and cls is not None:
+                        method = cls.methods[sub.name]
+                        frame_m = _Frame(self, cls, method, method.qualname, {}, {})
+                        frame_m.held.extend(self.corpus.held_by_convention(cls, sub.name))
+                        frame_m.walk_function(sub)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _Frame(self, None, None, stmt.name, {}, {}).walk_function(stmt)
+            else:
+                frame.walk_stmt(stmt)
+
+
+class _Frame:
+    """One function frame: the statement walk with its lockset, local lock
+    vars and local type env. Nested defs get child frames that inherit the
+    class context (a worker loop defined inside ``start_monitor`` still
+    mutates the class's shared state) and the visible lock vars (closures)."""
+
+    def __init__(
+        self,
+        walker: _BodyWalker,
+        cls: Optional[ClassModel],
+        method: Optional[MethodModel],
+        qualname: str,
+        lock_env: Dict[str, Tuple[str, str]],  # var -> (token, kind), closures incl.
+        type_env: Dict[str, str],  # var -> resolved ctor (queue detection)
+        nested: bool = False,
+    ) -> None:
+        self.w = walker
+        self.cls = cls
+        self.method = method
+        self.qualname = qualname
+        self.lock_env = dict(lock_env)
+        self.type_env = dict(type_env)
+        self.nested = nested
+        self.held: List[Tuple[str, str]] = []  # (token, kind) stack
+        # one entry per enclosing while: the lockset held at ITS entry — a
+        # Condition.wait is predicate-looped only when some enclosing while
+        # was entered with the condition already held (the predicate recheck
+        # then happens under a continuous hold; a `while not stop: with cond:
+        # if p: wait()` service loop does NOT qualify)
+        self.while_held: List[frozenset] = []
+
+    # -- lock reference resolution -------------------------------------------
+
+    def _lock_ref(self, node: ast.expr) -> Optional[Tuple[str, str]]:
+        """(token, kind) when ``node`` denotes a lock, else None."""
+        if isinstance(node, ast.Name):
+            return self.lock_env.get(node.id)
+        if not isinstance(node, ast.Attribute):
+            return None
+        attr = node.attr
+        if isinstance(node.value, ast.Name) and node.value.id == "self" and self.cls is not None:
+            eff = self.w.corpus.effective_lock_attrs(self.cls)
+            if attr in eff:
+                return eff[attr]
+            return None
+        # foreign reference: unique-attr resolution, else an unresolved token
+        owner = self.w.corpus.unique_lock_owner(attr)
+        if owner is not None:
+            cls, kind = owner
+            return (cls.lock_token(attr), kind)
+        if self.w.corpus.lock_attr_owners.get(attr):
+            return (f"?.{attr}", "unknown")
+        return None
+
+    def _held_tokens(self) -> Tuple[str, ...]:
+        return tuple(t for t, _k in self.held)
+
+    # -- function entry --------------------------------------------------------
+
+    def walk_function(self, node: ast.AST) -> None:
+        for stmt in getattr(node, "body", ()):
+            self.walk_stmt(stmt)
+
+    def _child(self, node: ast.AST, name: str) -> None:
+        child = _Frame(
+            self.w,
+            self.cls,
+            self.method,
+            f"{self.qualname}.{name}",
+            self.lock_env,
+            self.type_env,
+            nested=True,
+        )
+        child.walk_function(node)
+
+    # -- statements ------------------------------------------------------------
+
+    def walk_block(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._child(stmt, stmt.name)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return  # local classes: out of scope
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[Tuple[str, str]] = []
+            for item in stmt.items:
+                ref = self._lock_ref(item.context_expr)
+                if ref is not None:
+                    self._record_acquisition(ref, item.context_expr)
+                    self.held.append(ref)
+                    acquired.append(ref)
+                else:
+                    self.scan_expr(item.context_expr)
+            self.walk_block(stmt.body)
+            for _ in acquired:
+                self.held.pop()
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt, ast.AnnAssign) and stmt.value is None:
+                return  # bare annotation (`self.x: int`): no store at runtime
+            if stmt.value is not None:
+                self._track_local_types(stmt)
+                self.scan_expr(stmt.value)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                self._record_store_target(t, aug=isinstance(stmt, ast.AugAssign))
+            return
+        if isinstance(stmt, ast.While):
+            self.scan_expr(stmt.test)
+            self.while_held.append(frozenset(self._held_tokens()))
+            self.walk_block(stmt.body)
+            self.while_held.pop()
+            self.walk_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.scan_expr(stmt.iter)
+            self.walk_block(stmt.body)
+            self.walk_block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.If):
+            self.scan_expr(stmt.test)
+            self.walk_block(stmt.body)
+            self.walk_block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_block(stmt.body)
+            for h in stmt.handlers:
+                self.walk_block(h.body)
+            self.walk_block(stmt.orelse)
+            self.walk_block(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self.scan_expr(stmt.value)
+            return
+        if isinstance(stmt, (ast.Assert, ast.Raise, ast.Delete)):
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self.scan_expr(sub)
+            return
+        # Pass/Break/Continue/Import/Global/Nonlocal: nothing to record
+
+    def _track_local_types(self, stmt: ast.stmt) -> None:
+        """``x = threading.Lock()`` / ``x = queue.Queue()`` locals."""
+        if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+            return
+        resolved = self.w.ctx.resolve(stmt.value.func)
+        kind = _resolve_ctor(resolved)
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                if kind is not None:
+                    self.lock_env[t.id] = (f"{self.qualname}.{t.id}", kind)
+                elif resolved is not None:
+                    self.type_env[t.id] = resolved
+
+    # -- stores ---------------------------------------------------------------
+
+    def _record_store_target(self, target: ast.expr, aug: bool) -> None:
+        """Classify write targets: ``self.X = / += / [k] =`` are writes on X;
+        inner value expressions are scanned as reads."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_store_target(el, aug)
+            return
+        if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) and target.value.id == "self":
+            self._record_access(target.attr, write=True, node=target)
+            return
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name) and base.value.id == "self":
+                self._record_access(base.attr, write=True, node=base)
+            else:
+                self.scan_expr(base)
+            self.scan_expr(target.slice)
+            return
+        if isinstance(target, ast.Attribute) or isinstance(target, ast.Name):
+            # foreign-object stores (handle.state = ...) and locals: scan reads
+            if isinstance(target, ast.Attribute):
+                self.scan_expr(target.value)
+            return
+        self.scan_expr(target)
+
+    def _record_access(self, attr: str, write: bool, node: ast.AST) -> None:
+        if self.cls is None or self.method is None:
+            return
+        self.method.accesses.append(
+            Access(
+                attr=attr,
+                write=write,
+                held=self._held_tokens(),
+                method=self.method.name,
+                qualname=self.qualname,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0) + 1,
+                init_scope=self.method.name == "__init__" and not self.nested,
+            )
+        )
+
+    def _record_acquisition(self, ref: Tuple[str, str], node: ast.AST) -> None:
+        if self.method is not None:
+            self.method.acquisitions.append(
+                Acquisition(
+                    token=ref[0],
+                    kind=ref[1],
+                    held_before=self._held_tokens(),
+                    qualname=self.qualname,
+                    line=getattr(node, "lineno", 0),
+                    col=getattr(node, "col_offset", 0) + 1,
+                )
+            )
+
+    # -- expressions -----------------------------------------------------------
+
+    def scan_expr(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            self._record_access(node.attr, write=False, node=node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.scan_expr(child)
+
+    def _scan_call(self, node: ast.Call) -> None:
+        resolved = self.w.ctx.resolve(node.func)
+        func = node.func
+
+        # GS004: raw Thread construction (recorded everywhere; the rule layer
+        # applies the supervisor-wiring allowlist)
+        if resolved == "threading.Thread":
+            self.w.module.spawns.append(
+                ThreadSpawn(self.qualname, node.lineno, node.col_offset + 1)
+            )
+            self._note_thread_entry_targets(node)
+
+        # thread entry points: self.m handed to a spawner
+        if isinstance(func, ast.Attribute) and func.attr in ("spawn", "submit_worker"):
+            self._note_thread_entry_targets(node)
+
+        # lock method calls: acquire/release/wait on lock refs
+        if isinstance(func, ast.Attribute):
+            ref = self._lock_ref(func.value)
+            if ref is not None:
+                if func.attr == "acquire":
+                    self._record_acquisition(ref, node)
+                    self.held.append(ref)
+                    for arg in node.args:
+                        self.scan_expr(arg)
+                    for kw in node.keywords:
+                        self.scan_expr(kw.value)
+                    return
+                if func.attr == "release":
+                    for i in range(len(self.held) - 1, -1, -1):
+                        if self.held[i] == ref:
+                            del self.held[i]
+                            break
+                    return
+                if func.attr in ("wait", "wait_for") and ref[1] in ("condition", "unknown"):
+                    if func.attr == "wait" and ref[1] == "condition":
+                        self.w.module.waits.append(
+                            CondWait(
+                                token=ref[0],
+                                in_while=any(ref[0] in s for s in self.while_held),
+                                qualname=self.qualname,
+                                line=node.lineno,
+                                col=node.col_offset + 1,
+                            )
+                        )
+
+        # GS003: blocking calls under a held lock
+        if self.held:
+            desc = self._blocking_desc(node, resolved)
+            if desc is not None:
+                self.w.module.blocking.append(
+                    BlockingCall(
+                        desc=desc,
+                        held=self._held_tokens(),
+                        qualname=self.qualname,
+                        line=node.lineno,
+                        col=node.col_offset + 1,
+                    )
+                )
+
+        # call edges for the order graph (and self-attr reads)
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self._record_call("self", "", func.attr, node)
+            elif (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+            ):
+                self._record_access(recv.attr, write=False, node=recv)
+                if func.attr in _MUTATORS:
+                    # self.attr.append(...) mutates the container behind attr
+                    self._record_access(recv.attr, write=True, node=recv)
+                self._record_call("attr", recv.attr, func.attr, node)
+            elif isinstance(recv, ast.Name):
+                self._record_call("name", recv.id, func.attr, node)
+            else:
+                self.scan_expr(recv)
+                self._record_call("other", "", func.attr, node)
+        # arguments
+        for arg in node.args:
+            self.scan_expr(arg)
+        for kw in node.keywords:
+            self.scan_expr(kw.value)
+
+    def _record_call(self, recv_kind: str, recv: str, method: str, node: ast.Call) -> None:
+        if self.method is None:
+            return
+        self.method.calls.append(
+            CallSite(
+                recv_kind=recv_kind,
+                recv=recv,
+                method=method,
+                held=self._held_tokens(),
+                qualname=self.qualname,
+                line=node.lineno,
+                col=node.col_offset + 1,
+            )
+        )
+
+    def _note_thread_entry_targets(self, node: ast.Call) -> None:
+        if self.cls is None:
+            return
+        cands = list(node.args) + [kw.value for kw in node.keywords]
+        for cand in cands:
+            if (
+                isinstance(cand, ast.Call)
+                and isinstance(cand.func, ast.Name)
+                and cand.func.id == "partial"
+                and cand.args
+            ):
+                cand = cand.args[0]
+            if (
+                isinstance(cand, ast.Attribute)
+                and isinstance(cand.value, ast.Name)
+                and cand.value.id == "self"
+                and cand.attr in self.cls.methods
+            ):
+                self.cls.thread_entries.add(cand.attr)
+
+    # -- blocking classification ------------------------------------------------
+
+    def _blocking_desc(self, node: ast.Call, resolved: Optional[str]) -> Optional[str]:
+        if resolved == "jax.block_until_ready":
+            return "jax.block_until_ready(...)"
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        kwnames = {kw.arg for kw in node.keywords}
+        if attr == "block_until_ready":
+            return ".block_until_ready()"
+        if attr in ("recv", "recvfrom", "accept"):
+            return f"socket .{attr}()"
+        if attr == "join" and not node.args and "timeout" not in kwnames:
+            return ".join() with no timeout"
+        if attr == "result" and not node.args and "timeout" not in kwnames:
+            return ".result() with no timeout"
+        if attr in ("get", "put") and self._is_queue(func.value):
+            if "timeout" in kwnames:
+                return None
+            for kw in node.keywords:
+                if kw.arg == "block" and isinstance(kw.value, ast.Constant) and kw.value.value is False:
+                    return None
+            # positional forms: get(block[, timeout]) / put(item, block[, timeout])
+            block_idx = 1 if attr == "put" else 0
+            if len(node.args) > block_idx:
+                block_arg = node.args[block_idx]
+                if isinstance(block_arg, ast.Constant) and block_arg.value is False:
+                    return None  # get(False) / put(x, False) cannot block
+                if len(node.args) > block_idx + 1:
+                    return None  # positional timeout provided
+                if not (isinstance(block_arg, ast.Constant) and block_arg.value is True):
+                    return None  # dynamic block flag: can't prove it blocks
+            return f"queue.{attr}() with no timeout"
+        return None
+
+    def _is_queue(self, recv: ast.expr) -> bool:
+        if isinstance(recv, ast.Name):
+            return self.type_env.get(recv.id, "") in _QUEUE_TYPES
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and self.cls is not None
+        ):
+            return self.cls.attr_types.get(recv.attr, "") in _QUEUE_TYPES
+        return False

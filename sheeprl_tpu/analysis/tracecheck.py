@@ -37,7 +37,6 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
-import threading
 import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -115,7 +114,9 @@ class TraceCheck:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        from sheeprl_tpu.analysis.lockstats import sync_lock
+
+        self._lock = sync_lock("TraceCheck._lock")
         self._entries: List[EntryStats] = []
         self._events: Dict[str, List[Any]] = {}
         self.mode: str = os.environ.get("SHEEPRL_TPU_TRACECHECK", "warn").strip().lower() or "warn"

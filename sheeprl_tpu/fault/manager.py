@@ -260,6 +260,9 @@ class CheckpointManager:
         self._raise_pending()
         # Non-daemon so an orderly interpreter exit drains the pending save;
         # a SIGKILL mid-write is exactly what the atomic publish tolerates.
+        # graft-sync: disable-next-line=GS004 — deliberately NON-daemon (and thus
+        # unsupervisable): an orderly interpreter exit must drain the in-flight
+        # save; failures re-raise through _raise_pending on the next save/close
         self._inflight = threading.Thread(
             target=self._commit_async,
             args=(path, staged, rb_bytes, int(step), publish),

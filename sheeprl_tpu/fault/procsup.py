@@ -52,11 +52,11 @@ from __future__ import annotations
 
 import signal
 import subprocess
-import threading
 import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional
 
+from sheeprl_tpu.analysis.lockstats import sync_rlock
 from sheeprl_tpu.fault.supervisor import (
     AllWorkersDeadError,
     SupervisionError,
@@ -197,7 +197,7 @@ class ProcessSupervisor:
         self.name = name
         self._clock = clock
         self.stopping = False
-        self._lock = threading.RLock()
+        self._lock = sync_rlock("ProcessSupervisor._lock")
         self._replicas: Dict[str, ReplicaHandle] = {}
 
     @classmethod

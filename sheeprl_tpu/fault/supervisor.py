@@ -52,6 +52,8 @@ import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional
 
+from sheeprl_tpu.analysis.lockstats import sync_rlock
+
 __all__ = [
     "Supervisor",
     "WorkerContext",
@@ -256,7 +258,7 @@ class Supervisor:
         self._clock = clock
         self.stop_event = threading.Event()
         self.fatal: Optional[BaseException] = None  # set by the monitor thread
-        self._lock = threading.RLock()
+        self._lock = sync_rlock("Supervisor._lock")
         self._workers: Dict[str, WorkerHandle] = {}
         self._monitor: Optional[threading.Thread] = None
 

@@ -69,6 +69,9 @@ class SelfHealingEnv(gym.Wrapper):
             except BaseException as e:  # ferried to the caller thread
                 box["error"] = e
 
+        # graft-sync: disable-next-line=GS004 — the watchdog IS the hang-detection
+        # primitive the supervisor tier builds on; one ephemeral probe thread per
+        # guarded env call, joined with the step timeout right below
         t = threading.Thread(target=target, name=f"env-watchdog-{name}", daemon=True)
         t.start()
         t.join(self.step_timeout)

@@ -49,6 +49,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from sheeprl_tpu.analysis.lockstats import sync_lock
 from sheeprl_tpu.fault.inject import fault_point
 
 __all__ = [
@@ -115,7 +116,7 @@ class PipelineStats:
     """Thread-safe counters for the actor↔learner handoff."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = sync_lock("PipelineStats._lock")
         self.rollouts_produced = 0
         self.rollouts_consumed = 0
         self.actor_stall_s = 0.0  # time actors spent blocked on a full queue
@@ -292,7 +293,7 @@ class ParamServer:
             raise ValueError(f"publish_every must be >= 1, got {publish_every}")
         self.publish_every = publish_every
         self.stats = stats or PipelineStats()
-        self._lock = threading.Lock()
+        self._lock = sync_lock("ParamServer._lock")
         self._params = params
         self._version = 0
         self._device_cache: Dict[Any, Any] = {}  # device -> (version, placed params)

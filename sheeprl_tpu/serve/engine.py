@@ -31,12 +31,12 @@ construction for the AOT path, and the assertion is what keeps it true.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from sheeprl_tpu.analysis.lockstats import sync_lock
 from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.parallel.pipeline import DoubleBufferedStager
 from sheeprl_tpu.serve.policy import ServePolicy
@@ -125,7 +125,7 @@ class BucketEngine:
         self.policy = policy
         self.buckets = buckets
         self.mode = mode
-        self._lock = threading.Lock()
+        self._lock = sync_lock("BucketEngine._lock")
         # per-bucket host staging rides the pipeline's DoubleBufferedStager
         # (acquire mode: slabs handed out for in-place row writes, the same
         # discipline the Sebulba actors use). Ring depth 2 covers the one
@@ -277,7 +277,7 @@ class JitEngine:
         self.buckets: Tuple[int, ...] = ()
         self._greedy = jax.jit(policy.greedy_fn)
         self._sample = jax.jit(policy.sample_fn)
-        self._lock = threading.Lock()
+        self._lock = sync_lock("JitEngine._lock")
         self.dispatches = 0
         self.rows = 0
         self.padded_rows = 0

@@ -71,6 +71,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from sheeprl_tpu.analysis.lockstats import sync_lock, sync_rlock
 from sheeprl_tpu.fault import inject
 from sheeprl_tpu.fault.inject import fault_point
 from sheeprl_tpu.fault.procsup import ProcessSupervisor
@@ -128,7 +129,7 @@ class ReplicaEndpoint:
         self.connect_timeout_s = float(connect_timeout_s)
         self.request_timeout_s = float(request_timeout_s)
         self._pool: List[socket.socket] = []
-        self._pool_lock = threading.Lock()
+        self._pool_lock = sync_lock("ReplicaEndpoint._pool_lock")
         # router-maintained view (written by the health loop / failover path)
         self.ready = False
         self.status = "unknown"
@@ -309,7 +310,7 @@ class FleetRouter:
         self.request_timeout_s = float(cfg.get("request_timeout_s", 30.0) or 30.0)
         self._host = host
         self._port = port
-        self._lock = threading.RLock()
+        self._lock = sync_rlock("FleetRouter._lock")
         self.counters: Dict[str, int] = {
             "requests": 0,
             "routed": 0,
@@ -344,11 +345,16 @@ class FleetRouter:
             # process-tier chaos: kill-replica / hang-replica actions target
             # THIS fleet's replicas (first live one, deterministic order)
             inject.set_replica_chaos(kill=self._chaos_kill, hang=self._chaos_hang)
+        # graft-sync: disable-next-line=GS004 — the health loop DRIVES the process
+        # supervisor's check(); it cannot ride the engine it is the heartbeat of
         self._health_thread = threading.Thread(target=self._health_loop, name="fleet-health", daemon=True)
         self._health_thread.start()
         want_socket = (self._port is not None) if with_socket is None else with_socket
         if want_socket:
             self._tcp = _RouterTcp((self._host, int(self._port or 0)), self)
+            # graft-sync: disable-next-line=GS004 — socketserver accept loop; its
+            # lifecycle is serve_forever/shutdown, a supervised respawn would
+            # re-bind the listening socket out from under live clients
             self._tcp_thread = threading.Thread(target=self._tcp.serve_forever, name="fleet-tcp", daemon=True)
             self._tcp_thread.start()
         return self
@@ -370,7 +376,10 @@ class FleetRouter:
         (socket down), settle the in-flight routed requests, then — when the
         router owns the processes — SIGTERM each replica so every one runs
         its own PR 10 drain and exits 0."""
-        self._draining = True
+        with self._lock:
+            # serve_request reads _draining under the lock; an unguarded
+            # write here was graft-sync GS001's first real catch
+            self._draining = True
         if self._tcp is not None:
             self._tcp.shutdown()
             self._tcp.server_close()
@@ -458,6 +467,9 @@ class FleetRouter:
             # leases would then expire from scheduling alone. Beats land
             # asynchronously as each probe completes.
             for ep in self.endpoints:
+                # graft-sync: disable-next-line=GS004 — deliberate fire-and-forget
+                # probe (bounded by probe_inflight + the probe timeout): a probe
+                # is itself the liveness signal, supervising it would be circular
                 threading.Thread(target=self._probe_one, args=(ep,), daemon=True).start()
         if self.procsup is not None:
             try:

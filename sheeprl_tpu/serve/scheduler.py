@@ -248,6 +248,8 @@ class RequestScheduler:
         self._batch_idx = 0
         self._stop = threading.Event()
         self._closed = threading.Event()
+        # graft-sync: disable-next-line=GS004 — fallback for start(supervisor=None)
+        # only (tests, in-process embedding); the serve CLI always passes one
         self._worker: Optional[threading.Thread] = threading.Thread(
             target=self._run, name="serve-scheduler", daemon=True
         )

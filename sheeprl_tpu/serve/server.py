@@ -283,6 +283,9 @@ class PolicyServer:
                 request_timeout_s=self._request_timeout_s,
                 health_fn=self.health,
             )
+            # graft-sync: disable-next-line=GS004 — socketserver accept loop; its
+            # lifecycle is serve_forever/shutdown, a supervised respawn would
+            # re-bind the listening socket out from under live clients
             self._tcp_thread = threading.Thread(target=self._tcp.serve_forever, name="serve-tcp", daemon=True)
             self._tcp_thread.start()
         return self

@@ -34,7 +34,6 @@ in-flight batch against the server-owned cache — zero sessions dropped.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -42,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.analysis.lockstats import sync_lock
 from sheeprl_tpu.analysis.tracecheck import tracecheck
 from sheeprl_tpu.parallel.pipeline import DoubleBufferedStager
 from sheeprl_tpu.serve.engine import check_chunk_order, chunk_plan
@@ -144,7 +144,7 @@ class SessionCache:
         #: row ``max_sessions`` is the padding DONOR — never assigned to a session
         self.donor_row = self.max_sessions
         self.slab = self._fresh_slab()
-        self._lock = threading.Lock()
+        self._lock = sync_lock("SessionCache._lock")
         self._sessions: Dict[str, _Session] = {}
         self._free: List[int] = list(range(self.max_sessions - 1, -1, -1))
         self.generation = 0
@@ -348,7 +348,7 @@ class SessionEngine:
         self.cache = SessionCache(
             policy.state_spec(), max_sessions=max_sessions, ttl_s=ttl_s, sweep_every_s=sweep_every_s
         )
-        self._lock = threading.Lock()
+        self._lock = sync_lock("SessionEngine._lock")
         self._templates: Dict[int, Dict[str, Tuple[Tuple[int, ...], Any]]] = {
             b: {k: ((b, *shape), np.dtype(dtype)) for k, (shape, dtype) in policy.obs_spec.items()}
             for b in buckets

@@ -120,6 +120,8 @@ class CheckpointWatcher:
         self._strikes: Dict[Path, int] = {}
         self.quarantined: Set[Path] = set()
         self._stop = threading.Event()
+        # graft-sync: disable-next-line=GS004 — fallback for start(supervisor=None)
+        # only; the PolicyServer path always hands the watcher to its supervisor
         self._thread = threading.Thread(target=self._run, name="serve-ckpt-watcher", daemon=True)
         self._handle = None  # supervisor WorkerHandle when supervised
         self.published = 0

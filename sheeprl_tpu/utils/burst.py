@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
+from sheeprl_tpu.analysis.lockstats import sync_lock
 from sheeprl_tpu.data.ring import BlobLayout, effective_stage_buckets, make_blob_layouts, pack_burst_blob
 
 __all__ = [
@@ -129,7 +130,7 @@ class HostSnapshot:
         # supervised persistent refresh worker (attach_supervisor): the
         # pending slot is newest-wins, the worker owns the blocking pull
         self._pending: list = [None]
-        self._pending_lock = threading.Lock()
+        self._pending_lock = sync_lock("HostSnapshot._pending_lock")
         self._refresh_worker = None
 
     def pull(self, params: Any) -> Any:
@@ -193,6 +194,9 @@ class HostSnapshot:
         if self._refresh_thread is not None and self._refresh_thread.is_alive():
             return False
         packed = self._pack(params)
+        # graft-sync: disable-next-line=GS004 — one-shot fallback pull for callers
+        # that never attach_supervisor(); the supervised refresh worker above is
+        # the production path, and a dead one-shot pull only delays a snapshot
         self._refresh_thread = threading.Thread(
             target=lambda: self._slot.__setitem__(0, jax.device_put(packed, self.host_device)),
             daemon=True,
@@ -246,7 +250,7 @@ class TrainerThread:
         self._step_fn = step_fn
         self._on_step = on_step
         self._state = {"carry": carry, "metrics": None}
-        self._lock = threading.Lock()
+        self._lock = sync_lock("TrainerThread._lock")
         self._q: "_queue.Queue" = _queue.Queue(maxsize=maxsize)
         self._inflight: list = [None]  # job being (re)dispatched, survives a restart
         self._done = threading.Event()

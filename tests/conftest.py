@@ -39,6 +39,30 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """With the graft-sync runtime sanitizer armed (the chaos lane runs
+    ``SHEEPRL_TPU_SYNC_SANITIZE=1 pytest -m chaos``), every drill doubled as
+    a sanitizer run: fail the session unless the process-wide lock ledger
+    validates clean — 0 order cycles, 0 inversions, 0 over-budget holds."""
+    if os.environ.get("SHEEPRL_TPU_SYNC_SANITIZE", "").strip() != "1":
+        return
+    from sheeprl_tpu.analysis.lockstats import lockstats, validate_payload
+
+    report = lockstats.report()
+    problems, summary = validate_payload(report)
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    line = (
+        "graft-sync sanitizer: {locks} lock(s), {edges} edge(s) — {cycles} cycle(s), "
+        "{inversions} inversion(s), {over_budget_locks} over-budget lock(s)".format(**summary)
+    )
+    if tr is not None:
+        tr.write_line(line)
+        for p in problems:
+            tr.write_line(f"graft-sync sanitizer: {p}", red=True)
+    if problems:
+        session.exitstatus = 1
+
+
 @pytest.fixture()
 def tmp_logdir(tmp_path):
     return str(tmp_path / "logs")
