@@ -39,7 +39,17 @@ matrix read the registry, nothing is hand-enumerated:
   (``exp=ppo_anakin_population_benchmarks``) vs ``sequential`` = P
   back-to-back ``ppo_anakin_benchmarks`` runs at the matched recipe;
   reports aggregate env-steps/s and the fused-block compile count
-  (howto/population_training.md).
+  (howto/population_training.md);
+- ``scenario_matrix`` — the scenario axis of the population block:
+  ``BENCH_SCENARIO_MODE=vmapped`` trains P CartPole pole-length variants in
+  ONE dispatch (``algo.population.env_params``) vs ``sequential`` = P
+  single-scenario size-1 runs at identical seeds/steps; reports aggregate
+  env-steps/s, the block compile count from the tracecheck ledger (1 vs
+  >= P) and the per-scenario fitness spread read back from the final
+  checkpoints (howto/population_training.md);
+- ``env_zoo`` — raw vmapped ``BatchedJaxEnv.step`` throughput per
+  registered pure-JAX env at a fixed batch ladder (no agent, no learning:
+  the env-side budget an Anakin rollout spends per step).
 """
 
 from __future__ import annotations
@@ -327,6 +337,177 @@ def _lane_population() -> None:
                 "block_calls": int(block.get("calls", 0)),
                 "elapsed_s": round(elapsed, 2),
                 "vs_baseline": round((aggregate_steps / elapsed) / BASELINE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+@lane("scenario_matrix", "ppo_cartpole_scenario_matrix_env_steps_per_sec")
+def _lane_scenario_matrix() -> None:
+    scenario_mode = os.environ.get("BENCH_SCENARIO_MODE", "vmapped").strip().lower()
+    if scenario_mode not in ("vmapped", "sequential"):
+        raise SystemExit(
+            f"Unknown BENCH_SCENARIO_MODE '{scenario_mode}' (expected 'vmapped' or 'sequential')"
+        )
+    pop_size = int(os.environ.get("BENCH_SCENARIO_SIZE", 8))
+    # per-scenario steps, identical to the single-run ondevice recipe so the
+    # pairing measures the topology (one dispatch vs P) and nothing else
+    total_steps = _env_steps(65536)
+    # the scenario ladder: P CartPole pole half-lengths spanning 0.25..1.0
+    # (default 0.5) — genuinely different dynamics, same spaces/shapes
+    lengths = [round(0.25 + i * 0.75 / max(1, pop_size - 1), 4) for i in range(pop_size)]
+
+    import tempfile
+
+    from sheeprl_tpu.analysis.tracecheck import tracecheck
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    log_root = os.environ.get("BENCH_SCENARIO_LOG_ROOT") or tempfile.mkdtemp(prefix="scenario_bench_")
+
+    def _fitness_of(run_root: str) -> List[float]:
+        state = load_state(
+            find_latest_run_checkpoint(os.path.join(run_root, "ppo_anakin_population", "CartPole-v1"))
+        )
+        return [round(float(v), 3) for v in state["fitness"]]
+
+    tracecheck.reset()
+    block_name = "ppo_anakin_pop.block"
+    fitness: List[float] = []
+    if scenario_mode == "vmapped":
+        # seed-only hparams: every scenario trains the EXACT recipe the
+        # sequential baseline runs; the env_params grid is the ONE axis
+        ladder = "[" + ", ".join(str(v) for v in lengths) + "]"
+        elapsed = _run_cli(
+            "ppo_anakin_population_benchmarks",
+            total_steps,
+            extra=[
+                f"algo.population.size={pop_size}",
+                "algo.population.hparams={}",
+                f"algo.population.env_params={{length: {ladder}}}",
+                "seed=42",
+                # save_last back on (the shared bench conditions disable it):
+                # the per-scenario fitness is read from the final checkpoint
+                "checkpoint.save_last=True",
+                f"log_root={log_root}/vmapped",
+            ],
+        )
+        fitness = _fitness_of(f"{log_root}/vmapped")
+    else:
+        elapsed = 0.0
+        for i, length in enumerate(lengths):
+            elapsed += _run_cli(
+                "ppo_anakin_population_benchmarks",
+                total_steps,
+                extra=[
+                    "algo.population.size=1",
+                    "algo.population.hparams={}",
+                    f"algo.population.env_params={{length: [{length}]}}",
+                    "seed=42",
+                    "checkpoint.save_last=True",
+                    f"log_root={log_root}/seq_{i}",
+                ],
+            )
+            fitness += _fitness_of(f"{log_root}/seq_{i}")
+    # compile counts come from the tracecheck dump payload — the SAME
+    # artifact CI/`analysis tracecheck` read — not from scraping run logs
+    ledger = tracecheck.dump(os.environ.get("BENCH_TRACECHECK_DUMP") or None)
+    block = ledger["entries"].get(block_name, {})
+    aggregate_steps = pop_size * total_steps
+    member_elapsed = elapsed if scenario_mode == "vmapped" else elapsed / pop_size
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_scenario_matrix_env_steps_per_sec",
+                "value": round(aggregate_steps / elapsed, 2),
+                "unit": "aggregate env-steps/s",
+                "mode": scenario_mode,
+                "population_size": pop_size,
+                "scenario_lengths": lengths,
+                "per_scenario_fitness": fitness,
+                "fitness_spread": round(max(fitness) - min(fitness), 3) if fitness else None,
+                # CartPole pays +1 per env-step under every pole length, so the
+                # block fitness (rollout raw-reward mean) is structurally
+                # rollout_steps for EVERY scenario: spread 0.0 is the
+                # hand-computable expectation here and doubles as a ferry
+                # check; cost-shaped envs (Pendulum g sweeps) show real spread
+                "fitness_note": "CartPole raw-reward fitness == rollout_steps by construction",
+                "per_member_env_steps_per_sec": round(total_steps / member_elapsed, 2),
+                "block_compiles": int(block.get("compiles", 0)),
+                "block_calls": int(block.get("calls", 0)),
+                "elapsed_s": round(elapsed, 2),
+                "vs_baseline": round((aggregate_steps / elapsed) / BASELINE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+@lane("env_zoo", "jax_env_zoo_env_steps_per_sec")
+def _lane_env_zoo() -> None:
+    # Raw env-side throughput: a jitted lax.scan of vmapped BatchedJaxEnv.step
+    # (auto-reset included, traced default params, no agent in the loop) per
+    # registered env across a batch ladder. This bounds what any Anakin
+    # rollout can spend on env physics; compare against Sample Factory's
+    # ~100k FPS full-training bar (arXiv 2006.11751) to see how far pure-JAX
+    # env stepping is from being the bottleneck.
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.envs.jax_envs import JAX_ENV_REGISTRY, BatchedJaxEnv, make_jax_env
+
+    batches = [int(b) for b in os.environ.get("BENCH_ZOO_BATCHES", "128,1024,4096").split(",")]
+    scan_len = int(os.environ.get("BENCH_ZOO_STEPS", 256))
+    reps = int(os.environ.get("BENCH_ZOO_REPS", 3))
+
+    per_env: Dict[str, Dict[str, float]] = {}
+    for env_id in sorted(JAX_ENV_REGISTRY):
+        env = make_jax_env(env_id)
+        params = env.default_params()
+        rates: Dict[str, float] = {}
+        for batch in batches:
+            benv = BatchedJaxEnv(env, batch)
+            if isinstance(env.action_space, gym.spaces.Box):
+                acts = jnp.zeros((batch, *env.action_space.shape), jnp.float32)
+            else:
+                acts = jnp.zeros((batch,), jnp.int32)
+
+            def _rollout(state, _benv=benv, _acts=acts, _params=params):
+                def _body(s, _):
+                    s2, _, rew, _, _ = _benv.step(s, _acts, _params)
+                    return s2, rew
+
+                s, rews = jax.lax.scan(_body, state, None, length=scan_len)
+                return s, rews.sum()
+
+            roll = jax.jit(_rollout)
+            state, _ = jax.jit(benv.reset)(jax.random.PRNGKey(0), params)
+            state, warm = roll(state)  # compile outside the timed window
+            warm.block_until_ready()
+            start = time.perf_counter()
+            for _ in range(reps):
+                state, out = roll(state)
+            out.block_until_ready()
+            dt = time.perf_counter() - start
+            rates[str(batch)] = round(batch * scan_len * reps / dt, 1)
+        per_env[env_id] = rates
+    top_batch = str(max(batches))
+    print(
+        json.dumps(
+            {
+                "metric": "jax_env_zoo_env_steps_per_sec",
+                # headline: the SLOWEST registered env at the top of the
+                # ladder — the conservative env-side budget
+                "value": min(r[top_batch] for r in per_env.values()),
+                "unit": "raw env-steps/s",
+                "batch_ladder": batches,
+                "scan_len": scan_len,
+                "per_env": per_env,
+                "note": (
+                    "raw vmapped BatchedJaxEnv.step (auto-reset on, traced default params, no "
+                    "agent); Sample Factory's ~100k-FPS bar (arXiv 2006.11751) is full training "
+                    "throughput — these rates bound the env-physics share of an Anakin rollout"
+                ),
             }
         )
     )

@@ -30,10 +30,20 @@ def _member_slice(tree: Any, member: int) -> Any:
 def _best_member_state(state: Dict[str, Any]) -> Dict[str, Any]:
     """Population checkpoints stack every member on leading axis 0; slice the
     fittest member (recorded at save time) so the single-agent eval/serve
-    paths run unchanged."""
+    paths run unchanged. The member's scenario (its env-params row, when the
+    checkpoint carries a scenario matrix) rides along sliced to scalars —
+    the weights being evaluated were trained under THAT dynamics variant."""
     sliced = dict(state)
-    sliced["agent"] = _member_slice(state["agent"], int(state.get("best_member", 0)))
+    member = int(state.get("best_member", 0))
+    sliced["agent"] = _member_slice(state["agent"], member)
+    if state.get("env_params") is not None:
+        sliced["env_params"] = {k: _member_slice(v, member) for k, v in state["env_params"].items()}
     return sliced
+
+
+def _scenario_desc(env_params: Dict[str, Any]) -> str:
+    """Human-readable ``k=v`` line for a single member's env-params row."""
+    return ", ".join(f"{k}={float(v):.6g}" for k, v in env_params.items())
 
 
 # The decoupled, Anakin and Sebulba mains write the same checkpoint layout
@@ -146,8 +156,16 @@ def serve_policy_ppo(fabric, cfg: Dict[str, Any], observation_space, action_spac
 @register_evaluation(algorithms=["ppo_anakin_population"])
 def evaluate_ppo_population(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     """Evaluate the fittest member of a population checkpoint on the
-    gymnasium twin of its pure-JAX training env."""
-    return evaluate_ppo(fabric, cfg, _best_member_state(state))
+    gymnasium twin of its pure-JAX training env. When the checkpoint carries
+    a scenario matrix the best member's env-params row is reported: the
+    gymnasium twin always runs DEFAULT dynamics, so a member trained on a
+    perturbed scenario is being evaluated off its training distribution and
+    the printed row makes that visible rather than silent."""
+    sliced = _best_member_state(state)
+    if sliced.get("env_params"):
+        if fabric.is_global_zero:
+            print(f"Best member scenario (training dynamics): {_scenario_desc(sliced['env_params'])}")
+    return evaluate_ppo(fabric, cfg, sliced)
 
 
 @register_policy_builder(algorithms=["ppo_anakin_population"])
@@ -163,14 +181,17 @@ def serve_policy_ppo_population(fabric, cfg: Dict[str, Any], observation_space, 
     reaching the AOT bucket executables would fail every dispatch."""
     import dataclasses
 
-    if full_state is not None:
-        best = int(full_state.get("best_member", 0))
-    elif cfg.get("checkpoint_path"):
+    if full_state is None and cfg.get("checkpoint_path"):
         from sheeprl_tpu.utils.checkpoint import load_state
 
-        best = int(load_state(cfg.checkpoint_path).get("best_member", 0))
-    else:
-        best = 0
+        full_state = load_state(cfg.checkpoint_path)
+    best = int(full_state.get("best_member", 0)) if full_state is not None else 0
+    if full_state is not None and full_state.get("env_params") is not None:
+        # the served weights were trained under THIS member's dynamics row —
+        # surface the scenario so an operator knows which variant is live
+        row = {k: _member_slice(v, best) for k, v in full_state["env_params"].items()}
+        if fabric.is_global_zero:
+            print(f"Serving member {best} scenario (training dynamics): {_scenario_desc(row)}")
 
     policy = serve_policy_ppo(fabric, cfg, observation_space, action_space, _member_slice(agent_state, best))
     rebuild_single = policy.params_from_state

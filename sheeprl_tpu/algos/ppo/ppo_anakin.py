@@ -99,12 +99,21 @@ def make_anakin_local_block(
 
     ``population=True`` switches the per-run hyperparameters from baked-in
     Python constants to TRACED arguments — the signature grows
-    ``(..., gamma, gae_lambda)`` after the loss coefficients — so ONE compile
-    serves every (seed, hparam) member of a vmapped population, and adds a
-    per-iteration ``fit`` metric (mean per-env raw-reward sum over the
-    rollout, ``pmean``'d over ``dp``) as the in-graph fitness the PBT
-    selection step consumes. With ``population=False`` the emitted graph is
-    the exact pre-population block (constants folded at trace time).
+    ``(..., gamma, gae_lambda, env_params)`` after the loss coefficients — so
+    ONE compile serves every (seed, hparam, scenario) member of a vmapped
+    population, and adds a per-iteration ``fit`` metric (mean per-env
+    raw-reward sum over the rollout, ``pmean``'d over ``dp``) as the in-graph
+    per-scenario fitness the PBT selection step consumes. With
+    ``population=False`` the signature grows only the trailing ``env_params``
+    (gamma/gae_lambda stay folded constants).
+
+    ``env_params`` is the env's dynamics-constants pytree and is TRACED on
+    BOTH paths: XLA rewrites constant-parameter dynamics (reciprocal
+    strength-reduction, folded sub-expressions) in ways a traced pytree
+    can't follow, so baking defaults into the single-run program while the
+    population traced them would break the P=1 bit-parity guarantee. A
+    traced scenario costs a handful of loop-invariant scalar ops, hoisted
+    out of the rollout scan.
     """
     T = int(cfg.algo.rollout_steps)
     cfg_gamma = float(cfg.algo.gamma)
@@ -118,9 +127,10 @@ def make_anakin_local_block(
 
     def local_block(params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key, clip_coef, ent_coef, *hp):
         if population:
-            gamma, gae_lambda = hp
+            gamma, gae_lambda, env_params = hp
         else:
             gamma, gae_lambda = cfg_gamma, cfg_gae_lambda
+            (env_params,) = hp
 
         def rollout_step(carry, _):
             params, env_state, obs, ep_ret, ep_len, key = carry
@@ -133,7 +143,7 @@ def make_anakin_local_block(
                 buf_action = jnp.concatenate(acts, axis=-1)
                 idx = jnp.stack([a.argmax(axis=-1) for a in acts], axis=-1)
                 env_action = idx[..., 0] if n_heads == 1 else idx
-            env_state, next_obs, reward, done, info = benv.step(env_state, env_action)
+            env_state, next_obs, reward, done, info = benv.step(env_state, env_action, env_params)
 
             # time-limit bootstrap, fused (host loop: rewards[trunc] += gamma *
             # V(final_obs)); cond-gated so the extra critic forward only runs on
@@ -232,6 +242,10 @@ def make_anakin_block(
     episode arrays — ``(iters, T, num_envs)`` × 3 — from the program outputs,
     so a metrics-off run (the benchmark path) transfers only the per-iteration
     loss scalars per block.
+
+    The trailing ``env_params`` input (the env's dynamics-constants pytree,
+    replicated) is TRACED so the emitted dynamics match the population
+    block's bit-for-bit — see :func:`make_anakin_local_block`.
     """
     local_block = make_anakin_local_block(
         agent, tx, cfg, benv, local_envs, iters_per_block, obs_key,
@@ -247,7 +261,7 @@ def make_anakin_block(
     shard_block = shard_map(
         local_block,
         mesh=mesh,
-        in_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, P(), P(), P()),
+        in_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, P(), P(), P(), P()),
         out_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, metric_specs),
         check_vma=False,
     )
@@ -472,7 +486,10 @@ def main(fabric, cfg: Dict[str, Any]):
     rng = fabric.put_replicated(rng)
 
     benv = BatchedJaxEnv(jenv, num_envs)
-    env_state, first_obs = jax.jit(benv.reset)(env_reset_key)
+    # the env's dynamics constants, staged replicated ONCE and passed traced
+    # into every block call (same buffer each call: stable jit cache key)
+    env_params = fabric.put_replicated(jenv.default_params())
+    env_state, first_obs = jax.jit(benv.reset)(env_reset_key, env_params)
     env_sharding = fabric.data_sharding
     env_state = jax.device_put(env_state, env_sharding)
     obs = jax.device_put(first_obs, env_sharding)
@@ -511,7 +528,7 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/train_time", SumMetric):
             params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, metrics = block_fn(
                 params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_key,
-                clip_arr, ent_arr,
+                clip_arr, ent_arr, env_params,
             )
             metrics = jax.device_get(metrics)
 
@@ -681,6 +698,11 @@ def audit_anakin_setup(spec: AuditMesh, pop_size: int = 1):
     jenv = make_jax_env("CartPole-v1")
     benv = BatchedJaxEnv(jenv, num_envs)
     rep = NamedSharding(mesh, P())
+    defaults = jenv.default_params()
+    env_params_a = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((pop_size,) if pop_size > 1 else (), jnp.result_type(x), sharding=rep),
+        defaults,
+    )
     if pop_size > 1:
         env_sh = NamedSharding(mesh, P(None, "dp"))
         env_state_avals, obs_avals = jax.eval_shape(
@@ -718,6 +740,7 @@ def audit_anakin_setup(spec: AuditMesh, pop_size: int = 1):
         "ep_ret": ep_ret,
         "ep_len": ep_len,
         "env_keys": env_keys,
+        "env_params": env_params_a,
     }
 
 
@@ -736,7 +759,7 @@ def _audit_programs(spec: AuditMesh):
         fn=fn,
         args=(
             s["params"], s["opt_state"], s["env_state"], s["obs"], s["ep_ret"], s["ep_len"],
-            s["env_keys"], key, scalar, scalar,
+            s["env_keys"], key, scalar, scalar, s["env_params"],
         ),
         source=__name__,
         donate_argnums=(0, 1, 2, 3, 4, 5, 6),

@@ -1,5 +1,5 @@
-"""PPO Anakin — vmapped POPULATION training: P (seed, hyperparameter) members
-in ONE jitted dispatch.
+"""PPO Anakin — vmapped POPULATION training: P (seed, hyperparameter,
+scenario) members in ONE jitted dispatch.
 
 ``ppo_anakin`` fuses pure-JAX envs + rollout + GAE + optimization into one
 jitted ``shard_map`` block, but one process trains one run: a P-member sweep
@@ -30,6 +30,21 @@ Sweep specification (``algo.population.hparams.*``): each entry is a constant
 ``sweep=grid`` takes the cartesian product of the choices (must equal
 ``size``); ``sweep=random`` draws per member, deterministically from
 ``cfg.seed``.
+
+SCENARIO matrix (``algo.population.env_params.*``): the same spec schema
+applied to the env's dynamics-constants pytree
+(``JaxEnv.default_params()`` fields — gravity, masses, lengths, the
+TimeLimit bound, ...). The resolved ``(P,)``-stacked params pytree rides
+next to ``hparams`` as a TRACED block input and the population block vmaps
+over it: one compiled dispatch steps P distinct env variants, and the
+per-member ``fit`` output becomes per-SCENARIO fitness. ``sweep=grid``
+takes one cartesian product across hparams AND env params (joint size must
+equal ``size``); ``sweep=random`` keys each env param's stream by
+``(seed, "env_params.<name>")`` so adding a param — env or hparam — never
+reshuffles another's draws. PBT moves a member's scenario only when
+``algo.population.pbt.perturb_env_params=true`` (default off: selection
+copies weights INTO a scenario, it must not silently mutate the scenario a
+member is being scored on).
 
 Counter semantics: ``algo.total_steps`` / ``policy_step`` count PER-MEMBER
 env steps (identical to a single ``ppo_anakin`` run at the same config), so
@@ -76,6 +91,7 @@ __all__ = [
     "population_main",
     "make_population_block",
     "resolve_sweep",
+    "resolve_matrix",
     "resolve_pbt",
     "HPARAM_KEYS",
     "PBTConfig",
@@ -95,6 +111,10 @@ class PBTConfig(NamedTuple):
     num_copy: int  # q — bottom-q members copy top-q members
     perturb: Tuple[str, ...]  # hparam names perturbed on copy
     factors: Tuple[float, ...]  # multiplicative perturbation choices
+    #: env-param fields inherited + perturbed on copy; EMPTY means the env
+    #: params never move (default: selection must not silently mutate the
+    #: scenario a member is scored on — perturb_env_params=true opts in)
+    env_perturb: Tuple[str, ...] = ()
 
 
 def _base_hparams(cfg) -> Dict[str, float]:
@@ -130,21 +150,32 @@ def _spec_kind(spec: Any) -> Tuple[str, Any]:
     )
 
 
-def resolve_sweep(cfg, size: int, seed: int) -> Tuple[Dict[str, np.ndarray], Tuple[str, ...]]:
-    """Resolve ``algo.population.hparams`` into per-member ``(P,)`` float32
-    arrays, deterministically under ``seed``.
+def resolve_matrix(
+    cfg, size: int, seed: int, env=None
+) -> Tuple[Dict[str, np.ndarray], Tuple[str, ...], Dict[str, np.ndarray], Tuple[str, ...]]:
+    """Jointly resolve ``algo.population.hparams`` AND
+    ``algo.population.env_params`` into per-member ``(P,)`` arrays,
+    deterministically under ``seed``.
 
-    Returns ``(hparams, swept)`` where ``swept`` names the entries that
-    actually vary (the default PBT perturbation set). Unspecified
-    hyperparameters broadcast the run config's scalar.
+    Returns ``(hparams, swept, env_params, env_swept)``: ``hparams`` maps
+    every :data:`HPARAM_KEYS` entry to a ``(P,)`` float32 array, ``env_params``
+    maps every field of ``env.default_params()`` to a ``(P,)`` array in the
+    field's dtype (defaults broadcast; empty dict when ``env`` is ``None``),
+    and the ``*swept`` tuples name the entries that actually vary (the
+    default PBT perturbation sets).
 
-    - ``sweep=grid``: cartesian product of all ``choices`` entries, in
-      ``HPARAM_KEYS`` order; the product size must equal ``size`` exactly
-      (ranges are rejected — a grid needs discrete points);
-    - ``sweep=random``: each member draws independently — choices uniformly,
-      ranges uniform or log-uniform — from a stream keyed by
-      ``(seed, hparam name)``, so the draw for one hparam never shifts when
-      another is added.
+    - ``sweep=grid``: ONE cartesian product across hparam and env-param
+      ``choices`` axes — hparams first (``HPARAM_KEYS`` order), then env
+      params in ``default_params()`` field order; the joint product must
+      equal ``size`` exactly (ranges are rejected — a grid needs discrete
+      points);
+    - ``sweep=random``: each entry draws independently — choices uniformly,
+      ranges uniform or log-uniform — from a stream keyed by ``(seed, name)``
+      for hparams and ``(seed, "env_params.<name>")`` for env params, so the
+      draw for one entry never shifts when another is added.
+
+    Integer env-param fields (e.g. ``max_episode_steps``) round to the
+    field's dtype after drawing.
     """
     pop_cfg = cfg.algo.get("population") or {}
     mode = str(pop_cfg.get("sweep", "grid")).lower()
@@ -154,66 +185,131 @@ def resolve_sweep(cfg, size: int, seed: int) -> Tuple[Dict[str, np.ndarray], Tup
     unknown = sorted(set(spec_map) - set(HPARAM_KEYS))
     if unknown:
         raise ValueError(f"Unknown population hparam(s) {unknown}; supported: {list(HPARAM_KEYS)}")
+    env_spec_map = dict(pop_cfg.get("env_params") or {})
+    if env_spec_map and env is None:
+        raise ValueError(
+            "algo.population.env_params is configured but no pure-JAX env was provided to resolve "
+            "its params pytree against; scenario sweeps need the JaxEnv instance"
+        )
 
     base = _base_hparams(cfg)
     out = {k: np.full((size,), base[k], dtype=np.float32) for k in HPARAM_KEYS}
+    env_out: Dict[str, np.ndarray] = {}
+    env_dtypes: Dict[str, np.dtype] = {}
+    env_fields: Tuple[str, ...] = ()
+    if env is not None:
+        defaults = env.default_params()
+        env_fields = tuple(defaults._fields)
+        unknown = sorted(set(env_spec_map) - set(env_fields))
+        if unknown:
+            raise ValueError(
+                f"Unknown env param(s) {unknown} for '{env.id}'; "
+                f"default_params() fields: {list(env_fields)}"
+            )
+        for f in env_fields:
+            leaf = np.asarray(jax.device_get(getattr(defaults, f)))
+            env_dtypes[f] = leaf.dtype
+            env_out[f] = np.full((size,), leaf, dtype=leaf.dtype)
+
+    def _env_cast(name: str, vals) -> np.ndarray:
+        dt = env_dtypes[name]
+        arr = np.asarray(vals, dtype=np.float64)
+        return np.round(arr).astype(dt) if np.issubdtype(dt, np.integer) else arr.astype(dt)
+
     swept: List[str] = []
+    env_swept: List[str] = []
+
+    # one declared axis list spanning both spaces: hparams first (HPARAM_KEYS
+    # order), then env params in field order — stable and seed-independent
+    axes = [("hp", n, spec_map[n]) for n in HPARAM_KEYS if n in spec_map]
+    axes += [("env", n, env_spec_map[n]) for n in env_fields if n in env_spec_map]
 
     if mode == "grid":
-        grid_axes: List[Tuple[str, List[float]]] = []
-        for name in HPARAM_KEYS:  # declared order = HPARAM_KEYS order, stable
-            if name not in spec_map:
-                continue
-            kind, val = _spec_kind(spec_map[name])
+        grid_axes: List[Tuple[str, str, List[float]]] = []
+        for space, name, spec in axes:
+            kind, val = _spec_kind(spec)
             if kind == "const":
-                out[name][:] = val
+                if space == "hp":
+                    out[name][:] = val
+                else:
+                    env_out[name][:] = _env_cast(name, val)
             elif kind == "range":
                 raise ValueError(
                     f"sweep=grid cannot expand the range spec for '{name}'; list explicit choices "
                     "or use sweep=random"
                 )
             else:
-                grid_axes.append((name, val))
+                grid_axes.append((space, name, val))
         if grid_axes:
-            points = list(itertools.product(*(vals for _, vals in grid_axes)))
+            points = list(itertools.product(*(vals for _, _, vals in grid_axes)))
             if len(points) != size:
                 raise ValueError(
                     f"sweep=grid: the cartesian product of choices has {len(points)} points "
-                    f"({' x '.join(f'{n}[{len(v)}]' for n, v in grid_axes)}) but "
-                    f"algo.population.size={size}; make them equal"
+                    f"({' x '.join(f'{n}[{len(v)}]' for _, n, v in grid_axes)}) but "
+                    f"algo.population.size={size}; make them equal (hparam and env_params axes "
+                    "share ONE grid)"
                 )
             for i, point in enumerate(points):
-                for (name, _), v in zip(grid_axes, point):
-                    out[name][i] = v
-            swept = [n for n, _ in grid_axes]
+                for (space, name, _), v in zip(grid_axes, point):
+                    if space == "hp":
+                        out[name][i] = v
+                    else:
+                        env_out[name][i] = _env_cast(name, v)
+            swept = [n for s, n, _ in grid_axes if s == "hp"]
+            env_swept = [n for s, n, _ in grid_axes if s == "env"]
     else:
-        for name in HPARAM_KEYS:
-            if name not in spec_map:
-                continue
-            kind, val = _spec_kind(spec_map[name])
-            # stream keyed by (seed, name): adding one hparam never reshuffles
-            # another's draws, and the draw is platform-independent
-            rng = np.random.default_rng([int(seed) & 0xFFFFFFFF, zlib.crc32(name.encode())])
+        for space, name, spec in axes:
+            kind, val = _spec_kind(spec)
+            # stream keyed by (seed, name) — env params under an
+            # "env_params." prefix so a field named like an hparam gets its
+            # own stream: adding one entry never reshuffles another's draws,
+            # and the draw is platform-independent
+            stream = name if space == "hp" else f"env_params.{name}"
+            rng = np.random.default_rng([int(seed) & 0xFFFFFFFF, zlib.crc32(stream.encode())])
             if kind == "const":
-                out[name][:] = val
+                draw = None
             elif kind == "choices":
-                out[name][:] = rng.choice(np.asarray(val, dtype=np.float32), size=size)
-                swept.append(name)
+                draw = rng.choice(np.asarray(val, dtype=np.float64), size=size)
             else:
                 low, high, log = val
                 if log:
                     draw = np.exp(rng.uniform(np.log(low), np.log(high), size=size))
                 else:
                     draw = rng.uniform(low, high, size=size)
-                out[name][:] = draw.astype(np.float32)
-                swept.append(name)
+            if space == "hp":
+                if draw is None:
+                    out[name][:] = val
+                else:
+                    out[name][:] = draw.astype(np.float32)
+                    swept.append(name)
+            else:
+                if draw is None:
+                    env_out[name][:] = _env_cast(name, val)
+                else:
+                    env_out[name][:] = _env_cast(name, draw)
+                    env_swept.append(name)
 
-    return out, tuple(swept)
+    return out, tuple(swept), env_out, tuple(env_swept)
 
 
-def resolve_pbt(cfg, size: int, swept: Tuple[str, ...]) -> Tuple[Optional[PBTConfig], int]:
+def resolve_sweep(cfg, size: int, seed: int) -> Tuple[Dict[str, np.ndarray], Tuple[str, ...]]:
+    """Hparam-only view of :func:`resolve_matrix` (kept for callers that
+    sweep no env params)."""
+    hparams, swept, _, _ = resolve_matrix(cfg, size, seed, env=None)
+    return hparams, swept
+
+
+def resolve_pbt(
+    cfg, size: int, swept: Tuple[str, ...], env_swept: Tuple[str, ...] = ()
+) -> Tuple[Optional[PBTConfig], int]:
     """Resolve ``algo.population.pbt`` into the static :class:`PBTConfig`
-    (or ``None`` when disabled) plus the host-side block cadence."""
+    (or ``None`` when disabled) plus the host-side block cadence.
+
+    ``perturb_env_params`` (default ``false``) gates whether selection also
+    copies + perturbs the SWEPT env params: off, a replaced member keeps its
+    scenario and only the weights/optimizer/hparams move (curriculum
+    semantics); on, the scenario rides along like any other hyperparameter.
+    """
     pbt_cfg = (cfg.algo.get("population") or {}).get("pbt") or {}
     if not bool(pbt_cfg.get("enabled", False)):
         return None, 0
@@ -239,7 +335,10 @@ def resolve_pbt(cfg, size: int, swept: Tuple[str, ...]) -> Tuple[Optional[PBTCon
     every = int(pbt_cfg.get("every_blocks", 1))
     if every < 1:
         raise ValueError(f"pbt.every_blocks must be >= 1, got {every}")
-    return PBTConfig(num_copy=q, perturb=perturb, factors=factors), every
+    env_perturb: Tuple[str, ...] = ()
+    if bool(pbt_cfg.get("perturb_env_params", False)):
+        env_perturb = tuple(env_swept)
+    return PBTConfig(num_copy=q, perturb=perturb, factors=factors, env_perturb=env_perturb), every
 
 
 def _with_lr(opt_state, lr):
@@ -254,21 +353,25 @@ def _with_lr(opt_state, lr):
 def make_pbt_step(pop_size: int, pbt: PBTConfig):
     """Build the in-graph truncation-selection step.
 
-    ``(params, opt_state, hparams, fitness, key) -> (params, opt_state,
-    hparams)``: members are ranked by fitness (stable argsort — equal fitness
-    preserves member order, so an all-identical population maps onto itself);
-    the bottom-q members copy the top-q members' params AND optimizer state
-    and inherit their hyperparameters, multiplied — for the configured
-    ``perturb`` set — by a factor drawn per (member, hparam) from
-    ``perturb_factors`` under ``key``. Everything is a gather/where on the
-    member axis: shapes are static, the step is deterministic under the key,
-    and it compiles once inside the block dispatch's ``lax.cond``.
+    ``(params, opt_state, hparams, env_params, fitness, key) -> (params,
+    opt_state, hparams, env_params)``: members are ranked by fitness (stable
+    argsort — equal fitness preserves member order, so an all-identical
+    population maps onto itself); the bottom-q members copy the top-q
+    members' params AND optimizer state and inherit their hyperparameters,
+    multiplied — for the configured ``perturb`` set — by a factor drawn per
+    (member, hparam) from ``perturb_factors`` under ``key``. ``env_params``
+    passes through UNTOUCHED unless ``pbt.env_perturb`` names fields
+    (``perturb_env_params=true``): those are inherited and perturbed exactly
+    like hparams (integer fields round to their dtype, clamped >= 1).
+    Everything is a gather/where on the member axis: shapes are static, the
+    step is deterministic under the key, and it compiles once inside the
+    block dispatch's ``lax.cond``.
     """
     q = int(pbt.num_copy)
     factors = jnp.asarray(pbt.factors, dtype=jnp.float32)
 
     def pbt_step(operand):
-        params, opt_state, hparams, fitness, key = operand
+        params, opt_state, hparams, env_params, fitness, key = operand
         order = jnp.argsort(-fitness, stable=True)  # descending fitness
         src = order[:q]
         dst = order[pop_size - q:]
@@ -291,7 +394,25 @@ def make_pbt_step(pop_size: int, pbt: PBTConfig):
                     lo, hi = _PERTURB_BOUNDS[name]
                     h = jnp.clip(h, lo, hi)
             new_hparams[name] = jnp.where(replaced, h, hparams[name])
-        return params, opt_state, new_hparams
+        if pbt.env_perturb:
+            # the scenario rides along: swept env params inherit + perturb;
+            # the rest are population-constant so a gather is a no-op
+            new_fields = {}
+            for j, name in enumerate(type(env_params)._fields):
+                h = getattr(env_params, name)
+                if name not in pbt.env_perturb:
+                    new_fields[name] = h
+                    continue
+                taken = take(h)
+                fkey = jax.random.fold_in(key, len(HPARAM_KEYS) + j)
+                f = factors[jax.random.randint(fkey, (pop_size,), 0, factors.shape[0])]
+                if jnp.issubdtype(h.dtype, jnp.integer):
+                    p = jnp.maximum(jnp.round(taken.astype(jnp.float32) * f), 1.0).astype(h.dtype)
+                else:
+                    p = taken * f
+                new_fields[name] = jnp.where(replaced, p, h)
+            env_params = type(env_params)(**new_fields)
+        return params, opt_state, new_hparams, env_params
 
     return pbt_step
 
@@ -317,18 +438,21 @@ def make_population_block(
     Signature of the returned function::
 
         (params, opt_state, env_state, obs, ep_ret, ep_len, env_keys,
-         train_keys, hparams, anneal, pbt_gate, pbt_key)
+         train_keys, hparams, env_params, anneal, pbt_gate, pbt_key)
         -> (params, opt_state, env_state, obs, ep_ret, ep_len, env_keys,
-            hparams, fitness, metrics)
+            hparams, env_params, fitness, metrics)
 
     where every member-stacked pytree has leading dim P, ``hparams`` is the
-    dict of ``(P,)`` traced hyperparameter arrays, ``anneal`` is the traced
-    ``(3,)`` [lr, clip, ent] staircase fraction broadcast over members,
-    ``pbt_gate`` a traced bool and ``fitness`` the ``(P,)`` per-member block
-    fitness. Env-carrying arrays are sharded ``P(None, "dp")`` — envs split
-    across devices UNDER the population axis — params/optimizer replicated.
-    The gate, the hparams and the keys are all TRACED: one compile serves
-    every member, every annealing step and both PBT branches.
+    dict of ``(P,)`` traced hyperparameter arrays, ``env_params`` the
+    ``(P,)``-stacked env dynamics-constants pytree (the SCENARIO axis — each
+    member's envs step its own slice), ``anneal`` is the traced ``(3,)``
+    [lr, clip, ent] staircase fraction broadcast over members, ``pbt_gate``
+    a traced bool and ``fitness`` the ``(P,)`` per-member (= per-scenario)
+    block fitness. Env-carrying arrays are sharded ``P(None, "dp")`` — envs
+    split across devices UNDER the population axis — params/optimizer/env
+    params replicated. The gate, the hparams, the env params and the keys
+    are all TRACED: one compile serves every member, every scenario, every
+    annealing step and both PBT branches.
     """
     local_block = make_anakin_local_block(
         agent, tx, cfg, benv, local_envs, iters_per_block, obs_key,
@@ -360,7 +484,7 @@ def make_population_block(
         mesh=mesh,
         in_specs=(
             P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded,
-            P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(),
         ),
         out_specs=(P(), P(), env_sharded, env_sharded, env_sharded, env_sharded, env_sharded, metric_specs),
         check_vma=False,
@@ -369,7 +493,7 @@ def make_population_block(
 
     def dispatch(
         params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_keys,
-        hparams, anneal, pbt_gate, pbt_key,
+        hparams, env_params, anneal, pbt_gate, pbt_key,
     ):
         lr = hparams["lr"] * anneal[0]
         clip_coef = hparams["clip_coef"] * anneal[1]
@@ -377,17 +501,17 @@ def make_population_block(
         opt_state = _with_lr(opt_state, lr)
         params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, metrics = shard_block(
             params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_keys,
-            clip_coef, ent_coef, hparams["gamma"], hparams["gae_lambda"],
+            clip_coef, ent_coef, hparams["gamma"], hparams["gae_lambda"], env_params,
         )
         fitness = metrics["fit"].mean(axis=1)  # (P,): mean per-iteration fitness over the block
         if pbt_step is not None:
-            params, opt_state, hparams = jax.lax.cond(
+            params, opt_state, hparams, env_params = jax.lax.cond(
                 pbt_gate,
                 pbt_step,
-                lambda op: (op[0], op[1], op[2]),
-                (params, opt_state, hparams, fitness, pbt_key),
+                lambda op: (op[0], op[1], op[2], op[3]),
+                (params, opt_state, hparams, env_params, fitness, pbt_key),
             )
-        return params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, hparams, fitness, metrics
+        return params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, hparams, env_params, fitness, metrics
 
     # Pin the env-carried outputs to the SAME sharding the driver stages the
     # call-1 inputs with. Left to inference, the outer jit canonicalizes the
@@ -403,7 +527,7 @@ def make_population_block(
     # AUD002); fitness/metrics are host-consumed and stay unconstrained
     rep_out = NamedSharding(mesh, P())
     out_shardings = (
-        rep_out, rep_out, env_out, env_out, env_out, env_out, env_out, rep_out, None, None,
+        rep_out, rep_out, env_out, env_out, env_out, env_out, env_out, rep_out, rep_out, None, None,
     )
     return jax.jit(dispatch, donate_argnums=(0, 1, 2, 3, 4, 5, 6), out_shardings=out_shardings)
 
@@ -508,12 +632,28 @@ def population_main(fabric, cfg: Dict[str, Any]):
         stacked_params = jax.jit(jax.vmap(lambda k: agent.init(k, dummy_obs)))(init_keys)
     params = fabric.put_replicated(stacked_params)
 
-    # Sweep resolution (deterministic per seed) — or the checkpointed values
-    hparams_np, swept = resolve_sweep(cfg, pop_size, int(cfg.seed))
+    # Sweep + scenario-matrix resolution (deterministic per seed) — or the
+    # checkpointed values: resume NEVER re-resolves the matrix (PBT may have
+    # rewritten it, and an edited sweep config must not silently remap a
+    # running population onto different scenarios)
+    hparams_np, swept, env_params_np, env_swept = resolve_matrix(cfg, pop_size, int(cfg.seed), env=jenv)
+    if env_swept:
+        # re-make with the swept set declared: constructor kwargs that shadow
+        # a swept env param fail loudly instead of training every scenario on
+        # the constructor value (see make_jax_env)
+        jenv = make_jax_env(cfg.env.id, swept_params=env_swept, **env_kwargs)
     if state is not None and state.get("hparams") is not None:
         hparams_np = {k: np.asarray(v, dtype=np.float32) for k, v in state["hparams"].items()}
-    pbt, pbt_every = resolve_pbt(cfg, pop_size, swept)
+    if state is not None and state.get("env_params") is not None:
+        env_params_np = {k: np.asarray(v) for k, v in state["env_params"].items()}
+    pbt, pbt_every = resolve_pbt(cfg, pop_size, swept, env_swept)
     hparams = fabric.put_replicated({k: jnp.asarray(v) for k, v in hparams_np.items()})
+    # the (P,)-stacked scenario pytree: one slice per member, TRACED through
+    # the block so every scenario shares the single compile
+    _env_defaults = jenv.default_params()
+    env_params = fabric.put_replicated(
+        type(_env_defaults)(**{f: jnp.asarray(env_params_np[f]) for f in _env_defaults._fields})
+    )
 
     from sheeprl_tpu.optim.builders import build_optimizer
 
@@ -532,11 +672,13 @@ def population_main(fabric, cfg: Dict[str, Any]):
 
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
-        print(f"Population: {pop_size} members, sweep over {list(swept) or 'nothing (seed-only)'}")
+        axes_desc = list(swept) + [f"env_params.{n}" for n in env_swept]
+        print(f"Population: {pop_size} members, sweep over {axes_desc or 'nothing (seed-only)'}")
         for m in range(pop_size):
-            print(
-                f"  member {m}: " + ", ".join(f"{k}={hparams_np[k][m]:.6g}" for k in HPARAM_KEYS)
-            )
+            line = ", ".join(f"{k}={hparams_np[k][m]:.6g}" for k in HPARAM_KEYS)
+            if env_swept:
+                line += ", " + ", ".join(f"{k}={env_params_np[k][m]:.6g}" for k in env_swept)
+            print(f"  member {m}: {line}")
 
     aggregator = None
     if not MetricAggregator.disabled:
@@ -584,7 +726,9 @@ def population_main(fabric, cfg: Dict[str, Any]):
 
     benv = BatchedJaxEnv(jenv, num_envs)
     reset_keys = jax.random.split(env_reset_root, pop_size)
-    env_state, first_obs = jax.jit(jax.vmap(benv.reset))(reset_keys)
+    # vmap over (member key, member scenario): each member's envs start under
+    # its own env params
+    env_state, first_obs = jax.jit(jax.vmap(benv.reset))(reset_keys, env_params)
     env_sharding = fabric.sharding(None, "dp")
     env_state = jax.device_put(env_state, env_sharding)
     obs = jax.device_put(first_obs, env_sharding)
@@ -655,9 +799,12 @@ def population_main(fabric, cfg: Dict[str, Any]):
         anneal = fabric.put_replicated(jnp.asarray([lr_frac, clip_frac, ent_frac], dtype=jnp.float32))
         gate_arr = fabric.put_replicated(jnp.asarray(gate))
         with timer("Time/train_time", SumMetric):
-            (params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, hparams, fitness, metrics) = block_fn(
+            (
+                params, opt_state, env_state, obs, ep_ret, ep_len, env_keys,
+                hparams, env_params, fitness, metrics,
+            ) = block_fn(
                 params, opt_state, env_state, obs, ep_ret, ep_len, env_keys, train_keys,
-                hparams, anneal, gate_arr, pbt_key,
+                hparams, env_params, anneal, gate_arr, pbt_key,
             )
             metrics = jax.device_get(metrics)
             fitness_np = np.asarray(jax.device_get(fitness))
@@ -695,7 +842,7 @@ def population_main(fabric, cfg: Dict[str, Any]):
 
         if tripped:
             def _rollback(good):
-                nonlocal params, opt_state, member_rngs, hparams, pop_key, fitness_np
+                nonlocal params, opt_state, member_rngs, hparams, env_params, pop_key, fitness_np
                 params = fabric.put_replicated(jax.tree.map(lambda t, s: jnp.asarray(s), params, good["agent"]))
                 opt_state = fabric.put_replicated(
                     jax.tree.map(lambda t, s: jnp.asarray(s) if hasattr(t, "dtype") else s, opt_state, good["optimizer"])
@@ -704,6 +851,12 @@ def population_main(fabric, cfg: Dict[str, Any]):
                     member_rngs = fabric.put_replicated(jnp.asarray(good["rng"]))
                 if good.get("hparams") is not None:
                     hparams = fabric.put_replicated({k: jnp.asarray(v) for k, v in good["hparams"].items()})
+                if good.get("env_params") is not None:
+                    # the scenario matrix rolls back with the weights (PBT
+                    # with perturb_env_params may have moved it since)
+                    env_params = fabric.put_replicated(
+                        type(env_params)(**{f: jnp.asarray(good["env_params"][f]) for f in type(env_params)._fields})
+                    )
                 if good.get("pop_key") is not None:
                     pop_key = fabric.put_replicated(jnp.asarray(good["pop_key"]))
                 # the diverged block's fitness (possibly NaN) must not drive
@@ -746,6 +899,14 @@ def population_main(fabric, cfg: Dict[str, Any]):
                 for m in range(pop_size):
                     for k in HPARAM_KEYS:
                         pop_metrics[f"Population/member_{m}/{k}"] = float(live_h[k][m])
+                if env_swept:
+                    # ... and the live scenario (moves only under
+                    # perturb_env_params=true; logged either way so the
+                    # per-member fitness always reads against its scenario)
+                    live_e = jax.device_get(env_params)
+                    for m in range(pop_size):
+                        for k in env_swept:
+                            pop_metrics[f"Population/member_{m}/env_{k}"] = float(np.asarray(getattr(live_e, k))[m])
             logger.log_dict(pop_metrics, policy_step)
             logger.log_dict(
                 {
@@ -802,6 +963,10 @@ def population_main(fabric, cfg: Dict[str, Any]):
                 "rng": member_rngs,
                 "pop_key": pop_key,
                 "hparams": hparams,
+                # the scenario matrix, saved as a plain field dict (dtypes
+                # preserved) so resume/rollback/eval/serve restore it WITHOUT
+                # re-resolving the sweep
+                "env_params": {f: getattr(env_params, f) for f in type(env_params)._fields},
                 "fitness": fitness_np,
                 "population_size": pop_size,
                 "best_member": best,
@@ -843,10 +1008,6 @@ def _audit_programs(spec: AuditMesh):
 
     pop_size = 2
     s = audit_anakin_setup(spec, pop_size=pop_size)
-    fn = make_population_block(
-        s["agent"], s["tx"], s["cfg"], s["mesh"], s["benv"], s["local_envs"], 1,
-        "state", pop_size, ferry_episodes=True, guard=True, pbt=None,
-    )
     rep = s["rep"]
     train_keys = jax.ShapeDtypeStruct((pop_size, 2), jnp.uint32, sharding=rep)
     hparams = {
@@ -855,20 +1016,45 @@ def _audit_programs(spec: AuditMesh):
     anneal = jax.ShapeDtypeStruct((3,), jnp.float32, sharding=rep)
     gate = jax.ShapeDtypeStruct((), jnp.bool_, sharding=rep)
     pbt_key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+    args = (
+        s["params"], s["opt_state"], s["env_state"], s["obs"], s["ep_ret"], s["ep_len"],
+        s["env_keys"], train_keys, hparams, s["env_params"], anneal, gate, pbt_key,
+    )
+    out_decl = {
+        0: P(), 1: P(), 2: P(None, "dp"), 3: P(None, "dp"), 4: P(None, "dp"),
+        5: P(None, "dp"), 6: P(None, "dp"), 7: P(), 8: P(),
+    }
+    fn = make_population_block(
+        s["agent"], s["tx"], s["cfg"], s["mesh"], s["benv"], s["local_envs"], 1,
+        "state", pop_size, ferry_episodes=True, guard=True, pbt=None,
+    )
     yield AuditProgram(
         name="ppo_anakin_pop.block",
         fn=fn,
-        args=(
-            s["params"], s["opt_state"], s["env_state"], s["obs"], s["ep_ret"], s["ep_len"],
-            s["env_keys"], train_keys, hparams, anneal, gate, pbt_key,
-        ),
+        args=args,
         source=__name__,
         donate_argnums=(0, 1, 2, 3, 4, 5, 6),
-        feedback_outputs=(0, 1, 2, 3, 4, 5, 6, 7),
-        out_decl={
-            0: P(), 1: P(), 2: P(None, "dp"), 3: P(None, "dp"), 4: P(None, "dp"),
-            5: P(None, "dp"), 6: P(None, "dp"), 7: P(),
-        },
+        feedback_outputs=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+        out_decl=out_decl,
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )
+    # the PBT-armed twin: the lax.cond selection step (hparam + env-param
+    # inherit/perturb) is part of the compiled program and must satisfy the
+    # same sharding/donation/feedback contracts on both branches
+    pbt = PBTConfig(num_copy=1, perturb=("lr",), factors=(0.8, 1.25), env_perturb=("length",))
+    fn_pbt = make_population_block(
+        s["agent"], s["tx"], s["cfg"], s["mesh"], s["benv"], s["local_envs"], 1,
+        "state", pop_size, ferry_episodes=True, guard=True, pbt=pbt,
+    )
+    yield AuditProgram(
+        name="ppo_anakin_pop.block[pbt]",
+        fn=fn_pbt,
+        args=args,
+        source=__name__,
+        donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+        feedback_outputs=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+        out_decl=out_decl,
         mesh=s["mesh"],
         wire_dtype=spec.wire_dtype,
     )

@@ -12,6 +12,10 @@ Third dynamics regime of the zoo: unlike CartPole (unstable equilibrium,
 dense +1) and Pendulum (continuous torque, shaped cost), Acrobot is an
 underactuated double pendulum with a sparse cost — the population bench
 sweeps hyperparameters across genuinely different optimization landscapes.
+
+Dynamics constants live in :class:`AcrobotParams` (``default_params()``);
+``step``/``reset`` take the pytree explicitly so a population block can vmap
+the scenario axis (e.g. sweep ``link_mass_2`` or ``gravity`` per member).
 """
 
 from __future__ import annotations
@@ -25,12 +29,28 @@ import numpy as np
 
 from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
 
-__all__ = ["JaxAcrobot", "AcrobotState"]
+__all__ = ["JaxAcrobot", "AcrobotState", "AcrobotParams"]
 
 
 class AcrobotState(NamedTuple):
     physics: jax.Array  # (4,) float32: theta1, theta2, dtheta1, dtheta2
     t: jax.Array  # () int32 steps taken this episode
+
+
+class AcrobotParams(NamedTuple):
+    """gymnasium AcrobotEnv constants (book variant) as jnp scalars."""
+
+    dt: jax.Array
+    link_length_1: jax.Array
+    link_mass_1: jax.Array
+    link_mass_2: jax.Array
+    link_com_pos_1: jax.Array
+    link_com_pos_2: jax.Array
+    link_moi: jax.Array
+    max_vel_1: jax.Array
+    max_vel_2: jax.Array
+    gravity: jax.Array
+    max_episode_steps: jax.Array  # () int32
 
 
 def _wrap(x: jax.Array, m: float, M: float) -> jax.Array:
@@ -65,21 +85,36 @@ class JaxAcrobot(JaxEnv):
     def action_space(self) -> gym.Space:
         return gym.spaces.Discrete(3)
 
+    def default_params(self) -> AcrobotParams:
+        return AcrobotParams(
+            dt=jnp.float32(self.dt),
+            link_length_1=jnp.float32(self.link_length_1),
+            link_mass_1=jnp.float32(self.link_mass_1),
+            link_mass_2=jnp.float32(self.link_mass_2),
+            link_com_pos_1=jnp.float32(self.link_com_pos_1),
+            link_com_pos_2=jnp.float32(self.link_com_pos_2),
+            link_moi=jnp.float32(self.link_moi),
+            max_vel_1=jnp.float32(self.max_vel_1),
+            max_vel_2=jnp.float32(self.max_vel_2),
+            gravity=jnp.float32(self.gravity),
+            max_episode_steps=jnp.int32(self.max_episode_steps),
+        )
+
     def _obs(self, s: jax.Array) -> jax.Array:
         return jnp.stack(
             [jnp.cos(s[0]), jnp.sin(s[0]), jnp.cos(s[1]), jnp.sin(s[1]), s[2], s[3]]
         ).astype(jnp.float32)
 
-    def reset(self, key: jax.Array) -> Tuple[AcrobotState, jax.Array]:
+    def reset(self, key: jax.Array, params: AcrobotParams = None) -> Tuple[AcrobotState, jax.Array]:
         physics = jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1, dtype=jnp.float32)
         return AcrobotState(physics=physics, t=jnp.zeros((), jnp.int32)), self._obs(physics)
 
-    def _dsdt(self, s: jax.Array, torque: jax.Array) -> jax.Array:
-        m1, m2 = self.link_mass_1, self.link_mass_2
-        l1 = self.link_length_1
-        lc1, lc2 = self.link_com_pos_1, self.link_com_pos_2
-        i1 = i2 = self.link_moi
-        g = self.gravity
+    def _dsdt(self, s: jax.Array, torque: jax.Array, p: AcrobotParams) -> jax.Array:
+        m1, m2 = p.link_mass_1, p.link_mass_2
+        l1 = p.link_length_1
+        lc1, lc2 = p.link_com_pos_1, p.link_com_pos_2
+        i1 = i2 = p.link_moi
+        g = p.gravity
         theta1, theta2, dtheta1, dtheta2 = s[0], s[1], s[2], s[3]
         d1 = m1 * lc1**2 + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(theta2)) + i1 + i2
         d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(theta2)) + i2
@@ -98,31 +133,32 @@ class JaxAcrobot(JaxEnv):
         return jnp.stack([dtheta1, dtheta2, ddtheta1, ddtheta2])
 
     def step(
-        self, state: AcrobotState, action: jax.Array
+        self, state: AcrobotState, action: jax.Array, params: AcrobotParams = None
     ) -> Tuple[AcrobotState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        p = params if params is not None else self.default_params()
         torque = jnp.asarray(self.avail_torque, dtype=jnp.float32)[action.astype(jnp.int32)]
         # rk4 over a single [0, dt] interval, exactly like gymnasium
         # (the torque is the constant augmented component, derivative 0)
         y0 = state.physics
-        dt, dt2 = self.dt, self.dt / 2.0
-        k1 = self._dsdt(y0, torque)
-        k2 = self._dsdt(y0 + dt2 * k1, torque)
-        k3 = self._dsdt(y0 + dt2 * k2, torque)
-        k4 = self._dsdt(y0 + dt * k3, torque)
+        dt, dt2 = p.dt, p.dt / 2.0
+        k1 = self._dsdt(y0, torque, p)
+        k2 = self._dsdt(y0 + dt2 * k1, torque, p)
+        k3 = self._dsdt(y0 + dt2 * k2, torque, p)
+        k4 = self._dsdt(y0 + dt * k3, torque, p)
         ns = y0 + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
 
         ns = jnp.stack(
             [
                 _wrap(ns[0], -jnp.pi, jnp.pi),
                 _wrap(ns[1], -jnp.pi, jnp.pi),
-                jnp.clip(ns[2], -self.max_vel_1, self.max_vel_1),
-                jnp.clip(ns[3], -self.max_vel_2, self.max_vel_2),
+                jnp.clip(ns[2], -p.max_vel_1, p.max_vel_1),
+                jnp.clip(ns[3], -p.max_vel_2, p.max_vel_2),
             ]
         ).astype(jnp.float32)
 
         t = state.t + 1
         terminated = (-jnp.cos(ns[0]) - jnp.cos(ns[1] + ns[0])) > 1.0
-        truncated = t >= self.max_episode_steps
+        truncated = t >= p.max_episode_steps
         done = terminated | truncated
         reward = jnp.where(terminated, 0.0, -1.0).astype(jnp.float32)
         info = {"terminated": terminated, "truncated": truncated}

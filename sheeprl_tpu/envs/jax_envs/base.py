@@ -24,6 +24,15 @@ The reset branch runs unconditionally every step (a fresh-episode state is
 computed and selected by ``jnp.where``): shapes stay static, and for the
 closed-form resets of the classic-control envs the cost is a handful of
 scalar ops per env.
+
+*Every env carries a params pytree.* ``default_params()`` returns a NamedTuple
+of the dynamics constants (gravity, masses, lengths, force magnitudes, the
+TimeLimit bound) as jnp scalars; ``reset``/``step`` take it as an explicit
+trailing argument. ``params=None`` resolves to ``default_params()`` at trace
+time — the constants fold into the program exactly like the pre-params
+hard-coded attributes — while a TRACED params pytree lets a population block
+``vmap`` the env-parameter axis: one compiled dispatch steps P distinct
+scenarios (the scenario-matrix Anakin path).
 """
 
 from __future__ import annotations
@@ -56,14 +65,23 @@ class JaxEnv:
     def action_space(self) -> gym.Space:  # pragma: no cover - interface
         raise NotImplementedError
 
-    def reset(self, key: jax.Array) -> Tuple[Any, jax.Array]:  # pragma: no cover - interface
-        """Start a new episode: ``key -> (state, obs)``."""
+    def default_params(self) -> Any:  # pragma: no cover - interface
+        """Dynamics constants as a NamedTuple pytree of jnp scalars.
+
+        Every leaf is a () jax scalar so the same pytree works baked-in
+        (``params=None`` → resolved at trace time, constants fold) or traced
+        (a ``(P,)``-stacked copy ``vmap``ped over the scenario axis).
+        """
+        raise NotImplementedError
+
+    def reset(self, key: jax.Array, params: Any = None) -> Tuple[Any, jax.Array]:  # pragma: no cover - interface
+        """Start a new episode: ``(key, params) -> (state, obs)``."""
         raise NotImplementedError
 
     def step(
-        self, state: Any, action: jax.Array
+        self, state: Any, action: jax.Array, params: Any = None
     ) -> Tuple[Any, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:  # pragma: no cover - interface
-        """``(state, action) -> (state, obs, reward, done, info)`` with
+        """``(state, action, params) -> (state, obs, reward, done, info)`` with
         ``info = {"terminated": bool, "truncated": bool}``."""
         raise NotImplementedError
 
@@ -93,10 +111,13 @@ class BatchedJaxEnv:
     def single_action_space(self) -> gym.Space:
         return self.env.action_space
 
-    def reset(self, key: jax.Array) -> Tuple[BatchedState, jax.Array]:
+    def reset(self, key: jax.Array, params: Any = None) -> Tuple[BatchedState, jax.Array]:
+        if params is None:
+            params = self.env.default_params()
+
         def reset_one(k):
             k, sub = jax.random.split(k)
-            state, obs = self.env.reset(sub)
+            state, obs = self.env.reset(sub, params)
             return k, state, obs
 
         keys = jax.random.split(key, self.num_envs)
@@ -104,14 +125,17 @@ class BatchedJaxEnv:
         return BatchedState(env_state=states, keys=keys), obs
 
     def step(
-        self, state: BatchedState, action: jax.Array
+        self, state: BatchedState, action: jax.Array, params: Any = None
     ) -> Tuple[BatchedState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        if params is None:
+            params = self.env.default_params()
+
         def step_one(k, s, a):
-            s2, obs, reward, done, info = self.env.step(s, a)
+            s2, obs, reward, done, info = self.env.step(s, a, params)
             # unconditional fresh episode, selected only when done (the key
             # is consumed only on reset so un-done envs keep their stream)
             k2, sub = jax.random.split(k)
-            rs, robs = self.env.reset(sub)
+            rs, robs = self.env.reset(sub, params)
             new_state = jax.tree.map(lambda a_, b_: jnp.where(done, b_, a_), s2, rs)
             new_key = jnp.where(done, k2, k)
             new_obs = jnp.where(done, robs, obs)
@@ -119,6 +143,9 @@ class BatchedJaxEnv:
             info["final_obs"] = obs  # pre-reset obs; meaningful where done
             return new_key, new_state, new_obs, reward, done, info
 
+        # params is closed over, not vmapped: one scenario is shared by every
+        # env in the batch (the population block vmaps the MEMBER axis above
+        # this wrapper, so each member's batch steps its own scenario)
         keys, states, obs, reward, done, info = jax.vmap(step_one)(state.keys, state.env_state, action)
         return BatchedState(env_state=states, keys=keys), obs, reward, done, info
 
@@ -141,11 +168,31 @@ def is_jax_env(env_id: str) -> bool:
     return env_id in JAX_ENV_REGISTRY
 
 
-def make_jax_env(env_id: str, **kwargs: Any) -> JaxEnv:
+def make_jax_env(env_id: str, swept_params: Tuple[str, ...] = (), **kwargs: Any) -> JaxEnv:
+    """Build a registered :class:`JaxEnv`.
+
+    ``swept_params`` names the fields of the env's params pytree that a
+    population sweep (``algo.population.env_params.*``) overrides per member.
+    A constructor kwarg that shadows a swept field is an ERROR: the kwarg only
+    seeds ``default_params()``, so the sweep would silently win (or worse, a
+    field read off ``self`` would silently pin every scenario to the
+    constructor value) — refuse loudly instead.
+    """
     if env_id not in JAX_ENV_REGISTRY:
         raise ValueError(
             f"No pure-JAX environment registered for '{env_id}'. "
             f"Available: {sorted(JAX_ENV_REGISTRY)}. On-device (Anakin) training requires a JaxEnv; "
             "use the host-loop algorithms (e.g. algo=ppo) for arbitrary gymnasium envs."
         )
-    return JAX_ENV_REGISTRY[env_id](**kwargs)
+    env = JAX_ENV_REGISTRY[env_id](**kwargs)
+    if swept_params:
+        fields = set(getattr(env.default_params(), "_fields", ()))
+        clash = sorted(set(kwargs) & fields & set(swept_params))
+        if clash:
+            raise ValueError(
+                f"Env constructor kwarg(s) {clash} for '{env_id}' duplicate swept env params — "
+                f"algo.population.env_params.{clash[0]} already varies this field per member, so the "
+                "constructor value would be silently ignored (every scenario trains on the swept value). "
+                f"Drop the env kwarg or remove algo.population.env_params.{clash[0]}."
+            )
+    return env

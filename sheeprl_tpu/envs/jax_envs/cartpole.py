@@ -6,6 +6,10 @@ U(-0.05, 0.05) reset as ``gymnasium.envs.classic_control.CartPoleEnv``
 tolerance per episode, asserted by ``tests/test_envs/test_jax_envs.py``).
 TimeLimit truncation (500 steps for CartPole-v1) is folded into the env state
 as a step counter so the whole env stays a pure function.
+
+Dynamics constants live in :class:`CartPoleParams` (``default_params()``);
+``step``/``reset`` take the pytree explicitly so a population block can vmap
+the scenario axis (e.g. sweep ``length`` or ``gravity`` per member).
 """
 
 from __future__ import annotations
@@ -19,7 +23,7 @@ import numpy as np
 
 from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
 
-__all__ = ["JaxCartPole", "CartPoleState"]
+__all__ = ["JaxCartPole", "CartPoleState", "CartPoleParams"]
 
 
 class CartPoleState(NamedTuple):
@@ -27,15 +31,28 @@ class CartPoleState(NamedTuple):
     t: jax.Array  # () int32 steps taken this episode
 
 
+class CartPoleParams(NamedTuple):
+    """gymnasium CartPoleEnv constants as jnp scalars."""
+
+    gravity: jax.Array
+    masscart: jax.Array
+    masspole: jax.Array
+    length: jax.Array  # half the pole's length
+    force_mag: jax.Array
+    tau: jax.Array
+    theta_threshold: jax.Array
+    x_threshold: jax.Array
+    max_episode_steps: jax.Array  # () int32
+
+
 @register_jax_env("CartPole-v1")
 class JaxCartPole(JaxEnv):
-    # gymnasium CartPoleEnv constants
+    # gymnasium CartPoleEnv constants (class attrs feed the spaces and the
+    # params defaults; the dynamics read ONLY the params pytree)
     gravity = 9.8
     masscart = 1.0
     masspole = 0.1
-    total_mass = masspole + masscart
     length = 0.5  # half the pole's length
-    polemass_length = masspole * length
     force_mag = 10.0
     tau = 0.02
     theta_threshold = 12 * 2 * np.pi / 360
@@ -56,38 +73,55 @@ class JaxCartPole(JaxEnv):
     def action_space(self) -> gym.Space:
         return gym.spaces.Discrete(2)
 
-    def reset(self, key: jax.Array) -> Tuple[CartPoleState, jax.Array]:
+    def default_params(self) -> CartPoleParams:
+        return CartPoleParams(
+            gravity=jnp.float32(self.gravity),
+            masscart=jnp.float32(self.masscart),
+            masspole=jnp.float32(self.masspole),
+            length=jnp.float32(self.length),
+            force_mag=jnp.float32(self.force_mag),
+            tau=jnp.float32(self.tau),
+            theta_threshold=jnp.float32(self.theta_threshold),
+            x_threshold=jnp.float32(self.x_threshold),
+            max_episode_steps=jnp.int32(self.max_episode_steps),
+        )
+
+    def reset(self, key: jax.Array, params: CartPoleParams = None) -> Tuple[CartPoleState, jax.Array]:
         physics = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05, dtype=jnp.float32)
         return CartPoleState(physics=physics, t=jnp.zeros((), jnp.int32)), physics
 
     def step(
-        self, state: CartPoleState, action: jax.Array
+        self, state: CartPoleState, action: jax.Array, params: CartPoleParams = None
     ) -> Tuple[CartPoleState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        p = params if params is not None else self.default_params()
+        total_mass = p.masspole + p.masscart
+        polemass_length = p.masspole * p.length
+
         x, x_dot, theta, theta_dot = state.physics
-        force = jnp.where(action.astype(jnp.int32) == 1, self.force_mag, -self.force_mag)
+        force = jnp.where(action.astype(jnp.int32) == 1, p.force_mag, -p.force_mag)
         costheta = jnp.cos(theta)
         sintheta = jnp.sin(theta)
 
-        temp = (force + self.polemass_length * theta_dot**2 * sintheta) / self.total_mass
-        thetaacc = (self.gravity * sintheta - costheta * temp) / (
-            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / self.total_mass)
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (p.gravity * sintheta - costheta * temp) / (
+            p.length * (4.0 / 3.0 - p.masspole * costheta**2 / total_mass)
         )
-        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
 
-        x = x + self.tau * x_dot
-        x_dot = x_dot + self.tau * xacc
-        theta = theta + self.tau * theta_dot
-        theta_dot = theta_dot + self.tau * thetaacc
+        x = x + p.tau * x_dot
+        x_dot = x_dot + p.tau * xacc
+        theta = theta + p.tau * theta_dot
+        theta_dot = theta_dot + p.tau * thetaacc
         physics = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
 
         t = state.t + 1
         terminated = (
-            (x < -self.x_threshold)
-            | (x > self.x_threshold)
-            | (theta < -self.theta_threshold)
-            | (theta > self.theta_threshold)
+            (x < -p.x_threshold)
+            | (x > p.x_threshold)
+            | (theta < -p.theta_threshold)
+            | (theta > p.theta_threshold)
         )
-        truncated = t >= self.max_episode_steps
+        truncated = t >= p.max_episode_steps
         done = terminated | truncated
         reward = jnp.ones((), jnp.float32)
         info = {"terminated": terminated, "truncated": truncated}

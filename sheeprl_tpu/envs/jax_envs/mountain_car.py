@@ -1,0 +1,113 @@
+"""Pure-JAX MountainCar-v0, dynamics-exact against gymnasium.
+
+Same constants, closed-form velocity/position update, left-wall velocity
+clamp, goal test, -1-per-step reward and U(-0.6, -0.4) position reset as
+``gymnasium.envs.classic_control.MountainCarEnv`` (gymnasium computes in
+float64 via numpy scalars, this env in float32 — parity within float
+tolerance is asserted by ``tests/test_envs/test_jax_envs.py``). The 200-step
+TimeLimit truncation is a step counter in the env state.
+
+Fourth dynamics regime of the zoo and a second discrete-action scenario
+source for the population matrix: a sparse-reward exploration problem where
+the optimal policy must move AWAY from the goal first — sweeping ``force`` or
+``gravity`` per member changes how hard the hill is to escape.
+
+Dynamics constants live in :class:`MountainCarParams` (``default_params()``);
+``step``/``reset`` take the pytree explicitly so a population block can vmap
+the scenario axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
+
+__all__ = ["JaxMountainCar", "MountainCarState", "MountainCarParams"]
+
+
+class MountainCarState(NamedTuple):
+    physics: jax.Array  # (2,) float32: position, velocity
+    t: jax.Array  # () int32 steps taken this episode
+
+
+class MountainCarParams(NamedTuple):
+    """gymnasium MountainCarEnv constants as jnp scalars."""
+
+    min_position: jax.Array
+    max_position: jax.Array
+    max_speed: jax.Array
+    goal_position: jax.Array
+    goal_velocity: jax.Array
+    force: jax.Array
+    gravity: jax.Array
+    max_episode_steps: jax.Array  # () int32
+
+
+@register_jax_env("MountainCar-v0")
+class JaxMountainCar(JaxEnv):
+    # gymnasium MountainCarEnv constants
+    min_position = -1.2
+    max_position = 0.6
+    max_speed = 0.07
+    goal_position = 0.5
+    goal_velocity = 0.0
+    force = 0.001
+    gravity = 0.0025
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = int(max_episode_steps)
+
+    @property
+    def observation_space(self) -> gym.Space:
+        low = np.array([self.min_position, -self.max_speed], dtype=np.float32)
+        high = np.array([self.max_position, self.max_speed], dtype=np.float32)
+        return gym.spaces.Box(low, high, dtype=np.float32)
+
+    @property
+    def action_space(self) -> gym.Space:
+        return gym.spaces.Discrete(3)
+
+    def default_params(self) -> MountainCarParams:
+        return MountainCarParams(
+            min_position=jnp.float32(self.min_position),
+            max_position=jnp.float32(self.max_position),
+            max_speed=jnp.float32(self.max_speed),
+            goal_position=jnp.float32(self.goal_position),
+            goal_velocity=jnp.float32(self.goal_velocity),
+            force=jnp.float32(self.force),
+            gravity=jnp.float32(self.gravity),
+            max_episode_steps=jnp.int32(self.max_episode_steps),
+        )
+
+    def reset(self, key: jax.Array, params: MountainCarParams = None) -> Tuple[MountainCarState, jax.Array]:
+        position = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4, dtype=jnp.float32)
+        physics = jnp.stack([position, jnp.zeros((), jnp.float32)])
+        return MountainCarState(physics=physics, t=jnp.zeros((), jnp.int32)), physics
+
+    def step(
+        self, state: MountainCarState, action: jax.Array, params: MountainCarParams = None
+    ) -> Tuple[MountainCarState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        p = params if params is not None else self.default_params()
+        position, velocity = state.physics[0], state.physics[1]
+
+        velocity = velocity + (action.astype(jnp.int32) - 1) * p.force + jnp.cos(3 * position) * (-p.gravity)
+        velocity = jnp.clip(velocity, -p.max_speed, p.max_speed)
+        position = position + velocity
+        position = jnp.clip(position, p.min_position, p.max_position)
+        # inelastic left wall, exactly gymnasium's `if position == min and v < 0`
+        velocity = jnp.where((position <= p.min_position) & (velocity < 0.0), 0.0, velocity)
+        physics = jnp.stack([position, velocity]).astype(jnp.float32)
+
+        t = state.t + 1
+        terminated = (position >= p.goal_position) & (velocity >= p.goal_velocity)
+        truncated = t >= p.max_episode_steps
+        done = terminated | truncated
+        reward = jnp.full((), -1.0, jnp.float32)
+        info = {"terminated": terminated, "truncated": truncated}
+        return MountainCarState(physics=physics, t=t), physics, reward, done, info

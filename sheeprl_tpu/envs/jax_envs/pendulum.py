@@ -6,6 +6,10 @@ U([-pi, pi] x [-1, 1]) reset as
 float64; parity within float tolerance is asserted by
 ``tests/test_envs/test_jax_envs.py``). The episode never terminates; the
 200-step TimeLimit truncation is a step counter in the env state.
+
+Dynamics constants live in :class:`PendulumParams` (``default_params()``);
+``step``/``reset`` take the pytree explicitly so a population block can vmap
+the scenario axis (e.g. sweep ``g`` or ``length`` per member).
 """
 
 from __future__ import annotations
@@ -19,13 +23,25 @@ import numpy as np
 
 from sheeprl_tpu.envs.jax_envs.base import JaxEnv, register_jax_env
 
-__all__ = ["JaxPendulum", "PendulumState"]
+__all__ = ["JaxPendulum", "PendulumState", "PendulumParams"]
 
 
 class PendulumState(NamedTuple):
     theta: jax.Array  # () float32
     theta_dot: jax.Array  # () float32
     t: jax.Array  # () int32 steps taken this episode
+
+
+class PendulumParams(NamedTuple):
+    """gymnasium PendulumEnv constants as jnp scalars."""
+
+    max_speed: jax.Array
+    max_torque: jax.Array
+    dt: jax.Array
+    g: jax.Array
+    m: jax.Array
+    length: jax.Array
+    max_episode_steps: jax.Array  # () int32
 
 
 def _angle_normalize(x: jax.Array) -> jax.Array:
@@ -53,29 +69,41 @@ class JaxPendulum(JaxEnv):
     def action_space(self) -> gym.Space:
         return gym.spaces.Box(-self.max_torque, self.max_torque, (1,), dtype=np.float32)
 
+    def default_params(self) -> PendulumParams:
+        return PendulumParams(
+            max_speed=jnp.float32(self.max_speed),
+            max_torque=jnp.float32(self.max_torque),
+            dt=jnp.float32(self.dt),
+            g=jnp.float32(self.g),
+            m=jnp.float32(self.m),
+            length=jnp.float32(self.length),
+            max_episode_steps=jnp.int32(self.max_episode_steps),
+        )
+
     def _obs(self, theta: jax.Array, theta_dot: jax.Array) -> jax.Array:
         return jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot]).astype(jnp.float32)
 
-    def reset(self, key: jax.Array) -> Tuple[PendulumState, jax.Array]:
+    def reset(self, key: jax.Array, params: PendulumParams = None) -> Tuple[PendulumState, jax.Array]:
         high = jnp.array([jnp.pi, 1.0], dtype=jnp.float32)
         th, thdot = jax.random.uniform(key, (2,), minval=-high, maxval=high, dtype=jnp.float32)
         return PendulumState(theta=th, theta_dot=thdot, t=jnp.zeros((), jnp.int32)), self._obs(th, thdot)
 
     def step(
-        self, state: PendulumState, action: jax.Array
+        self, state: PendulumState, action: jax.Array, params: PendulumParams = None
     ) -> Tuple[PendulumState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        p = params if params is not None else self.default_params()
         th, thdot = state.theta, state.theta_dot
-        u = jnp.clip(jnp.reshape(action, ()), -self.max_torque, self.max_torque)
+        u = jnp.clip(jnp.reshape(action, ()), -p.max_torque, p.max_torque)
 
         cost = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
 
-        newthdot = thdot + (3.0 * self.g / (2.0 * self.length) * jnp.sin(th) + 3.0 / (self.m * self.length**2) * u) * self.dt
-        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
-        newth = th + newthdot * self.dt
+        newthdot = thdot + (3.0 * p.g / (2.0 * p.length) * jnp.sin(th) + 3.0 / (p.m * p.length**2) * u) * p.dt
+        newthdot = jnp.clip(newthdot, -p.max_speed, p.max_speed)
+        newth = th + newthdot * p.dt
 
         t = state.t + 1
         terminated = jnp.zeros((), bool)
-        truncated = t >= self.max_episode_steps
+        truncated = t >= p.max_episode_steps
         done = terminated | truncated
         info = {"terminated": terminated, "truncated": truncated}
         new_state = PendulumState(theta=newth.astype(jnp.float32), theta_dot=newthdot.astype(jnp.float32), t=t)

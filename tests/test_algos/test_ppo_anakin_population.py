@@ -151,6 +151,7 @@ def _run_parity_check():
     s_params, s_opt, s_env, s_obs, s_ret, s_len, _, s_metrics = block(
         sp, so, ss, sob, sret, slen, skeys, tkey,
         jnp.asarray(clip0, jnp.float32), jnp.asarray(ent0, jnp.float32),
+        jenv.default_params(),
     )
     s_params = jax.device_get(s_params)
     s_metrics = jax.device_get(s_metrics)
@@ -164,9 +165,11 @@ def _run_parity_check():
     pp, po, ps, pob, pret, plen, pkeys, tkey = _fresh_inputs(cfg, fabric, params_np, tx, benv)
     stack = lambda tree: jax.tree.map(lambda x: x[None], tree)
     hparams = {k: jnp.full((1,), v, jnp.float32) for k, v in _base_hparams(cfg).items()}
-    p_params, p_opt, p_env, p_obs, p_ret, p_len, _, p_hparams, p_fit, p_metrics = pblock(
+    env_params = stack(jenv.default_params())
+    p_params, p_opt, p_env, p_obs, p_ret, p_len, _, p_hparams, p_env_params, p_fit, p_metrics = pblock(
         stack(pp), stack(po), stack(ps), stack(pob), stack(pret), stack(plen), stack(pkeys),
-        tkey[None], hparams, jnp.ones((3,), jnp.float32), jnp.asarray(False), jax.random.PRNGKey(0),
+        tkey[None], hparams, env_params, jnp.ones((3,), jnp.float32), jnp.asarray(False),
+        jax.random.PRNGKey(0),
     )
     p_params = jax.device_get(p_params)
     p_metrics = jax.device_get(p_metrics)
@@ -180,9 +183,12 @@ def _run_parity_check():
         np.testing.assert_array_equal(np.asarray(s_metrics[k]), np.asarray(p_metrics[k])[0])
     np.testing.assert_array_equal(np.asarray(s_metrics["ep_done"]), np.asarray(p_metrics["ep_done"])[0])
     np.testing.assert_array_equal(np.asarray(s_metrics["ep_ret"]), np.asarray(p_metrics["ep_ret"])[0])
-    # the hparams ride through unchanged without PBT, fitness is finite
+    # the hparams AND env params ride through unchanged without PBT,
+    # fitness is finite
     for k, v in hparams.items():
         np.testing.assert_array_equal(np.asarray(v), np.asarray(p_hparams[k]))
+    for a, b in zip(jax.tree.leaves(env_params), jax.tree.leaves(p_env_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert np.isfinite(np.asarray(p_fit)).all() and np.asarray(p_fit).shape == (1,)
     print("PARITY_OK")
 
@@ -365,6 +371,187 @@ def test_sweep_rejections():
 
 
 # --------------------------------------------------------------------------- #
+# Scenario matrix: env_params resolution
+# --------------------------------------------------------------------------- #
+
+
+def test_matrix_grid_is_joint_cartesian():
+    """hparam and env-param choices share ONE grid — hparam axes outer
+    (HPARAM_KEYS order), env-param axes inner (default_params field order);
+    unswept env fields broadcast their defaults in the field dtype."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_matrix
+    from sheeprl_tpu.envs.jax_envs import make_jax_env
+
+    env = make_jax_env("CartPole-v1")
+    cfg = _sweep_cfg(
+        "algo.population.sweep=grid",
+        "algo.population.hparams={lr: [1e-3, 5e-4]}",
+        "algo.population.env_params={length: [0.25, 0.5]}",
+    )
+    hp, swept, ep, env_swept = resolve_matrix(cfg, 4, seed=0, env=env)
+    assert swept == ("lr",) and env_swept == ("length",)
+    np.testing.assert_allclose(hp["lr"], [1e-3, 1e-3, 5e-4, 5e-4], rtol=1e-6)
+    np.testing.assert_allclose(ep["length"], [0.25, 0.5, 0.25, 0.5], rtol=1e-6)
+    # unswept env fields broadcast the default, dtype preserved
+    np.testing.assert_allclose(ep["gravity"], np.full(4, 9.8, np.float32), rtol=1e-6)
+    assert ep["max_episode_steps"].dtype == np.int32
+    np.testing.assert_array_equal(ep["max_episode_steps"], np.full(4, 500, np.int32))
+    # grid is seed-independent
+    _, _, ep2, _ = resolve_matrix(cfg, 4, seed=77, env=env)
+    for k in ep:
+        np.testing.assert_array_equal(ep[k], ep2[k])
+    # joint product must equal size exactly
+    with pytest.raises(ValueError, match="share ONE grid"):
+        resolve_matrix(cfg, 3, seed=0, env=env)
+
+
+def test_matrix_random_streams_never_reshuffle():
+    """Env-param streams are keyed by (seed, 'env_params.<name>'): adding an
+    hparam axis or another env axis never changes an existing field's draws,
+    and an env field named like an hparam gets its own stream. Integer
+    fields (max_episode_steps) round to their dtype."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_matrix
+    from sheeprl_tpu.envs.jax_envs import make_jax_env
+
+    env = make_jax_env("Pendulum-v1")
+    lone = _sweep_cfg(
+        "algo.population.sweep=random",
+        "algo.population.env_params={g: {low: 2.0, high: 20.0}}",
+    )
+    more = _sweep_cfg(
+        "algo.population.sweep=random",
+        "algo.population.hparams={lr: {low: 1e-4, high: 1e-2, log: true}}",
+        "algo.population.env_params={g: {low: 2.0, high: 20.0}, max_episode_steps: {low: 100, high: 400}}",
+    )
+    _, _, ep1, env_swept1 = resolve_matrix(lone, 8, seed=5, env=env)
+    hp2, swept2, ep2, env_swept2 = resolve_matrix(more, 8, seed=5, env=env)
+    assert env_swept1 == ("g",)
+    assert swept2 == ("lr",) and env_swept2 == ("g", "max_episode_steps")
+    np.testing.assert_array_equal(ep1["g"], ep2["g"])
+    assert ((ep2["g"] >= 2.0) & (ep2["g"] <= 20.0)).all()
+    assert ep2["max_episode_steps"].dtype == np.int32
+    assert ((ep2["max_episode_steps"] >= 100) & (ep2["max_episode_steps"] <= 400)).all()
+    # the hparam lr stream is untouched by env axes (same key as hparam-only)
+    hp_only, _, _, _ = resolve_matrix(
+        _sweep_cfg(
+            "algo.population.sweep=random",
+            "algo.population.hparams={lr: {low: 1e-4, high: 1e-2, log: true}}",
+        ),
+        8,
+        seed=5,
+        env=env,
+    )
+    np.testing.assert_array_equal(hp2["lr"], hp_only["lr"])
+
+
+def test_matrix_rejections():
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import resolve_matrix
+    from sheeprl_tpu.envs.jax_envs import make_jax_env
+
+    env = make_jax_env("CartPole-v1")
+    with pytest.raises(ValueError, match="Unknown env param"):
+        resolve_matrix(
+            _sweep_cfg("algo.population.env_params={mass_of_moon: [1, 2]}"), 2, seed=0, env=env
+        )
+    with pytest.raises(ValueError, match="no pure-JAX env"):
+        resolve_matrix(
+            _sweep_cfg("algo.population.env_params={length: [0.25, 0.5]}"), 2, seed=0, env=None
+        )
+    with pytest.raises(ValueError, match="cannot expand the range"):
+        resolve_matrix(
+            _sweep_cfg("algo.population.env_params={length: {low: 0.25, high: 1.0}}"),
+            2,
+            seed=0,
+            env=env,
+        )
+
+
+def test_population_scenario_matrix_dry_run(tmp_path):
+    """A scenario-swept population through the real CLI: 2 members, 2 CartPole
+    pole lengths, one dispatch."""
+    run(
+        _args(
+            tmp_path,
+            "algo.population.size=2",
+            "algo.population.hparams={}",
+            "algo.population.env_params={length: [0.25, 1.0]}",
+        )
+    )
+
+
+def test_make_jax_env_kwarg_sweep_clash():
+    """An env constructor kwarg duplicating a swept env-params field raises a
+    named error pointing at the sweep key (the constructor value would be
+    silently shadowed by the per-member values otherwise)."""
+    from sheeprl_tpu.envs.jax_envs import make_jax_env
+
+    with pytest.raises(ValueError, match=r"algo\.population\.env_params\.max_episode_steps"):
+        make_jax_env("CartPole-v1", swept_params=("max_episode_steps",), max_episode_steps=100)
+
+
+def test_per_scenario_fitness_ferry_hand_computed():
+    """Per-member fitness IS per-scenario fitness: a P=2 CartPole block with
+    two pole lengths ferries one fitness per scenario. The hand-computed
+    twin: CartPole pays exactly +1 every env-step under EVERY dynamics
+    variant (SAME_STEP auto-reset included), so each scenario's per-iteration
+    fitness is exactly rollout_steps and the block fitness is its mean —
+    while the member trajectories themselves must diverge (each member's
+    envs really stepped under its own pole length)."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import _base_hparams, make_population_block
+    from sheeprl_tpu.envs.jax_envs import BatchedJaxEnv, make_jax_env
+    from sheeprl_tpu.optim.builders import build_optimizer
+    from sheeprl_tpu.parallel import Fabric
+
+    cfg = _parity_cfg()
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(21)
+    jenv = make_jax_env("CartPole-v1")
+    obs_space = gym.spaces.Dict({"state": jenv.observation_space})
+    agent, params, _ = build_agent(fabric, (2,), False, cfg, obs_space, None)
+
+    lr0 = float(cfg.algo.optimizer.lr)
+    tx = optax.inject_hyperparams(
+        lambda learning_rate: build_optimizer(
+            {**cfg.algo.optimizer, "lr": learning_rate}, max_grad_norm=cfg.algo.max_grad_norm
+        )
+    )(learning_rate=lr0)
+    num_envs = int(cfg.env.num_envs)
+    benv = BatchedJaxEnv(jenv, num_envs)
+    P, iters, T = 2, 3, int(cfg.algo.rollout_steps)
+
+    pblock = make_population_block(
+        agent, tx, cfg, fabric.mesh, benv, num_envs, iters, "state",
+        pop_size=P, ferry_episodes=True, guard=False, pbt=None,
+    )
+    stack = lambda tree: jax.tree.map(lambda x: jnp.broadcast_to(x, (P,) + x.shape), tree)
+    p = jax.tree.map(jnp.asarray, jax.device_get(params))
+    defaults = jenv.default_params()
+    env_params = stack(defaults)._replace(
+        length=jnp.asarray([0.25, 1.0], jnp.float32)  # two scenarios
+    )
+    reset_keys = jax.random.split(jax.random.PRNGKey(31), P)
+    env_state, obs = jax.jit(jax.vmap(benv.reset))(reset_keys, env_params)
+    hparams = {k: jnp.full((P,), v, jnp.float32) for k, v in _base_hparams(cfg).items()}
+    out = pblock(
+        stack(p), stack(tx.init(p)), env_state, obs,
+        jnp.zeros((P, num_envs), jnp.float32), jnp.zeros((P, num_envs), jnp.int32),
+        stack(jax.random.split(jax.random.PRNGKey(32), fabric.world_size)),
+        jax.random.split(jax.random.PRNGKey(33), P),
+        hparams, env_params, jnp.ones((3,), jnp.float32), jnp.asarray(False),
+        jax.random.PRNGKey(34),
+    )
+    _, _, _, p_obs, _, _, _, _, _, fitness, metrics = out
+    fit_iters = np.asarray(metrics["fit"])
+    assert fit_iters.shape == (P, iters)
+    # hand-computed: +1 per step -> per-iteration fitness == rollout_steps
+    np.testing.assert_allclose(fit_iters, np.full((P, iters), T, np.float32), rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(fitness), np.full((P,), T, np.float32), rtol=0, atol=0)
+    # the scenarios really applied: trajectories diverge between members
+    assert not np.array_equal(np.asarray(p_obs)[0], np.asarray(p_obs)[1])
+
+
+# --------------------------------------------------------------------------- #
 # PBT truncation selection
 # --------------------------------------------------------------------------- #
 
@@ -379,21 +566,38 @@ def _pbt_fixture(pop=4, value_per_member=None):
     return params, opt, hparams
 
 
+def _stacked_env_params(pop):
+    """(P,)-stacked Pendulum scenario matrix with per-member distinct values
+    on the swept-in-tests fields (length, max_episode_steps)."""
+    from sheeprl_tpu.envs.jax_envs import make_jax_env
+
+    defaults = make_jax_env("Pendulum-v1").default_params()
+    stacked = jax.tree.map(lambda x: jnp.broadcast_to(x, (pop,) + x.shape).copy(), defaults)
+    return stacked._replace(
+        length=jnp.asarray(np.linspace(0.5, 2.0, pop), jnp.float32),
+        max_episode_steps=jnp.asarray(100 + 50 * np.arange(pop), jnp.int32),
+    )
+
+
 def test_pbt_step_deterministic_and_truncates():
     from sheeprl_tpu.algos.ppo.ppo_anakin_population import PBTConfig, make_pbt_step
 
     pbt = PBTConfig(num_copy=1, perturb=("lr",), factors=(0.8, 1.25))
     step = jax.jit(make_pbt_step(4, pbt))
     params, opt, hparams = _pbt_fixture()
+    env_params = _stacked_env_params(4)
     fitness = jnp.asarray([3.0, 1.0, 2.0, 0.0])  # member 0 best, member 3 worst
     key = jax.random.PRNGKey(12)
 
-    out1 = jax.device_get(step((params, opt, hparams, fitness, key)))
-    out2 = jax.device_get(step((params, opt, hparams, fitness, key)))
+    out1 = jax.device_get(step((params, opt, hparams, env_params, fitness, key)))
+    out2 = jax.device_get(step((params, opt, hparams, env_params, fitness, key)))
     for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(out2)):
         np.testing.assert_array_equal(a, b)
 
-    new_params, new_opt, new_hparams = out1
+    new_params, new_opt, new_hparams, new_env_params = out1
+    # env params pass through UNTOUCHED with the default empty env_perturb
+    for a, b in zip(jax.tree.leaves(env_params), jax.tree.leaves(new_env_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # the worst member copied the best member's params + optimizer state
     np.testing.assert_array_equal(new_params["w"][3], np.asarray(params["w"])[0])
     np.testing.assert_array_equal(new_opt["mu"][3], np.asarray(opt["mu"])[0])
@@ -422,9 +626,10 @@ def test_pbt_all_identical_stays_identical():
     pbt = PBTConfig(num_copy=1, perturb=(), factors=(0.8, 1.25))
     step = jax.jit(make_pbt_step(4, pbt))
     params, opt, hparams = _pbt_fixture(value_per_member=np.zeros(4, np.float32))
+    env_params = _stacked_env_params(4)
     fitness = jnp.zeros((4,))
-    out = jax.device_get(step((params, opt, hparams, fitness, jax.random.PRNGKey(0))))
-    new_params, new_opt, new_hparams = out
+    out = jax.device_get(step((params, opt, hparams, env_params, fitness, jax.random.PRNGKey(0))))
+    new_params, new_opt, new_hparams, _ = out
     np.testing.assert_array_equal(new_params["w"], np.asarray(params["w"]))
     np.testing.assert_array_equal(new_opt["mu"], np.asarray(opt["mu"]))
     for k in hparams:
@@ -443,8 +648,41 @@ def test_pbt_perturb_clamps_discount_hparams():
     hparams = {k: jnp.full((2,), 0.5, jnp.float32) for k in HPARAM_KEYS}
     hparams["gamma"] = jnp.asarray([0.999, 0.999], jnp.float32)
     fitness = jnp.asarray([1.0, 0.0])
-    _, _, new_hparams = jax.device_get(step((params, opt, hparams, fitness, jax.random.PRNGKey(1))))
+    _, _, new_hparams, _ = jax.device_get(
+        step((params, opt, hparams, _stacked_env_params(2), fitness, jax.random.PRNGKey(1)))
+    )
     assert float(new_hparams["gamma"][1]) <= 0.9999  # 0.999 * 1.25 clamped
+
+
+def test_pbt_env_perturb_moves_swept_scenarios():
+    """``perturb_env_params=true``: swept env-params fields are inherited
+    from the source member and multiplied by a perturb factor; integer
+    fields round to their dtype and clamp >= 1; non-swept fields never
+    move. With the default empty ``env_perturb`` the scenario stays with
+    the SLOT (curriculum semantics) — covered by the tests above."""
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import PBTConfig, make_pbt_step
+
+    pbt = PBTConfig(
+        num_copy=1, perturb=(), factors=(0.8, 1.25), env_perturb=("length", "max_episode_steps")
+    )
+    step = jax.jit(make_pbt_step(2, pbt))
+    params, opt = {"w": jnp.zeros((2, 1))}, {"mu": jnp.zeros((2,))}
+    from sheeprl_tpu.algos.ppo.ppo_anakin_population import HPARAM_KEYS
+
+    hparams = {k: jnp.full((2,), 0.5, jnp.float32) for k in HPARAM_KEYS}
+    env_params = _stacked_env_params(2)
+    fitness = jnp.asarray([1.0, 0.0])  # member 1 copies member 0
+    _, _, _, new_ep = jax.device_get(step((params, opt, hparams, env_params, fitness, jax.random.PRNGKey(3))))
+    src_len = float(np.asarray(env_params.length)[0])
+    got = float(new_ep.length[1])
+    assert np.isclose(got, [0.8 * src_len, 1.25 * src_len], rtol=1e-6).any()
+    # integer field: rounded to int32, clamped >= 1, moved off the slot value
+    assert new_ep.max_episode_steps.dtype == np.int32
+    src_steps = int(np.asarray(env_params.max_episode_steps)[0])
+    assert int(new_ep.max_episode_steps[1]) in (int(round(0.8 * src_steps)), int(round(1.25 * src_steps)))
+    # survivor untouched, non-perturbed fields bitwise across the board
+    np.testing.assert_array_equal(np.asarray(new_ep.length)[0], np.asarray(env_params.length)[0])
+    np.testing.assert_array_equal(np.asarray(new_ep.g), np.asarray(env_params.g))
 
 
 def test_resolve_pbt_validation():
@@ -628,6 +866,47 @@ def test_population_checkpoint_kill_resume_from_latest(tmp_path):
     # the population (PBT/perturbation) stream rode along too
     assert state.get("pop_key") is not None
     assert state.get("fitness") is not None and np.asarray(state["fitness"]).shape == (3,)
+
+
+@pytest.mark.fault
+def test_population_scenario_matrix_kill_resume_restores_env_params(tmp_path):
+    """Scenario-matrix run: checkpoint → SIGKILL → ``resume_from=latest``
+    restores the env-params matrix from the checkpoint. Resumed under a
+    DIFFERENT seed: a re-resolved random scenario sweep would draw different
+    pole lengths, so bitwise equality of the resumed matrix with the
+    pre-kill snapshot proves resume does NOT re-resolve."""
+    from sheeprl_tpu.fault.manager import find_latest_run_checkpoint, latest_complete
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    scenario = [
+        "algo.population.env_params={length: {low: 0.25, high: 1.0}, gravity: {low: 4.9, high: 19.6}}",
+    ]
+    proc = _launch(
+        tmp_path, extra_args=scenario, extra_env={"SHEEPRL_FAULT_KILL": "checkpoint.pre_commit:2"}
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    ckpt_dirs = glob.glob(
+        str(tmp_path / "logs/ppo_anakin_population/CartPole-v1/*/version_*/checkpoint")
+    )
+    assert len(ckpt_dirs) == 1
+    pre = load_state(latest_complete(ckpt_dirs[0]))
+    assert pre.get("env_params") is not None
+    pre_ep = {k: np.asarray(v) for k, v in pre["env_params"].items()}
+    assert pre_ep["length"].shape == (3,)
+    assert len(np.unique(pre_ep["length"])) == 3  # scenarios actually vary
+
+    proc2 = _launch(tmp_path, extra_args=[*scenario, "checkpoint.resume_from=latest", "seed=321"])
+    assert proc2.returncode == 0, (proc2.stdout[-2000:], proc2.stderr[-2000:])
+
+    final = find_latest_run_checkpoint(tmp_path / "logs/ppo_anakin_population/CartPole-v1")
+    state = load_state(final)
+    assert state["iter_num"] >= 6
+    # the scenario matrix survived the kill bitwise — including the unswept
+    # broadcast fields (re-resolution under seed=321 would have redrawn the
+    # swept ones)
+    for k, v in state["env_params"].items():
+        np.testing.assert_array_equal(np.asarray(v), pre_ep[k])
 
 
 def test_population_resume_conflicting_size_uses_checkpoint_population(tmp_path):

@@ -282,7 +282,8 @@ def test_audit_cli_repo_tree_clean_gate():
     # the tracecheck hot-path inventory the ISSUE names is all present
     for expected in (
         "ppo.train_step", "ppo.gae", "ppo.rollout_step", "ppo_anakin.block",
-        "ppo_anakin_pop.block", "sac.train_step", "sac.resident_step", "sac.rollout_step",
+        "ppo_anakin_pop.block", "ppo_anakin_pop.block[pbt]",
+        "sac.train_step", "sac.resident_step", "sac.rollout_step",
         "ppo_sebulba.train_step", "ppo_sebulba.gae", "ppo_sebulba.act", "ppo_sebulba.traj",
         "sac_sebulba.train_step", "sac_sebulba.act", "sac_sebulba.append",
         "dreamer_v3.burst_step",

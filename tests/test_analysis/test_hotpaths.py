@@ -131,6 +131,33 @@ def test_ppo_anakin_population_steady_state_clean(tmp_path, trace_hygiene):
     _assert_quiet(trace_hygiene, ["ppo_anakin_pop.block"])
 
 
+def test_ppo_anakin_population_scenario_matrix_steady_state_clean(tmp_path, trace_hygiene):
+    """Scenario matrix + PBT live together beyond warmup: the env-params axis
+    is a TRACED block argument, so P scenarios ride one compile; the PBT
+    gate toggling (with perturb_env_params moving the scenario rows in-graph)
+    must not retrace either — 0 post-warmup retraces under strict budgets
+    and the steady-state transfer guard."""
+    run(
+        _args(tmp_path, "ppo_anakin_population", env="gym", extra=PPO_FAST)
+        + [
+            "dry_run=False",
+            "algo.total_steps=64",
+            "checkpoint.every=16",
+            "checkpoint.save_last=False",
+            "algo.population.size=3",
+            "algo.population.sweep=random",
+            "algo.population.hparams={lr: {low: 0.0001, high: 0.01, log: true}}",
+            "algo.population.env_params={length: {low: 0.25, high: 1.0}}",
+            "algo.population.pbt.enabled=True",
+            "algo.population.pbt.every_blocks=2",
+            "algo.population.pbt.perturb_env_params=True",
+        ]
+    )
+    report = trace_hygiene.report()["ppo_anakin_pop.block"]
+    assert report["calls"] >= 2, report
+    _assert_quiet(trace_hygiene, ["ppo_anakin_pop.block"])
+
+
 def test_sac_dry_run_clean(tmp_path, trace_hygiene):
     run(_args(tmp_path, "sac", extra=SAC_FAST))
     _assert_quiet(trace_hygiene, ["sac.train_step", "sac.rollout_step"])
