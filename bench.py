@@ -49,7 +49,12 @@ matrix read the registry, nothing is hand-enumerated:
   checkpoints (howto/population_training.md);
 - ``env_zoo`` — raw vmapped ``BatchedJaxEnv.step`` throughput per
   registered pure-JAX env at a fixed batch ladder (no agent, no learning:
-  the env-side budget an Anakin rollout spends per step).
+  the env-side budget an Anakin rollout spends per step);
+- ``pod_restart`` — gang-restart MTTR of the fault-tolerant pod: real
+  2-process pods with one seeded ``kill-host`` per rep, MTTR = SIGKILL ->
+  first post-restart completed train iteration, every rep must converge to
+  its configured ``total_steps`` (howto/fault_tolerance.md#pod-training;
+  benchmarks/pod_bench.py).
 """
 
 from __future__ import annotations
@@ -535,6 +540,21 @@ def _lane_serve_fleet() -> None:
     from serve_fleet_bench import main as fleet_main
 
     fleet_main()
+
+
+@lane("pod_restart", "pod", "pod_restart_mttr_s")
+def _lane_pod_restart() -> None:
+    # Gang-restart MTTR lane: real 2-process pods through the CLI with one
+    # seeded kill-host injection per rep; MTTR = SIGKILL -> first
+    # post-restart completed train iteration, and every rep must FINISH at
+    # its configured total_steps (recovery that converges, not just
+    # respawns). Knobs (BENCH_POD_WORKERS / _REPS / _KILL_AT / _TOTAL_STEPS
+    # / _TIMEOUT) in benchmarks/pod_bench.py, interpretation in
+    # howto/fault_tolerance.md#pod-training.
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from pod_bench import main as pod_main
+
+    pod_main()
 
 
 @lane("serve_sessions", "sessions", "ppo_recurrent_serve_session_steps_per_sec")

@@ -1,0 +1,138 @@
+"""MTTR bench for graft-pod gang-restart training.
+
+Runs REAL 2-process pods (``sheeprl_tpu run --pod N``) with one seeded
+``kill-host`` chaos injection per repetition and reports the launcher's
+measured MTTR — injected SIGKILL → first post-restart completed train
+iteration (the heartbeat-content signal) — per rep, plus the recovery
+bookkeeping (fences, restarts, kills) that proves the pod came back from the
+newest complete checkpoint and not from scratch whenever one existed.
+
+Each rep asserts the run FINISHED (the chaos run converges to its configured
+``total_steps``) — an MTTR number from a run that never recovered would be
+meaningless.
+
+Knobs (env vars): ``BENCH_POD_WORKERS`` (default 2), ``BENCH_POD_REPS``
+(default 3), ``BENCH_POD_TOTAL_STEPS`` (default 160), ``BENCH_POD_KILL_AT``
+(``train.pod.step`` beat of the injection — the Nth observed heartbeat step
+advance, progress-keyed so it lands mid-run regardless of compile-cache
+warmth; default 6 ≈ iteration 3 of 10), ``BENCH_POD_TIMEOUT`` (seconds per
+rep, default 560).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _overrides(total_steps: int, log_root: str, kill_at: int) -> List[str]:
+    return [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "metric.log_level=0",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"algo.total_steps={total_steps}",
+        "checkpoint.every=16",
+        "algo.run_test=False",
+        "seed=11",
+        "fabric.pod.backoff=0.1",
+        "fabric.pod.lease_s=20",
+        "fabric.pod.grace_s=120",
+        f"log_root={log_root}",
+        "fault.chaos.enabled=True",
+        f"fault.chaos.events=[train.pod.step:kill-host:{kill_at}]",
+    ]
+
+
+def _one_rep(workers: int, total_steps: int, kill_at: int, timeout: float) -> Dict[str, Any]:
+    tmp = tempfile.mkdtemp(prefix="pod-bench-")
+    try:
+        cmd = [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu",
+            "run",
+            "--pod",
+            str(workers),
+            *_overrides(total_steps, os.path.join(tmp, "logs"), kill_at),
+        ]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        tic = time.perf_counter()
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env, timeout=timeout
+        )
+        elapsed = time.perf_counter() - tic
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("POD_SUMMARY ")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"pod rep failed rc={proc.returncode}:\n{proc.stdout[-4000:]}"
+            )
+        summary = json.loads(lines[-1][len("POD_SUMMARY ") :])
+        if not summary["finished"]:
+            raise RuntimeError(f"pod rep did not finish: {summary}")
+        if summary["pod_restarts"] < 1 or not summary["restarts"]:
+            raise RuntimeError(
+                f"chaos kill never produced a gang restart (kill_at={kill_at} may be past "
+                f"the end of the run): {summary}"
+            )
+        return {
+            "elapsed_s": round(elapsed, 2),
+            "pod_restarts": summary["pod_restarts"],
+            "kills": summary["kills"],
+            "hangs": summary["hangs"],
+            "fences": summary["fences"],
+            "mttr_s": [round(float(r["mttr_s"]), 3) for r in summary["restarts"]],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> None:
+    workers = int(os.environ.get("BENCH_POD_WORKERS", 2))
+    reps = int(os.environ.get("BENCH_POD_REPS", 3))
+    total_steps = int(os.environ.get("BENCH_POD_TOTAL_STEPS", 160))
+    kill_at = int(os.environ.get("BENCH_POD_KILL_AT", 6))
+    timeout = float(os.environ.get("BENCH_POD_TIMEOUT", 560))
+
+    rep_results = [_one_rep(workers, total_steps, kill_at, timeout) for _ in range(reps)]
+    mttrs = [m for r in rep_results for m in r["mttr_s"]]
+    result = {
+        "benchmark": "pod_restart_mttr",
+        "workers": workers,
+        "reps": reps,
+        "total_steps": total_steps,
+        "kill_at_step_beat": kill_at,
+        "mttr_s": mttrs,
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 3),
+        "mttr_min_s": round(min(mttrs), 3),
+        "mttr_max_s": round(max(mttrs), 3),
+        "rep_detail": rep_results,
+        "note": (
+            "MTTR = injected SIGKILL of one pod worker -> first post-restart completed train "
+            "iteration (heartbeat-content signal); every rep must FINISH at its configured "
+            "total_steps, proving gang restart + resume_from=latest converge, not just respawn"
+        ),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.factory import vectorize_env
 from sheeprl_tpu.ops import gae as gae_op
+from sheeprl_tpu.parallel import pod as pod_runtime
 from sheeprl_tpu.parallel.comm import pmean_grads
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric, build_aggregator
@@ -289,17 +290,21 @@ def main(fabric, cfg: Dict[str, Any]):
     )
 
     # Global counters (reference: ppo.py:215-240)
-    # Counter semantics: envs live in ONE process here (devices shard the
-    # batch, not the envs), so policy steps advance by num_envs per env step
-    # regardless of mesh size — unlike the reference where each rank runs its
-    # own envs (ppo.py:215-240). Checkpoint counters use the same convention.
+    # Counter semantics: devices shard the batch, envs live PER PROCESS —
+    # single-process runs keep the old "one process owns all envs" counters,
+    # a pod of N workers steps num_envs envs in EACH worker, so global policy
+    # steps advance by num_envs * process_count per env step (the reference's
+    # per-rank-envs convention, with rank = pod worker). Checkpoint counters
+    # use the same convention, so a resumed gang restores the GLOBAL step.
+    n_proc = fabric.process_count
+    world_envs = int(cfg.env.num_envs * n_proc)
     last_train = 0
     train_step = 0
     start_iter = state["iter_num"] + 1 if state is not None else 1
-    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+    policy_step = state["iter_num"] * world_envs * cfg.algo.rollout_steps if state is not None else 0
     last_log = state["last_log"] if state is not None else 0
     last_checkpoint = state["last_checkpoint"] if state is not None else 0
-    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    policy_steps_per_iter = int(world_envs * cfg.algo.rollout_steps)
     total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
     if state is not None:
         cfg.algo.per_rank_batch_size = state["batch_size"]
@@ -315,12 +320,15 @@ def main(fabric, cfg: Dict[str, Any]):
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
-    # Jitted pieces
-    local_batch_global = cfg.algo.rollout_steps * cfg.env.num_envs
-    if local_batch_global % fabric.world_size != 0:
+    # Jitted pieces. Each process contributes its local rollout rows;
+    # shard_data assembles the GLOBAL batch (concat over processes), so the
+    # per-device row count divides the global batch, not the local one.
+    local_batch = cfg.algo.rollout_steps * cfg.env.num_envs
+    global_batch = local_batch * n_proc
+    if global_batch % fabric.world_size != 0:
         raise ValueError(
-            f"rollout_steps*num_envs ({local_batch_global}) must be divisible by the number of devices "
-            f"({fabric.world_size})"
+            f"rollout_steps*num_envs*processes ({global_batch}) must be divisible by the number of "
+            f"devices ({fabric.world_size})"
         )
     sentinel_cfg = (cfg.get("fault") or {}).get("sentinel") or {}
     guard = bool(sentinel_cfg.get("enabled", True))
@@ -332,7 +340,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # hygiene fixture, implicit transfers) are budget violations.
     train_fn = tracecheck.instrument(
         make_train_step(
-            agent, tx, cfg, fabric.mesh, local_batch_global // fabric.world_size, guard=guard
+            agent, tx, cfg, fabric.mesh, global_batch // fabric.world_size, guard=guard
         ),
         name="ppo.train_step",
     )
@@ -378,7 +386,7 @@ def main(fabric, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         profiler.tick(iter_num)
         for _ in range(0, cfg.algo.rollout_steps):
-            policy_step += cfg.env.num_envs
+            policy_step += world_envs
 
             with timer("Time/env_interaction_time", SumMetric):
                 jobs = prepare_obs(fabric, next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
@@ -530,8 +538,20 @@ def main(fabric, cfg: Dict[str, Any]):
                 iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
             )
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+        # Pod worker plumbing: publish the completed global step to the
+        # launcher's heartbeat file, and agree ACROSS RANKS on rank-0's drain
+        # flag — SIGTERM delivery timing differs per worker, and a gang where
+        # one rank checkpoints-and-exits while another enters the next
+        # rollout deadlocks in the collectives.
+        pod_runtime.beat_step(policy_step)
+        drain_now = pod_runtime.drain_requested()
+        if n_proc > 1:
+            drain_now = bool(np.asarray(fabric.broadcast_obj(np.asarray(drain_now, dtype=np.int32), src=0)))
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+            or drain_now
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -546,6 +566,13 @@ def main(fabric, cfg: Dict[str, Any]):
             }
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
             fabric.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+        if drain_now:
+            # checkpoint-and-exit: the pod launcher drains outermost-first,
+            # and a worker that exits 0 here is generation teardown, not a
+            # failure — the non-daemon checkpoint writer settles before exit
+            print(f"Rank-{rank}: drain requested — checkpointed at policy_step={policy_step}, exiting")
+            break
 
     envs.close()
     profiler.close()

@@ -264,8 +264,13 @@ def run_algorithm(cfg: DotDict) -> None:
 
     from sheeprl_tpu.parallel import Fabric
     from sheeprl_tpu.parallel.distributed import maybe_init
+    from sheeprl_tpu.parallel.pod import maybe_start_worker_runtime
     from sheeprl_tpu.utils.callback import CheckpointCallback
 
+    # pod worker runtime (heartbeat thread + SIGTERM drain flag) BEFORE the
+    # slow bring-up below: the launcher's liveness lease must survive
+    # jax.distributed connect + mesh compile stalls
+    maybe_start_worker_runtime()
     # multi-host bring-up BEFORE the fabric builds its mesh: config-driven
     # (fabric.distributed.*) with the SHEEPRL_* env vars as the pod
     # runtime's per-host override
@@ -374,6 +379,31 @@ def _extract_fleet_flag(args: List[str]) -> Tuple[List[str], Optional[int]]:
     return out, fleet
 
 
+def _extract_pod_flag(args: List[str]) -> Tuple[List[str], Optional[int]]:
+    """Pull ``--pod [N]`` / ``--pod=N`` out of hydra-style args; returns
+    (remaining args, worker count or None). Bare ``--pod`` means 2."""
+    out: List[str] = []
+    pod: Optional[int] = None
+    i = 0
+    while i < len(args):
+        tok = args[i]
+        if tok == "--pod":
+            if i + 1 < len(args) and args[i + 1].isdigit():
+                pod = int(args[i + 1])
+                i += 2
+            else:
+                pod = 2
+                i += 1
+            continue
+        if tok.startswith("--pod="):
+            pod = int(tok.split("=", 1)[1])
+            i += 1
+            continue
+        out.append(tok)
+        i += 1
+    return out, pod
+
+
 def serve(args: Optional[List[str]] = None, fleet: Optional[int] = None, require_fleet: bool = False) -> None:
     """Serve a checkpoint behind the continuous-batching inference tier
     (``sheeprl_tpu serve checkpoint_path=... [serve.buckets=[1,8,32] ...]``).
@@ -454,12 +484,28 @@ def available_agents() -> None:
 
 
 def run(args: Optional[List[str]] = None) -> None:
-    """Train (reference: ``cli.py:357-365``)."""
+    """Train (reference: ``cli.py:357-365``).
+
+    ``--pod N`` (or ``fabric.pod.workers=N``) trains over a gang-supervised
+    pod of N worker processes spanning ONE ``jax.distributed`` mesh instead
+    of a single process (howto/fault_tolerance.md#pod-training)."""
     args = list(sys.argv[1:] if args is None else args)
+    args, pod_flag = _extract_pod_flag(args)
     cfg = compose(args)
     from sheeprl_tpu.utils.utils import print_config
 
     print_config(cfg)
+    if pod_flag is not None:
+        cfg.fabric.pod.workers = int(pod_flag)
+    pod_workers = int(((cfg.get("fabric") or {}).get("pod") or {}).get("workers", 0) or 0)
+    from sheeprl_tpu.parallel.pod import pod_worker_active, run_pod
+
+    if (pod_flag is not None or pod_workers) and not pod_worker_active():
+        # an operator who asked for a POD must get one or a loud error —
+        # PodLauncher enforces workers >= 2 (same contract as the serve fleet)
+        check_configs(cfg)
+        run_pod(cfg, args)
+        return
     if cfg.checkpoint.resume_from:
         cfg = resolve_resume_latest(cfg)
         cfg = resume_from_checkpoint(cfg)

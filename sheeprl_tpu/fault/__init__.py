@@ -8,7 +8,9 @@ supervision runtime for the async tiers — heartbeat leases, bounded
 restarts, restart→degrade→abort escalation
 (:mod:`~sheeprl_tpu.fault.supervisor`) — its PROCESS twin for serve-fleet
 replicas with health-probe liveness leases and SIGKILL-vs-hang detection
-(:mod:`~sheeprl_tpu.fault.procsup`), and the deterministic
+(:mod:`~sheeprl_tpu.fault.procsup`), the gang-restart tier for multi-host
+training pods where one worker failure condemns the whole mesh generation
+(:mod:`~sheeprl_tpu.fault.podsup`), and the deterministic
 fault/chaos-injection harness that keeps all of it tested
 (:mod:`~sheeprl_tpu.fault.inject`). See ``howto/fault_tolerance.md``.
 """
@@ -28,6 +30,7 @@ from sheeprl_tpu.fault.manager import (
     load_resume_state,
     read_manifest,
 )
+from sheeprl_tpu.fault.podsup import PodSupervisor
 from sheeprl_tpu.fault.procsup import ProcessHungError, ProcessSupervisor, ReplicaHandle
 from sheeprl_tpu.fault.sentinel import DivergenceError, DivergenceSentinel
 from sheeprl_tpu.fault.supervisor import (
@@ -52,6 +55,7 @@ __all__ = [
     "FlakyEnv",
     "HungWorkerError",
     "NaNInjector",
+    "PodSupervisor",
     "ProcessHungError",
     "ProcessSupervisor",
     "ReplicaHandle",

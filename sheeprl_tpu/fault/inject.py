@@ -60,6 +60,7 @@ __all__ = [
     "reset",
     "release_hangs",
     "set_replica_chaos",
+    "set_host_chaos",
     "truncate_file",
     "scramble_file",
     "corrupt_checkpoint_arrays",
@@ -72,7 +73,7 @@ KILL_ENV_VAR = "SHEEPRL_FAULT_KILL"
 ARM_ENV_VAR = "SHEEPRL_FAULT_ARM"
 NAN_ENV_VAR = "SHEEPRL_FAULT_NAN_AT"
 
-_ACTIONS = ("raise", "kill", "kill-thread", "hang", "kill-replica", "hang-replica")
+_ACTIONS = ("raise", "kill", "kill-thread", "hang", "kill-replica", "hang-replica", "kill-host", "hang-host")
 
 _counts: Dict[str, int] = {}
 _armed: Dict[str, Tuple[str, int, float]] = {}  # point -> (action, Nth-hit, hang_s)
@@ -82,6 +83,10 @@ _hang_release = threading.Event()
 # "hang-replica" actions dispatch to them. Armed from the same seeded
 # fault.chaos.events schedule as every other point.
 _replica_chaos: Dict[str, Optional[Any]] = {"kill": None, "hang": None}
+# host-tier chaos (graft-pod): the pod launcher registers callables that
+# SIGKILL / SIGSTOP one of its training WORKER processes (a whole "host" of
+# the pod mesh); the "kill-host" / "hang-host" actions dispatch to them.
+_host_chaos: Dict[str, Optional[Any]] = {"kill": None, "hang": None}
 
 
 class FaultInjected(RuntimeError):
@@ -128,6 +133,16 @@ def set_replica_chaos(kill: Optional[Any] = None, hang: Optional[Any] = None) ->
     _replica_chaos["hang"] = hang
 
 
+def set_host_chaos(kill: Optional[Any] = None, hang: Optional[Any] = None) -> None:
+    """Register the host-tier chaos handlers (the pod launcher does this at
+    start): ``kill()`` SIGKILLs one live training worker process, ``hang()``
+    wedges one (SIGSTOP — the dead-host vs wedged-host pair of the pod
+    drills). The ``kill-host`` / ``hang-host`` actions dispatch here; unarmed
+    or unregistered they are no-ops. Cleared by :func:`reset`."""
+    _host_chaos["kill"] = kill
+    _host_chaos["hang"] = hang
+
+
 def release_hangs() -> None:
     """Wake every thread currently stalled in a ``hang`` fault point (and any
     future one until the next :func:`reset`) — test teardown's escape hatch."""
@@ -141,6 +156,8 @@ def reset() -> None:
     _counts.clear()
     _replica_chaos["kill"] = None
     _replica_chaos["hang"] = None
+    _host_chaos["kill"] = None
+    _host_chaos["hang"] = None
     _hang_release.set()  # release any thread still stalled in a hang
     _hang_release = threading.Event()
 
@@ -213,11 +230,13 @@ def fault_point(point: str) -> None:
         return
     if action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)  # the preemption model: no cleanup
-    if action in ("kill-replica", "hang-replica"):
-        # process-tier chaos: dispatch to the fleet-registered handler; the
-        # CALLING thread (the router's poll loop) keeps running — the drill
-        # is that the fleet survives, not that the caller dies
-        handler = _replica_chaos.get(action.split("-", 1)[0])
+    if action in ("kill-replica", "hang-replica", "kill-host", "hang-host"):
+        # process-tier chaos: dispatch to the registered handler (fleet
+        # router for -replica, pod launcher for -host); the CALLING thread
+        # (the owner's poll loop) keeps running — the drill is that the
+        # fleet/pod survives, not that the caller dies
+        registry = _host_chaos if action.endswith("-host") else _replica_chaos
+        handler = registry.get(action.split("-", 1)[0])
         if handler is not None:
             handler()
         return

@@ -107,6 +107,48 @@ def _write_manifest(ckpt_dir: Path, entries: List[Dict[str, Any]]) -> None:
     os.replace(tmp, path)
 
 
+def _sidecar_sizes(path: Path) -> Dict[str, int]:
+    """Per-file byte sizes of ``path``'s sidecars (``.arrays`` orbax dir and
+    ``.rb`` replay pickle), keyed by path relative to the checkpoint dir —
+    the completeness marker recorded in the manifest at publish time.
+    Sidecars are immutable once the meta commits, so a later size mismatch
+    means torn/truncated bytes (e.g. a gang restart racing a mid-save
+    SIGKILL), not legitimate drift."""
+    out: Dict[str, int] = {}
+    arrays = Path(str(path) + ".arrays")
+    if arrays.is_dir():
+        for p in sorted(arrays.rglob("*")):
+            if p.is_file():
+                try:
+                    out[str(p.relative_to(path.parent))] = p.stat().st_size
+                except OSError:
+                    pass
+    rb = Path(str(path) + ".rb")
+    if rb.is_file():
+        try:
+            out[rb.name] = rb.stat().st_size
+        except OSError:
+            pass
+    return out
+
+
+def _sidecars_intact(path: Path, entry: Dict[str, Any]) -> bool:
+    """Check a manifest entry's recorded sidecar sizes against the on-disk
+    files. Entries without the marker (pre-PR17 manifests, bare-scan merges)
+    pass — existence was already probed by :func:`_verify`."""
+    recorded = entry.get("sidecars")
+    if not isinstance(recorded, dict) or not recorded:
+        return True
+    for rel, size in recorded.items():
+        p = path.parent / str(rel)
+        try:
+            if p.stat().st_size != int(size):
+                return False
+        except (OSError, ValueError):
+            return False
+    return True
+
+
 def _verify(path: Path) -> bool:
     """Cheap completeness probe: meta unpickles and the sidecars it promises
     exist. (Deep corruption inside the orbax dir surfaces at ``load_state``
@@ -134,21 +176,32 @@ def _complete_entries(ckpt_dir: Path) -> List[Tuple[float, int, Path]]:
     manifest (pre-manifest runs, foreign ranks) are merged in via mtime."""
     ckpt_dir = Path(ckpt_dir)
     out: Dict[Path, Tuple[float, int, Path]] = {}
+    rejected: set = set()
     for e in read_manifest(ckpt_dir):
         p = ckpt_dir / str(e["file"])
         if not _verify(p):
+            rejected.add(p)
             continue
         expected = e.get("digest")
         if expected:
             try:
-                if _digest(p) != expected:  # bit-rot / partial overwrite of the meta
+                if _digest(p) != expected:
+                    # bit-rot / stale manifest record of the META: drop the
+                    # manifest's trust but leave the file scan-eligible — the
+                    # meta itself unpickles, so the save may still be whole
                     continue
             except OSError:
                 continue
+        if not _sidecars_intact(p, e):  # torn sidecar bytes (truncated .arrays/.rb)
+            rejected.add(p)
+            continue
         out[p] = (float(e.get("time", 0.0)), int(e.get("step", _parse_step(p.name) or 0)), p)
     if ckpt_dir.is_dir():
+        # bare-scan merge (pre-manifest runs, foreign ranks) — but an entry
+        # with TORN SIDECARS must not be resurrected by the weaker
+        # existence-only probe (the sidecar damage is invisible to _verify)
         for p in ckpt_dir.glob("*.ckpt"):
-            if p not in out and _verify(p):
+            if p not in out and p not in rejected and _verify(p):
                 step = _parse_step(p.name)
                 out[p] = (p.stat().st_mtime, step if step is not None else 0, p)
     return sorted(out.values(), key=lambda t: (t[1], t[0]))
@@ -310,6 +363,10 @@ class CheckpointManager:
                 "format_version": 2,
                 "digest": _digest(path),
                 "has_rb": rb_bytes is not None,
+                # completeness marker: recorded byte sizes of every sidecar
+                # file; resume discovery (_sidecars_intact) skips the entry if
+                # any file was torn after publish
+                "sidecars": _sidecar_sizes(path),
             }
         )
         entries.sort(key=lambda e: (int(e.get("step", 0)), float(e.get("time", 0.0))))

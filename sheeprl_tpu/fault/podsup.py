@@ -1,0 +1,261 @@
+"""Gang supervision for multi-host pod TRAINING: the pod tier of procsup.
+
+The serve fleet (:mod:`.procsup`) restarts replicas one at a time because
+replicas are independent — the router just routes around the hole. A training
+pod is the opposite: the N worker processes jointly own ONE process-spanning
+``jax.distributed`` mesh, and JAX meshes cannot elastically rejoin — a
+respawned worker can never re-enter the old gang's collectives. Any worker
+failure therefore condemns the whole generation:
+
+- **detection is inherited.** :class:`PodSupervisor` reuses
+  :class:`~sheeprl_tpu.fault.procsup.ProcessSupervisor`'s engine verbatim:
+  ``proc.poll()`` deaths with ``rc < 0`` counted in ``kills`` (signal named),
+  heartbeat-lease expiry with the process alive counted in ``hangs`` (the
+  supervisor SIGKILLs the wedged worker itself — a worker frozen by SIGSTOP
+  or wedged in a collective cannot be preempted any other way).
+- **recovery is gang restart, not respawn.** The first abnormal death of a
+  generation marks the gang dirty; survivors are drained (SIGTERM, a short
+  grace for their own checkpoint-and-exit, SIGKILL stragglers — a survivor
+  blocked in a cross-host collective will never see the SIGTERM flag) and
+  the WHOLE pod respawns from the latest complete checkpoint. ``rc == 0``
+  is a worker that finished training — never a gang trigger.
+- **the same ladder and knob shape.** ``restart`` / ``degrade`` / ``abort``
+  with ``max_restarts`` + exponential ``backoff`` (``fabric.pod.*``), and the
+  SAME typed errors as ``fault.supervisor``. One pod-specific collapse:
+  a pod cannot train on a partial mesh, so ``degrade`` past the budget is a
+  drained stop raising :class:`~sheeprl_tpu.fault.supervisor.AllWorkersDeadError`
+  (documented in howto/fault_tolerance.md#pod-training) rather than
+  limping on survivors.
+
+The launcher (:mod:`sheeprl_tpu.parallel.pod`) owns everything
+training-specific: worker commands/env, heartbeat files, resume resolution
+and checkpoint-step fencing — wired through the ``on_gang_restart(generation)``
+hook which runs BEFORE the new generation spawns.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional
+
+from sheeprl_tpu.fault.procsup import (
+    _DEGRADED,
+    _RUNNING,
+    _STOPPED,
+    ProcessSupervisor,
+    ReplicaHandle,
+)
+from sheeprl_tpu.fault.supervisor import AllWorkersDeadError, WorkerAbortError
+
+__all__ = ["PodSupervisor"]
+
+# gang-level states (the per-worker vocabulary stays procsup's)
+_GANG_IDLE = "idle"
+_GANG_BACKOFF = "backoff"  # dirty generation drained, respawn scheduled
+_GANG_DEGRADED = "degraded"  # budget exhausted: drained stop, typed error raised
+
+
+class PodSupervisor(ProcessSupervisor):
+    """Supervise N training workers as ONE gang (see module docstring).
+
+    The owner drives the engine exactly like the fleet: :meth:`beat` per
+    worker heartbeat, :meth:`check` on the poll cadence. ``check`` inherits
+    death/hang detection, then runs the gang ladder instead of per-worker
+    respawns.
+    """
+
+    def __init__(
+        self,
+        *,
+        drain_s: float = 5.0,
+        on_gang_restart: Optional[Callable[[int], None]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        # how long drained survivors get to checkpoint-and-exit before the
+        # stragglers (typically blocked in a dead collective) are SIGKILLed
+        self.drain_s = max(0.0, float(drain_s))
+        self.on_gang_restart = on_gang_restart
+        self.pod_restarts = 0  # gang respawns actually executed
+        self.generation = 0  # pod generation (1 = first spawn_gang)
+        self._gang_state = _GANG_IDLE
+        self._gang_reason: Optional[str] = None
+        self._gang_not_before = 0.0
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]] = None, **defaults: Any) -> "PodSupervisor":
+        """Build from a ``fabric.pod``-shaped mapping — the procsup merge
+        contract plus the pod-only ``drain_s`` knob."""
+        cfg = dict(cfg or {})
+        drain = cfg.get("drain_s")
+        if drain is None:
+            drain = defaults.pop("drain_s", 5.0)
+        else:
+            defaults.pop("drain_s", None)
+        sup = super().from_config(cfg, **defaults)
+        sup.drain_s = max(0.0, float(drain))
+        return sup
+
+    # -- gang lifecycle -------------------------------------------------------
+    def spawn_gang(self, spawners: Dict[str, Callable[[], subprocess.Popen]]) -> List[ReplicaHandle]:
+        """Launch every worker of the first generation. ``spawners`` maps
+        worker name -> spawn closure; closures are re-invoked verbatim on
+        gang respawn (the launcher's ``on_gang_restart`` hook mutates the
+        shared launch context — fresh coordinator port, resume args — that
+        the closures read)."""
+        with self._lock:
+            self.generation += 1
+        return [self.spawn(name, fn) for name, fn in spawners.items()]
+
+    def finished(self) -> bool:
+        """Every worker exited rc == 0 (training complete) — pod done."""
+        with self._lock:
+            return bool(self._replicas) and all(
+                h.state == _STOPPED and h.last_rc == 0 for h in self._replicas.values()
+            )
+
+    def gang_info(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._gang_state,
+                "generation": self.generation,
+                "pod_restarts": self.pod_restarts,
+                "reason": self._gang_reason,
+            }
+
+    # -- the engine -----------------------------------------------------------
+    def _on_death(self, handle: ReplicaHandle, what: str, hang: bool, now: float) -> None:
+        """A worker died or was SIGKILLed as a hang victim: never respawn it
+        individually — park it and mark the GANG dirty. ``rc == 0`` is a
+        normal training completion, not a failure."""
+        if self.stopping or handle.retired:
+            handle.state = _STOPPED
+            return
+        if not hang and handle.last_rc == 0:
+            handle.state = _STOPPED  # finished training; see finished()
+            return
+        handle.deaths += 1
+        handle.last_error = what
+        handle.state = _STOPPED  # parked until the gang ladder respawns ALL
+        with self._lock:  # reentrant: _on_death runs under the engine's pass
+            first = self._gang_reason is None
+            if first:
+                self._gang_reason = f"worker '{handle.name}' {what}"
+        if first:
+            warnings.warn(
+                f"[{self.name}] worker '{handle.name}' {what} — a JAX pod mesh cannot "
+                "rejoin: draining survivors for a gang restart"
+            )
+
+    def check(self) -> None:
+        """One supervision pass: inherited detection (deaths, hangs → SIGKILL
+        the wedged worker), then the gang ladder — drain survivors of a dirty
+        generation, schedule/execute the full-pod respawn, escalate past the
+        budget. Raises :class:`WorkerAbortError` (``escalation=abort``) or
+        :class:`AllWorkersDeadError` (``degrade`` past the budget — a pod
+        cannot train on a partial mesh)."""
+        if self.stopping:
+            return
+        super().check()
+        self._gang_ladder()
+
+    def _gang_ladder(self) -> None:
+        now = self._clock()
+        with self._lock:
+            reason = self._gang_reason
+            state = self._gang_state
+        if reason is not None and state == _GANG_IDLE:
+            self._drain_survivors()
+            with self._lock:
+                if self.escalation == "restart" or self.pod_restarts < self.max_restarts:
+                    delay = self.backoff * (2.0**self.pod_restarts)
+                    self._gang_state = _GANG_BACKOFF
+                    self._gang_not_before = now + delay
+                    warnings.warn(
+                        f"[{self.name}] gang restart in {delay:g}s "
+                        f"(pod restart {self.pod_restarts + 1}"
+                        + ("" if self.escalation == "restart" else f"/{self.max_restarts}")
+                        + f"): {reason}"
+                    )
+                else:
+                    self._gang_state = _GANG_DEGRADED
+                    errors = {
+                        name: RuntimeError(h.last_error or reason)
+                        for name, h in self._replicas.items()
+                    }
+                    for h in self._replicas.values():
+                        h.state = _DEGRADED
+                    if self.escalation == "abort":
+                        raise WorkerAbortError(self.name, RuntimeError(reason))
+                    warnings.warn(
+                        f"[{self.name}] pod restart budget ({self.max_restarts}) exhausted — "
+                        f"a pod cannot train on a partial mesh, stopping: {reason}"
+                    )
+                    raise AllWorkersDeadError(errors)
+            return
+        if state == _GANG_BACKOFF and now >= self._gang_not_before:
+            self._gang_respawn(now)
+
+    def _drain_survivors(self) -> None:
+        """SIGTERM the dirty generation's survivors so they checkpoint-and-
+        exit, SIGKILL whoever is still alive past ``drain_s`` (a survivor
+        blocked in a cross-host collective never reaches its drain check).
+        Their exits are generation teardown, not new failures — no counters."""
+        with self._lock:
+            survivors = [
+                h for h in self._replicas.values() if h.state == _RUNNING and h.is_alive()
+            ]
+            for h in survivors:
+                h.state = _STOPPED  # claimed: detection must not re-read the exit
+        for h in survivors:
+            try:
+                h.proc.terminate()
+            except OSError:
+                pass
+        deadline = self._clock() + self.drain_s
+        for h in survivors:
+            try:
+                h.proc.wait(timeout=max(0.0, deadline - self._clock()))
+            except subprocess.TimeoutExpired:
+                warnings.warn(
+                    f"[{self.name}] worker '{h.name}' did not drain within {self.drain_s:g}s — SIGKILL"
+                )
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            with self._lock:
+                h.last_rc = h.proc.poll()
+
+    def _gang_respawn(self, now: float) -> None:
+        with self._lock:
+            self.pod_restarts += 1
+            self.generation += 1
+            generation = self.generation
+            self._gang_state = _GANG_IDLE
+            self._gang_reason = None
+            handles = list(self._replicas.values())
+        if self.on_gang_restart is not None:
+            try:
+                self.on_gang_restart(generation)
+            except Exception as e:
+                with self._lock:
+                    self._gang_reason = f"on_gang_restart hook failed: {type(e).__name__}: {e}"
+                    warnings.warn(f"[{self.name}] {self._gang_reason}")
+                return
+        with self._lock:
+            for handle in handles:
+                if handle.retired:
+                    continue
+                handle.restarts += 1
+                try:
+                    self._launch(handle)
+                except Exception as e:  # spawn itself failed (port race, exec error)
+                    handle.state = _STOPPED
+                    handle.last_error = f"respawn failed: {type(e).__name__}: {e}"
+                    if self._gang_reason is None:
+                        self._gang_reason = f"worker '{handle.name}' {handle.last_error}"
+                        warnings.warn(f"[{self.name}] {self._gang_reason}")

@@ -1,3 +1,10 @@
+from sheeprl_tpu.parallel.distributed import CoordinatorConnectError, maybe_init
 from sheeprl_tpu.parallel.fabric import Fabric, Precision, get_single_device_fabric
 
-__all__ = ["Fabric", "Precision", "get_single_device_fabric"]
+__all__ = [
+    "CoordinatorConnectError",
+    "Fabric",
+    "Precision",
+    "get_single_device_fabric",
+    "maybe_init",
+]

@@ -16,11 +16,27 @@ pod-runtime override (one launch command, per-host env) and win over config.
 from __future__ import annotations
 
 import os
+import time
+import warnings
 from typing import Any, Dict, Optional
 
 import jax
 
 _initialized = False
+
+
+class CoordinatorConnectError(ConnectionError):
+    """``jax.distributed.initialize`` could not reach the coordinator after
+    the configured connect-retry budget. Names the coordinator address so a
+    pod operator can tell a dead coordinator host from a bad config."""
+
+    def __init__(self, coordinator: str, attempts: int, cause: BaseException) -> None:
+        self.coordinator = coordinator
+        self.attempts = attempts
+        super().__init__(
+            f"could not join the jax.distributed runtime at coordinator "
+            f"'{coordinator}' after {attempts} attempt(s): {type(cause).__name__}: {cause}"
+        )
 
 
 def maybe_init(
@@ -40,6 +56,14 @@ def maybe_init(
     silently training solo); ``enabled: null`` (the default) auto-detects —
     initialize iff a coordinator or process count was provided somewhere.
     No-op when already initialized or single-process.
+
+    Startup ordering is NOT guaranteed in a gang-spawned pod: a worker may
+    call this before the coordinator (process 0) is listening. The connect is
+    therefore retried with bounded exponential backoff
+    (``cfg.connect_retries`` extra attempts, ``cfg.connect_backoff_s`` base
+    delay, optional ``cfg.init_timeout_s`` per-attempt jax initialization
+    timeout); exhaustion raises :class:`CoordinatorConnectError` naming the
+    coordinator address instead of a raw RuntimeError.
     """
     global _initialized
     if _initialized:
@@ -69,10 +93,39 @@ def maybe_init(
                 "joins the same jax.distributed runtime instead of silently training solo"
             )
         return False  # single host
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    # CPU backend: cross-process computations need an explicit collectives
+    # implementation (the default "none" raises "Multiprocess computations
+    # aren't implemented on the CPU backend" at the first collective). Gloo
+    # ships in jaxlib; the flag only shapes CPU client creation, so it is
+    # harmless on real accelerators. Must be set BEFORE initialize().
+    if not os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # pragma: no cover - older/newer jaxlib knob drift
+            warnings.warn(f"could not select gloo CPU collectives: {e}")
+    retries = max(0, int(cfg.get("connect_retries", 3) or 0))
+    backoff_s = max(0.0, float(cfg.get("connect_backoff_s", 1.0) or 0.0))
+    init_kwargs: Dict[str, Any] = {}
+    if cfg.get("init_timeout_s"):
+        init_kwargs["initialization_timeout"] = int(cfg["init_timeout_s"])
+    for attempt in range(retries + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **init_kwargs,
+            )
+            break
+        except Exception as e:
+            if attempt >= retries:
+                raise CoordinatorConnectError(str(coordinator_address), retries + 1, e) from e
+            delay = backoff_s * (2.0**attempt)
+            warnings.warn(
+                f"jax.distributed connect to coordinator '{coordinator_address}' failed "
+                f"(attempt {attempt + 1}/{retries + 1}): {type(e).__name__}: {e} — "
+                f"retrying in {delay:g}s"
+            )
+            time.sleep(delay)
     _initialized = True
     return True

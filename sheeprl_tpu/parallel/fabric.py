@@ -129,6 +129,12 @@ class Fabric:
         return jax.process_index()
 
     @property
+    def process_count(self) -> int:
+        """Number of processes in the ``jax.distributed`` runtime (1 when
+        single-host). Pod training spans the mesh over this many workers."""
+        return jax.process_count()
+
+    @property
     def node_rank(self) -> int:
         return jax.process_index()
 

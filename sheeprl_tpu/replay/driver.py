@@ -99,7 +99,7 @@ class SequenceRingDriver:
         # Packed flushes read the key bytes on the host; a device-resident
         # key would cost one device pull per env step (threefry is platform-
         # deterministic, so the stream is unchanged).
-        self._host_device = jax.devices("cpu")[0]
+        self._host_device = jax.local_devices(backend="cpu")[0]
         self._key = jax.device_put(jax.random.PRNGKey(seed), self._host_device)
         if isinstance(restore, DeviceReplayState):
             self.load_state_dict(restore)

@@ -116,7 +116,7 @@ class HostSnapshot:
     """
 
     def __init__(self, subset_fn: Callable[[Any], Any], params: Any, wire_dtype=jnp.bfloat16):
-        self.host_device = jax.devices("cpu")[0]
+        self.host_device = jax.local_devices(backend="cpu")[0]
         # Pull the subset once to build the unravel spec — as ONE pipelined
         # batch of transfers, not leaf-by-leaf blocking pulls (a remote
         # accelerator charges a full round-trip per blocking pull).

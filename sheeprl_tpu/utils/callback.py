@@ -34,6 +34,11 @@ class CheckpointCallback:
         self.manager = manager
 
     def _save(self, fabric, ckpt_path: str, state: Dict[str, Any]) -> None:
+        # Pod (multi-process) runs: the checkpointed state is replicated, so
+        # rank 0's save IS the full checkpoint — the other ranks writing
+        # duplicate payloads would only burn IO and tear the manifest.
+        if getattr(fabric, "process_count", 1) > 1 and not fabric.is_global_zero:
+            return
         if self.manager is not None:
             self.manager.save(ckpt_path, state, publish=fabric.is_global_zero)
         else:
@@ -77,6 +82,8 @@ class CheckpointCallback:
             self._experiment_consistent_rb(replay_buffer, rb_state)
 
     def on_checkpoint_trainer(self, fabric, state: Dict[str, Any], ckpt_path: str) -> None:
+        if getattr(fabric, "process_count", 1) > 1 and not fabric.is_global_zero:
+            return
         if self.manager is not None:
             self.manager.save(ckpt_path, state, publish=fabric.is_global_zero)
         else:

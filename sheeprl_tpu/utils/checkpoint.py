@@ -72,10 +72,27 @@ def stage_to_host(tree: Any) -> Any:
     checkpoint path calls this on the training thread and finalizes on the
     writer thread, overlapping the transfer + serialization with the next
     train block."""
-    cpu = jax.devices("cpu")[0]
+    # local_devices, not devices: in a multi-process pod the global device
+    # list leads with process 0's devices, and device_put to another
+    # process's CPU is a fatal XLA error on every rank but 0
+    cpu = jax.local_devices(backend="cpu")[0]
 
     def pull(x):
         if isinstance(x, jax.Array):
+            if not x.is_fully_addressable:
+                # multi-process global array: device_put refuses these. The
+                # checkpointed state (params/optimizer/rng) is REPLICATED, so
+                # any local shard IS the full value — pull that instead of a
+                # cross-host gather. A sharded leaf here would silently save
+                # one host's slice, hence the loud error.
+                shard = x.addressable_shards[0].data
+                if shard.shape != x.shape:
+                    raise CheckpointError(
+                        f"cannot checkpoint a cross-process SHARDED array (global shape "
+                        f"{x.shape}, local shard {shard.shape}) — only replicated state "
+                        "is checkpointable from a pod worker"
+                    )
+                x = shard
             return jax.device_put(x, cpu)
         return x
 
@@ -102,6 +119,20 @@ def _to_host(tree: Any) -> Any:
 def _checkpointer():
     import orbax.checkpoint as ocp
 
+    if jax.process_count() > 1:
+        # Pod workers save rank-LOCALLY (the checkpointed state is replicated
+        # and rank 0 is the only writer — see CheckpointCallback._save). The
+        # default Checkpointer barriers EVERY process on a key derived from
+        # the save path, which can never agree across ranks saving different
+        # paths (or not saving at all) — scope the barrier to this process.
+        me = jax.process_index()
+        local = ocp.options.MultiprocessingOptions(
+            primary_host=None, active_processes={me}, barrier_sync_key_prefix=f"rank{me}"
+        )
+        return ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(multiprocessing_options=local),
+            multiprocessing_options=local,
+        )
     return ocp.PyTreeCheckpointer()
 
 

@@ -119,10 +119,19 @@ def get_log_dir(cfg: Mapping[str, Any], root_dir: str, run_name: str, share: boo
         os.makedirs(log_dir, exist_ok=True)
     else:  # pragma: no cover - multi-host only
         log_dir = ""
-    if share and jax.process_count() > 1:  # pragma: no cover - multi-host only
+    if share and jax.process_count() > 1:  # pragma: no cover - exercised by the pod drills
+        import numpy as np
         from jax.experimental import multihost_utils
 
-        log_dir = multihost_utils.broadcast_one_to_all(log_dir)
-        if isinstance(log_dir, bytes):
-            log_dir = log_dir.decode()
+        # broadcast_one_to_all moves ARRAYS, not python strings — ship the
+        # path as a fixed-width uint8 buffer (every process must contribute
+        # the same shape)
+        buf = np.zeros(4096, dtype=np.uint8)
+        raw = log_dir.encode("utf-8")
+        if len(raw) > buf.size:
+            raise ValueError(f"log dir path too long to broadcast ({len(raw)} bytes): {log_dir}")
+        buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        # the broadcast psum upcasts uint8 -> int32: cast back before decoding
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf)).astype(np.uint8)
+        log_dir = bytes(out).rstrip(b"\0").decode("utf-8")
     return log_dir

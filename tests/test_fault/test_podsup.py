@@ -1,0 +1,245 @@
+"""PodSupervisor unit drills: gang restart (never per-worker respawn),
+survivor draining, checkpoint-free hook ordering, budget exhaustion on the
+POD ladder, hang-vs-kill counters, and the pod knob shape. Children are tiny
+``python -c`` processes — no JAX, no training stack, just gang lifecycle."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sheeprl_tpu.fault.podsup import PodSupervisor
+from sheeprl_tpu.fault.supervisor import AllWorkersDeadError, WorkerAbortError
+
+SLEEPER = [sys.executable, "-c", "import time; time.sleep(120)"]
+CRASHER = [sys.executable, "-c", "import sys; sys.exit(3)"]
+FINISHER = [sys.executable, "-c", "pass"]  # exits rc=0: training complete
+STUBBORN = [
+    sys.executable,
+    "-c",
+    "import signal, time; signal.signal(signal.SIGTERM, signal.SIG_IGN); time.sleep(120)",
+]
+
+
+def _spawner(cmd, log=None, tag="spawn"):
+    def spawn():
+        if log is not None:
+            log.append(tag)
+        return subprocess.Popen(cmd)
+
+    return spawn
+
+
+def _wait(predicate, timeout=10.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def sup():
+    s = PodSupervisor(lease_s=None, backoff=0.01, max_restarts=2, join_s=10.0, drain_s=5.0)
+    yield s
+    s.request_stop()
+    s.terminate_all(grace_s=5.0)
+
+
+def test_kill_one_worker_gang_restarts_all(sup):
+    """One SIGKILLed worker condemns the generation: the survivor is DRAINED
+    (its exit is teardown, not a counted failure), the on_gang_restart hook
+    runs before any respawn, and the WHOLE gang comes back."""
+    order = []
+    sup.on_gang_restart = lambda gen: order.append(f"hook:{gen}")
+    sup.spawn_gang(
+        {
+            "w0": _spawner(SLEEPER, order, "spawn:w0"),
+            "w1": _spawner(SLEEPER, order, "spawn:w1"),
+        }
+    )
+    h0, h1 = sup.replica("w0"), sup.replica("w1")
+    assert sup.generation == 1
+    os.kill(h0.pid(), signal.SIGKILL)
+    assert _wait(lambda: h0.proc.poll() is not None)
+    with pytest.warns(UserWarning, match="gang restart"):
+        sup.check()  # death detected -> survivors drained -> backoff armed
+    assert h0.kills == 1 and h0.deaths == 1
+    # the drained survivor is generation teardown: no failure counters
+    assert h1.deaths == 0 and h1.kills == 0 and not h1.is_alive()
+    assert _wait(lambda: (sup.check() or (h0.is_alive() and h1.is_alive())))
+    assert sup.pod_restarts == 1 and sup.generation == 2
+    assert h0.restarts == 1 and h1.restarts == 1
+    # hook ran after the first generation's spawns and BEFORE the respawns
+    assert order == ["spawn:w0", "spawn:w1", "hook:2", "spawn:w0", "spawn:w1"]
+
+
+def test_all_workers_exit_zero_is_finished(sup):
+    """rc == 0 everywhere is training completion — no counters, no restart,
+    ``finished()`` flips."""
+    sup.spawn_gang({"w0": _spawner(FINISHER), "w1": _spawner(FINISHER)})
+    h0, h1 = sup.replica("w0"), sup.replica("w1")
+    assert _wait(lambda: h0.proc.poll() is not None and h1.proc.poll() is not None)
+    assert not sup.finished()
+    sup.check()  # no warning expected: these are normal completions
+    assert sup.finished()
+    assert sup.pod_restarts == 0 and h0.deaths == 0 and h1.deaths == 0
+
+
+def test_budget_exhausted_degrade_is_drained_stop():
+    """degrade past the pod budget is a DRAINED STOP raising
+    AllWorkersDeadError — a pod cannot train on a partial mesh."""
+    sup = PodSupervisor(lease_s=None, backoff=0.01, max_restarts=0, escalation="degrade", drain_s=5.0)
+    try:
+        sup.spawn_gang({"w0": _spawner(CRASHER), "w1": _spawner(SLEEPER)})
+        h0, h1 = sup.replica("w0"), sup.replica("w1")
+        assert _wait(lambda: h0.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="budget \\(0\\) exhausted"):
+            with pytest.raises(AllWorkersDeadError):
+                sup.check()
+        assert h0.state == "degraded" and h1.state == "degraded"
+        assert not h1.is_alive()  # survivor drained before the stop
+        assert sup.gang_info()["state"] == "degraded"
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_abort_escalation_raises_worker_abort():
+    sup = PodSupervisor(lease_s=None, backoff=0.01, max_restarts=0, escalation="abort", drain_s=5.0)
+    try:
+        sup.spawn_gang({"w0": _spawner(CRASHER), "w1": _spawner(SLEEPER)})
+        h0 = sup.replica("w0")
+        assert _wait(lambda: h0.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="gang restart|draining"):
+            with pytest.raises(WorkerAbortError, match="exited rc=3"):
+                sup.check()
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_gang_backoff_grows_exponentially():
+    """delay = backoff * 2^pod_restarts — the ladder's backoff is on POD
+    restarts, not per-worker ones."""
+    clock = FakeClock()
+    sup = PodSupervisor(
+        lease_s=None, backoff=1.0, max_restarts=5, drain_s=0.0, clock=clock, escalation="restart"
+    )
+    try:
+        sup.spawn_gang({"w0": _spawner(CRASHER), "w1": _spawner(CRASHER)})
+        h0, h1 = sup.replica("w0"), sup.replica("w1")
+        assert _wait(lambda: h0.proc.poll() is not None and h1.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="gang restart in 1s"):
+            sup.check()
+        assert sup._gang_not_before == pytest.approx(clock.t + 1.0)
+        clock.t += 1.0
+        sup.check()  # due: respawn generation 2 (crashers die again)
+        assert sup.pod_restarts == 1
+        assert _wait(lambda: h0.proc.poll() is not None and h1.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="gang restart in 2s"):
+            sup.check()
+        assert sup._gang_not_before == pytest.approx(clock.t + 2.0)
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_sigstop_hang_counts_distinctly_and_gang_restarts():
+    """A SIGSTOPped worker stops beating: lease expiry with the process alive
+    is a HANG (hangs++, kills unchanged) — the supervisor SIGKILLs it and the
+    gang ladder takes over."""
+    sup = PodSupervisor(lease_s=0.15, grace_s=0.15, backoff=0.01, max_restarts=2, drain_s=5.0)
+    try:
+        sup.spawn_gang({"w0": _spawner(SLEEPER), "w1": _spawner(SLEEPER)})
+        h0, h1 = sup.replica("w0"), sup.replica("w1")
+        os.kill(h0.pid(), signal.SIGSTOP)
+        deadline = time.monotonic() + 0.4
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            sup.beat("w1")  # the healthy worker keeps beating
+        with pytest.warns(UserWarning, match="hung: missed its 0.15s"):
+            sup.check()
+        assert h0.hangs == 1 and h0.kills == 0 and h0.deaths == 1
+        assert h1.hangs == 0 and h1.deaths == 0
+        assert _wait(lambda: (sup.check() or (h0.is_alive() and h1.is_alive())))
+        assert sup.pod_restarts == 1
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_drain_sigkills_stragglers():
+    """A survivor blocked past drain_s (modeled by a SIGTERM-ignoring child)
+    is SIGKILLed — a worker wedged in a dead collective never drains."""
+    sup = PodSupervisor(lease_s=None, backoff=0.01, max_restarts=2, drain_s=0.5)
+    try:
+        sup.spawn_gang({"w0": _spawner(CRASHER), "w1": _spawner(STUBBORN)})
+        h0, h1 = sup.replica("w0"), sup.replica("w1")
+        assert _wait(lambda: h0.proc.poll() is not None and h1.is_alive())
+        time.sleep(0.2)  # let the stubborn child install SIG_IGN
+        with pytest.warns(UserWarning, match="did not drain within 0.5s"):
+            sup.check()
+        assert not h1.is_alive()
+        assert h1.deaths == 0  # teardown, not failure
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_failed_hook_marks_gang_dirty_again():
+    """If on_gang_restart itself fails (e.g. resume resolution), the respawn
+    is NOT attempted half-configured — the gang stays dirty and retries."""
+    clock = FakeClock()
+    boom = {"n": 0}
+
+    def hook(gen):
+        boom["n"] += 1
+        if boom["n"] == 1:
+            raise RuntimeError("no complete checkpoint yet")
+
+    sup = PodSupervisor(
+        lease_s=None, backoff=0.1, max_restarts=5, drain_s=0.0, clock=clock,
+        escalation="restart", on_gang_restart=hook,
+    )
+    try:
+        sup.spawn_gang({"w0": _spawner(CRASHER)})
+        h0 = sup.replica("w0")
+        assert _wait(lambda: h0.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="gang restart"):
+            sup.check()
+        clock.t += 0.1
+        with pytest.warns(UserWarning, match="hook failed.*no complete checkpoint"):
+            sup.check()  # respawn due -> hook raises -> dirty again, no spawn
+        assert h0.proc.poll() is not None and sup.pod_restarts == 1
+        with pytest.warns(UserWarning, match="gang restart"):
+            sup.check()  # re-enters the ladder from the hook failure
+        clock.t += 10.0
+        assert _wait(lambda: (sup.check() or h0.proc.poll() is not None))
+        assert boom["n"] == 2
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_from_config_pod_knob_shape():
+    """fabric.pod knob shape: explicit keys win, drain_s rides along, lease
+    null disables hang detection — the fault.supervisor merge contract."""
+    sup = PodSupervisor.from_config(
+        {"max_restarts": 7, "lease_s": 0, "drain_s": 2.5, "escalation": "abort"},
+        backoff=0.25,
+        name="train-pod",
+        drain_s=9.0,
+    )
+    assert sup.max_restarts == 7 and sup.escalation == "abort"
+    assert sup.lease_s is None and sup.drain_s == 2.5
+    assert sup.backoff == 0.25 and sup.name == "train-pod"
+    # default drain_s applies when the cfg omits it
+    assert PodSupervisor.from_config({}, drain_s=9.0).drain_s == 9.0
+    assert PodSupervisor.from_config({}).drain_s == 5.0

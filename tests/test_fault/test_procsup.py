@@ -189,6 +189,85 @@ def test_retired_replica_is_never_respawned(sup):
     assert handle.state == "stopped" and handle.restarts == 0
 
 
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_budget_exactly_exhausted_degrades_not_aborts():
+    """max_restarts=1: the SECOND death finds restarts == max_restarts (not
+    <) and must DEGRADE, not respawn — the ladder's off-by-one edge."""
+    sup = ProcessSupervisor(lease_s=None, backoff=0.0, max_restarts=1, escalation="degrade")
+    try:
+        handle = sup.spawn("r0", _spawner(CRASHER))
+        assert _wait(lambda: handle.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="respawning"):
+            sup.check()  # death 1: within budget, zero-backoff respawn
+        assert _wait(lambda: handle.restarts == 1 or (sup.check() or False))
+        assert handle.restarts == 1
+        assert _wait(lambda: handle.proc.poll() is not None)
+        # death 2: budget EXACTLY spent -> degrade (not abort); with the last
+        # replica degraded the pool then raises the all-dead typed error
+        with pytest.warns(UserWarning, match="DEGRADED"):
+            with pytest.raises(AllWorkersDeadError):
+                sup.check()
+        assert handle.state == "degraded" and handle.restarts == 1
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_backoff_grows_exponentially_per_restart():
+    """delay = backoff * 2^restarts: each consecutive respawn of the same
+    replica waits twice as long (deterministic via an injected clock)."""
+    clock = _FakeClock()
+    sup = ProcessSupervisor(
+        lease_s=None, backoff=1.0, max_restarts=5, escalation="restart", clock=clock
+    )
+    try:
+        handle = sup.spawn("r0", _spawner(CRASHER))
+        expected = [1.0, 2.0, 4.0]
+        for restarts_so_far, delay in enumerate(expected):
+            assert _wait(lambda: handle.proc.poll() is not None)
+            with pytest.warns(UserWarning, match=f"respawning in {delay:g}s"):
+                sup.check()
+            assert handle._not_before == pytest.approx(clock.t + delay)
+            clock.t += delay
+            sup.check()  # due now: respawn (the crasher dies again)
+            assert handle.restarts == restarts_so_far + 1
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
+def test_mixed_sigkill_then_sigstop_counts_kills_and_hangs_separately():
+    """A SIGKILL death then a SIGSTOP hang on the SAME replica: kills and
+    hangs each count once, deaths counts both, and the recorded last_error
+    flips from the kill to the hang."""
+    clock = _FakeClock()
+    sup = ProcessSupervisor(lease_s=5.0, grace_s=5.0, backoff=0.0, max_restarts=4, clock=clock)
+    try:
+        handle = sup.spawn("r0", _spawner(SLEEPER))
+        os.kill(handle.pid(), signal.SIGKILL)
+        assert _wait(lambda: handle.proc.poll() is not None)
+        with pytest.warns(UserWarning, match="killed by SIGKILL"):
+            sup.check()
+        assert handle.kills == 1 and handle.hangs == 0 and handle.deaths == 1
+        assert _wait(lambda: (sup.check() or handle.is_alive()))
+        assert handle.restarts == 1
+        # generation 2 wedges: SIGSTOP freezes it, the lease expires silently
+        os.kill(handle.pid(), signal.SIGSTOP)
+        clock.t += 100.0
+        with pytest.warns(UserWarning, match="hung: missed its 5s health-probe lease"):
+            sup.check()
+        assert handle.kills == 1 and handle.hangs == 1 and handle.deaths == 2
+        assert "hung" in handle.last_error
+        assert _wait(lambda: (sup.check() or (handle.restarts == 2 and handle.is_alive())))
+    finally:
+        sup.terminate_all(grace_s=5.0)
+
+
 def test_from_config_knob_shape():
     """serve.fleet knob shape: explicit keys win over defaults; lease null
     disables hang detection — the fault.supervisor merge contract."""

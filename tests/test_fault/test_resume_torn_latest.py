@@ -1,0 +1,104 @@
+"""Checkpoint-integrity fallback for ``resume_from=latest`` (pod gang
+restarts): a SIGKILL racing a mid-save leaves a checkpoint whose meta
+committed but whose ``.arrays`` payload is TORN. The manifest's sidecar-size
+marker must reject it — ``latest_complete`` / ``find_latest_run_checkpoint``
+fall back to the previous complete save instead of handing a gang restart a
+checkpoint that explodes at ``load_state``."""
+
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.fault import inject
+from sheeprl_tpu.fault.manager import (
+    CheckpointManager,
+    find_latest_run_checkpoint,
+    latest_complete,
+    load_resume_state,
+    read_manifest,
+)
+
+
+def _save_steps(d, steps):
+    m = CheckpointManager()
+    for s in steps:
+        m.save(d / f"ckpt_{s}_0.ckpt", {"agent": {"w": jnp.full(3, float(s))}, "iter_num": s}, step=s)
+    m.close()
+
+
+def _arrays_payload(ckpt):
+    """Largest file inside the checkpoint's orbax ``.arrays`` dir — the
+    tensor payload a torn save truncates."""
+    arrays = ckpt.parent / (ckpt.name + ".arrays")
+    files = [p for p in arrays.rglob("*") if p.is_file() and p.stat().st_size > 0]
+    assert files, f"no sidecar payload under {arrays}"
+    return max(files, key=lambda p: p.stat().st_size)
+
+
+def test_manifest_records_sidecar_sizes(tmp_path):
+    _save_steps(tmp_path, [8])
+    (entry,) = read_manifest(tmp_path)
+    sizes = entry["sidecars"]
+    assert sizes and all(int(v) > 0 for v in sizes.values())
+    assert any(".arrays" in rel for rel in sizes)
+
+
+def test_truncated_latest_arrays_falls_back_to_previous_save(tmp_path):
+    """resume_from=latest with a torn newest ``.arrays`` payload: the size
+    marker rejects it and discovery returns the previous COMPLETE save —
+    and the bare ``*.ckpt`` scan (which only probes existence) must not
+    resurrect the rejected entry."""
+    _save_steps(tmp_path, [8, 16])
+    assert latest_complete(tmp_path).name == "ckpt_16_0.ckpt"
+
+    inject.truncate_file(_arrays_payload(tmp_path / "ckpt_16_0.ckpt"), keep_bytes=8)
+    latest = latest_complete(tmp_path)
+    assert latest is not None and latest.name == "ckpt_8_0.ckpt"
+    # the fallback actually loads
+    state = load_resume_state(latest)
+    assert state["iter_num"] == 8
+
+
+def test_torn_latest_across_version_dirs(tmp_path):
+    """Pod launcher resume resolution scans ``*/version_*/checkpoint`` run
+    dirs: when the newest version dir's only checkpoint is torn, resolution
+    falls back to the previous version dir's complete save."""
+    v0 = tmp_path / "run" / "version_0" / "checkpoint"
+    v1 = tmp_path / "run" / "version_1" / "checkpoint"
+    v0.mkdir(parents=True)
+    v1.mkdir(parents=True)
+    _save_steps(v0, [8, 16])
+    _save_steps(v1, [24])
+    assert find_latest_run_checkpoint(tmp_path) == v1 / "ckpt_24_0.ckpt"
+
+    inject.truncate_file(_arrays_payload(v1 / "ckpt_24_0.ckpt"), keep_bytes=8)
+    assert find_latest_run_checkpoint(tmp_path) == v0 / "ckpt_16_0.ckpt"
+
+
+def test_grown_sidecar_is_also_rejected(tmp_path):
+    """The marker is an exact-size check, not a floor: appended garbage
+    (e.g. two generations racing one path) rejects the entry the same way."""
+    _save_steps(tmp_path, [8, 16])
+    payload = _arrays_payload(tmp_path / "ckpt_16_0.ckpt")
+    with open(payload, "ab") as f:
+        f.write(b"\0" * 64)
+    assert latest_complete(tmp_path).name == "ckpt_8_0.ckpt"
+
+
+def test_nothing_complete_returns_none(tmp_path):
+    _save_steps(tmp_path, [8])
+    inject.truncate_file(_arrays_payload(tmp_path / "ckpt_8_0.ckpt"), keep_bytes=8)
+    assert latest_complete(tmp_path) is None
+    assert find_latest_run_checkpoint(tmp_path) is None
+
+
+def test_pre_marker_manifest_entries_still_pass(tmp_path):
+    """Manifests written before the size marker existed (no ``sidecars``
+    key) must keep resolving — existence is still probed, sizes are not."""
+    import json
+
+    _save_steps(tmp_path, [8])
+    entries = read_manifest(tmp_path)
+    for e in entries:
+        e.pop("sidecars", None)
+    (tmp_path / "manifest.json").write_text(json.dumps({"version": 1, "entries": entries}))
+    assert latest_complete(tmp_path).name == "ckpt_8_0.ckpt"

@@ -1,0 +1,90 @@
+"""Coordinator-connect retry drills for ``maybe_init``: bounded exponential
+backoff around ``jax.distributed.initialize`` and the typed
+CoordinatorConnectError naming the coordinator address on exhaustion — a pod
+worker that races process 0's coordinator socket must retry, and a worker
+that can NEVER reach it must fail with an address an operator can act on.
+``initialize`` is monkeypatched; nothing distributed actually starts."""
+
+import pytest
+
+import sheeprl_tpu.parallel.distributed as dist
+from sheeprl_tpu.parallel.distributed import CoordinatorConnectError, maybe_init
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    # never leak the module-level "already initialized" latch, and make sure
+    # the pod env vars of an outer test run don't steer resolution
+    monkeypatch.setattr(dist, "_initialized", False)
+    for var in ("SHEEPRL_COORDINATOR", "SHEEPRL_NUM_PROCESSES", "SHEEPRL_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+CFG = {
+    "coordinator": "10.1.2.3:7777",
+    "num_processes": 2,
+    "process_id": 1,
+    "connect_retries": 2,
+    "connect_backoff_s": 0.5,
+}
+
+
+def test_exhaustion_raises_typed_error_naming_coordinator(monkeypatch):
+    attempts = []
+    sleeps = []
+    monkeypatch.setattr(
+        dist.jax.distributed,
+        "initialize",
+        lambda **kw: (attempts.append(kw), (_ for _ in ()).throw(RuntimeError("connection refused")))[1],
+    )
+    monkeypatch.setattr(dist.time, "sleep", sleeps.append)
+    with pytest.warns(UserWarning, match="retrying in 0.5s"):
+        with pytest.raises(CoordinatorConnectError) as ei:
+            maybe_init(CFG)
+    err = ei.value
+    assert err.coordinator == "10.1.2.3:7777" and err.attempts == 3
+    assert "10.1.2.3:7777" in str(err) and "3 attempt(s)" in str(err)
+    assert "connection refused" in str(err)
+    assert isinstance(err.__cause__, RuntimeError)
+    assert len(attempts) == 3
+    # exponential backoff between attempts: base, base*2
+    assert sleeps == [0.5, 1.0]
+    assert dist._initialized is False
+
+
+def test_success_after_transient_failures(monkeypatch):
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky(**kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("coordinator not listening yet")
+        assert kw["coordinator_address"] == "10.1.2.3:7777"
+        assert kw["num_processes"] == 2 and kw["process_id"] == 1
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(dist.time, "sleep", sleeps.append)
+    with pytest.warns(UserWarning, match="attempt 2/3"):
+        assert maybe_init(CFG) is True
+    assert calls["n"] == 3 and sleeps == [0.5, 1.0]
+    assert dist._initialized is True
+
+
+def test_zero_retries_fails_on_first_attempt(monkeypatch):
+    monkeypatch.setattr(
+        dist.jax.distributed,
+        "initialize",
+        lambda **kw: (_ for _ in ()).throw(OSError("no route to host")),
+    )
+    monkeypatch.setattr(dist.time, "sleep", lambda s: pytest.fail("must not sleep with 0 retries"))
+    with pytest.raises(CoordinatorConnectError, match="1 attempt"):
+        maybe_init({**CFG, "connect_retries": 0})
+
+
+def test_init_timeout_forwarded(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(dist.jax.distributed, "initialize", lambda **kw: seen.update(kw))
+    assert maybe_init({**CFG, "init_timeout_s": 45}) is True
+    assert seen["initialization_timeout"] == 45

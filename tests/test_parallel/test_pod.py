@@ -1,0 +1,273 @@
+"""Pod launcher drills — unit coverage of the launch/fence surface plus the
+slow chaos e2es through the real CLI: a worker SIGKILLed mid-run gang-restarts
+the WHOLE pod from the newest complete checkpoint and converges to the same
+final counters as the fault-free twin; a SIGSTOPped worker expires its
+heartbeat lease and is counted as a HANG (not a kill); SIGTERM on the
+launcher drains outermost-first and exits 0."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.fault.manager import CheckpointManager, find_latest_run_checkpoint, load_resume_state
+from sheeprl_tpu.parallel.pod import PodLauncher, StepFenceError, beat_step, drain_requested, pod_worker_active
+
+
+class _Cfg(dict):
+    """Minimal compose()-shaped cfg: dict access + the resolved root_dir."""
+
+    root_dir = "ppo/discrete_dummy"
+
+
+def _cfg(tmp_path, **pod):
+    return _Cfg({"fabric": {"pod": {"workers": 2, "devices_per_worker": 1, **pod}}, "log_root": str(tmp_path / "logs")})
+
+
+# --------------------------------------------------------------------------- #
+# fast unit coverage (tier-1)
+# --------------------------------------------------------------------------- #
+
+
+def test_launcher_rejects_fewer_than_two_workers(tmp_path):
+    with pytest.raises(ValueError, match="fabric.pod.workers >= 2"):
+        PodLauncher(_cfg(tmp_path, workers=1), [])
+
+
+def test_worker_command_pins_and_resume_ownership(tmp_path):
+    """The launcher OWNS the resume pin: a user token is stripped from the
+    worker argv and re-issued by the launcher (so gang restarts can replace
+    it), recursion is blocked, and the CPU proxy mesh spans every worker."""
+    argv = ["exp=ppo", "checkpoint.resume_from=/old/ckpt", "algo.total_steps=64"]
+    l = PodLauncher(_cfg(tmp_path, workers=2, devices_per_worker=2), argv)
+    assert l.user_resume == "/old/ckpt"
+    cmd = l.worker_command(0)
+    assert cmd.count("checkpoint.resume_from=/old/ckpt") == 1  # launcher-issued, not doubled
+    assert "fabric.pod.workers=0" in cmd  # a worker must never recurse into a pod
+    assert "fabric.devices=4" in cmd  # 2 workers x 2 virtual devices
+    assert "algo.total_steps=64" in cmd
+
+
+def test_worker_env_shape_and_xla_flag_rewrite(tmp_path, monkeypatch):
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8 --xla_foo=1")
+    l = PodLauncher(_cfg(tmp_path, workers=2, devices_per_worker=3), [])
+    env = l.worker_env(1)
+    assert env["SHEEPRL_COORDINATOR"] == f"127.0.0.1:{l._port}"
+    assert env["SHEEPRL_NUM_PROCESSES"] == "2" and env["SHEEPRL_PROCESS_ID"] == "1"
+    assert env["SHEEPRL_POD_RANK"] == "1" and env["SHEEPRL_POD_HEARTBEAT"]
+    # the stale host-device-count flag is REPLACED, other flags survive
+    assert env["XLA_FLAGS"].count("xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=3" in env["XLA_FLAGS"]
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+
+
+def test_gang_restart_resolves_latest_and_fences_monotone(tmp_path):
+    l = PodLauncher(_cfg(tmp_path), ["exp=ppo"])
+    ckpt_dir = Path(l.ckpt_root) / "run_name" / "version_0" / "checkpoint"
+    ckpt_dir.mkdir(parents=True)
+    m = CheckpointManager()
+    m.save(ckpt_dir / "ckpt_48_0.ckpt", {"agent": {"w": jnp.ones(2)}, "iter_num": 3}, step=48)
+    m.close()
+
+    l.fences.append(0)
+    old_port = l._port
+    l._on_gang_restart(2)
+    assert l.fences == [0, 48]
+    assert l._resume is not None and l._resume.endswith("ckpt_48_0.ckpt")
+    assert l._port != old_port  # the dead coordinator may still hold its socket
+    assert f"checkpoint.resume_from={l._resume}" in l.worker_command(0)
+
+    # a resolution BEHIND the fence (here: the checkpoint vanished entirely,
+    # resolving to a fresh start at step 0) must refuse to double-count
+    import shutil
+
+    shutil.rmtree(ckpt_dir)
+    with pytest.raises(StepFenceError, match="BEHIND the previous fence 48"):
+        l._on_gang_restart(3)
+
+
+def test_worker_helpers_are_noops_outside_a_pod():
+    assert not pod_worker_active()
+    assert not drain_requested()
+    beat_step(123)  # no heartbeat path bound: must not raise
+
+
+def test_cli_pod_flag_parsing():
+    from sheeprl_tpu.cli import _extract_pod_flag
+
+    assert _extract_pod_flag(["run", "exp=ppo"])[1] is None
+    assert _extract_pod_flag(["--pod", "exp=ppo"]) == (["exp=ppo"], 2)
+    assert _extract_pod_flag(["--pod", "4", "exp=ppo"]) == (["exp=ppo"], 4)
+    assert _extract_pod_flag(["--pod=3", "exp=ppo"]) == (["exp=ppo"], 3)
+
+
+# --------------------------------------------------------------------------- #
+# slow chaos drills: real 2-process pods through the CLI
+# --------------------------------------------------------------------------- #
+
+# world_envs = num_envs * workers = 4; policy_steps_per_iter = 16;
+# total_steps=160 -> 10 iterations, checkpoint every iteration. Deterministic
+# final counters: every run (fault-free or chaos) must land on iter_num == 10.
+OVERRIDES = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.total_steps=160",
+    "checkpoint.every=16",
+    "algo.run_test=False",
+    "seed=11",
+    "fabric.pod.backoff=0.1",
+    "fabric.pod.lease_s=20",
+    "fabric.pod.grace_s=120",
+]
+FINAL_ITERS = 10
+
+
+def _pod_popen(tmp, tag, extra=()):
+    cmd = [sys.executable, "-m", "sheeprl_tpu", "run", "--pod", "2", *OVERRIDES, f"log_root={tmp}/{tag}/logs", *extra]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+
+
+def _pod_run(tmp, tag, extra=(), timeout=560):
+    proc = _pod_popen(tmp, tag, extra)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"pod run '{tag}' did not finish in {timeout}s:\n{out[-4000:]}")
+    return proc.returncode, out
+
+
+def _summary(out):
+    lines = [l for l in out.splitlines() if l.startswith("POD_SUMMARY ")]
+    assert lines, f"no POD_SUMMARY in output:\n{out[-4000:]}"
+    return json.loads(lines[-1][len("POD_SUMMARY ") :])
+
+
+def _final_iters(tmp, tag):
+    ckpt = find_latest_run_checkpoint(Path(str(tmp)) / tag / "logs" / "ppo" / "discrete_dummy")
+    assert ckpt is not None, f"no complete checkpoint for '{tag}'"
+    return int(load_resume_state(ckpt)["iter_num"])
+
+
+@pytest.fixture(scope="module")
+def pod_tmp(tmp_path_factory):
+    return tmp_path_factory.mktemp("pod_drills")
+
+
+@pytest.fixture(scope="module")
+def fault_free_twin(pod_tmp):
+    """The clean reference run: shared by the chaos drills (and the warm-up
+    of the persistent XLA compile cache for everything after it)."""
+    rc, out = _pod_run(pod_tmp, "clean")
+    summary = _summary(out)
+    return rc, summary, _final_iters(pod_tmp, "clean")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fault_free_pod_completes(fault_free_twin):
+    rc, s, iters = fault_free_twin
+    assert rc == 0 and s["finished"] and not s["drained"] and s["error"] is None
+    assert s["pod_restarts"] == 0 and s["kills"] == 0 and s["hangs"] == 0
+    assert iters == FINAL_ITERS
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_host_gang_restarts_and_counters_match_twin(pod_tmp, fault_free_twin):
+    """Acceptance drill: SIGKILL one worker mid-run (seeded chaos schedule).
+    The gang restarts from the newest complete checkpoint, the step fences
+    stay monotone, and the run converges to the fault-free twin's counters —
+    no lost and no double-counted steps."""
+    _, _, twin_iters = fault_free_twin
+    rc, out = _pod_run(
+        pod_tmp,
+        "kill",
+        extra=[
+            "fault.chaos.enabled=True",
+            # progress-keyed: the 6th observed heartbeat step advance is
+            # ~iteration 3 of 10, after checkpoints exist, however warm the
+            # XLA compile cache makes the run
+            "fault.chaos.events=[train.pod.step:kill-host:6]",
+        ],
+    )
+    s = _summary(out)
+    assert rc == 0, f"chaos pod run failed rc={rc}:\n{out[-4000:]}"
+    assert s["finished"] and s["error"] is None
+    assert s["pod_restarts"] >= 1 and s["kills"] >= 1 and s["hangs"] == 0
+    assert s["fences"] == sorted(s["fences"])  # monotone: never double-counts
+    assert s["restarts"] and all(r["mttr_s"] > 0 for r in s["restarts"])
+    assert _final_iters(pod_tmp, "kill") == twin_iters
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_hang_host_counts_distinctly_and_recovers(pod_tmp, fault_free_twin):
+    """SIGSTOP drill: a wedged (alive but silent) worker expires its
+    heartbeat lease -> counted as a HANG, distinct from kills, SIGKILLed by
+    the supervisor, and the gang restarts to completion."""
+    rc, out = _pod_run(
+        pod_tmp,
+        "hang",
+        extra=[
+            "fabric.pod.lease_s=8",
+            "fabric.pod.grace_s=30",
+            "fault.chaos.enabled=True",
+            "fault.chaos.events=[train.pod.step:hang-host:6]",
+        ],
+    )
+    s = _summary(out)
+    assert rc == 0, f"hang pod run failed rc={rc}:\n{out[-4000:]}"
+    assert s["finished"] and s["error"] is None
+    assert s["hangs"] == 1  # the wedged host is a HANG, not a kill
+    assert s["pod_restarts"] >= 1
+    assert _final_iters(pod_tmp, "hang") == FINAL_ITERS
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigterm_drains_outermost_first(pod_tmp, fault_free_twin):
+    """SIGTERM on the launcher: supervision stops first, each worker
+    checkpoints at its next iteration boundary and exits 0, the launcher
+    reports a drained (not errored) pod and exits 0."""
+    proc = _pod_popen(pod_tmp, "drain")
+    root = Path(str(pod_tmp)) / "drain" / "logs" / "ppo" / "discrete_dummy"
+    try:
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            if find_latest_run_checkpoint(root) is not None:
+                break
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                pytest.fail(f"pod exited rc={proc.returncode} before first checkpoint:\n{out[-4000:]}")
+            time.sleep(0.5)
+        else:
+            pytest.fail("no checkpoint appeared within 420s")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, f"drained pod must exit 0, got {proc.returncode}:\n{out[-4000:]}"
+    s = _summary(out)
+    assert s["drained"] and s["error"] is None
+    assert find_latest_run_checkpoint(root) is not None
