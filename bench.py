@@ -50,6 +50,11 @@ matrix read the registry, nothing is hand-enumerated:
 - ``env_zoo`` — raw vmapped ``BatchedJaxEnv.step`` throughput per
   registered pure-JAX env at a fixed batch ladder (no agent, no learning:
   the env-side budget an Anakin rollout spends per step);
+- ``kernels`` — the Pallas kernel tier microbench: every kernel in the
+  ``ops.kernels`` registry timed pallas-vs-lax on identical inputs at 2-3
+  call-site shapes (``BENCH_KERNEL=<name>|all``,
+  ``BENCH_KERNEL_BACKEND=pallas|lax|both``; interpret-mode caveat in the
+  payload, howto/kernels.md; benchmarks/kernel_bench.py);
 - ``pod_restart`` — gang-restart MTTR of the fault-tolerant pod: real
   2-process pods with one seeded ``kill-host`` per rep, MTTR = SIGKILL ->
   first post-restart completed train iteration, every rep must converge to
@@ -555,6 +560,20 @@ def _lane_pod_restart() -> None:
     from pod_bench import main as pod_main
 
     pod_main()
+
+
+@lane("kernels", "kernel", "kernel_tier_lax_over_pallas_median")
+def _lane_kernels() -> None:
+    # Pallas kernel tier microbench: every registered kernel timed through
+    # its dispatch wrapper at 2-3 call-site shapes, pallas vs lax paired on
+    # identical inputs (BENCH_KERNEL / BENCH_KERNEL_BACKEND / _REPS / _OUT in
+    # benchmarks/kernel_bench.py). On a TPU-less host the pallas column is
+    # interpret mode — a correctness vehicle, not a performance claim; see
+    # the lane's in-payload note and howto/kernels.md.
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from kernel_bench import main as kernel_main
+
+    kernel_main()
 
 
 @lane("serve_sessions", "sessions", "ppo_recurrent_serve_session_steps_per_sec")

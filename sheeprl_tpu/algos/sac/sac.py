@@ -366,6 +366,7 @@ def make_resident_train_step(
     beta; ``skipped`` counts guard-rejected steps (0 when ``guard=False``).
     """
     from sheeprl_tpu.data.ring import unpack_burst_blob
+    from sheeprl_tpu.ops.kernels import sumtree_sample
     from sheeprl_tpu.replay import sumtree as st
 
     gamma = float(cfg.algo.gamma)
@@ -408,10 +409,12 @@ def make_resident_train_step(
             k_a, k_b, k_next, k_actor = jax.random.split(key, 4)
             if prioritized:
                 u = jax.random.uniform(k_a, (B,))
-                leaf = st.sample(tree, u)
+                # fused descent + importance weights (ops.kernels registry;
+                # lax backend reproduces the old two-pass st.sample +
+                # st.importance_weights graph bit-for-bit)
+                leaf, w = sumtree_sample(tree, u, vld * n_envs, beta)
                 pos_idx = leaf // n_envs
                 env_idx = leaf % n_envs
-                w = st.importance_weights(tree, leaf, vld * n_envs, beta)
                 w = w / jnp.maximum(jax.lax.pmax(w.max(), "dp"), 1e-12)
             else:
                 pos_idx = jax.random.randint(k_a, (B,), 0, jnp.maximum(vld, 1))

@@ -368,15 +368,22 @@ def run_audit(
     ``--select`` slice cannot (and program construction is the expensive
     setup half of an audit, so the registry is built exactly once)."""
     from sheeprl_tpu.analysis.programs import collect_programs
+    from sheeprl_tpu.ops.kernels import registry as kernels_registry
 
-    programs = collect_programs(mesh, select)
     findings: List[AuditFinding] = []
     measurements: Dict[str, Dict[str, Any]] = {}
-    for prog in programs:
-        f, m = audit_program(prog)
-        findings.extend(f)
-        if m:
-            measurements[prog.name] = m
+    # The budget manifest documents the DEFAULT kernel configuration; pin the
+    # ops registry for the duration of the run so an inherited
+    # SHEEPRL_TPU_OPS_BACKEND cannot drift the measured HBM footprints away
+    # from the manifest. The kernels/* audit programs call their Pallas
+    # variants directly, so the Pallas tier is still budgeted explicitly.
+    with kernels_registry.use_backend("auto", reset=True):
+        programs = collect_programs(mesh, select)
+        for prog in programs:
+            f, m = audit_program(prog)
+            findings.extend(f)
+            if m:
+                measurements[prog.name] = m
     if manifest is not None:
         sources = {p.name: p.source for p in programs}
         for name, message in check_budgets(

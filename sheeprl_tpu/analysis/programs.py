@@ -47,6 +47,7 @@ AUDIT_SOURCES: Tuple[str, ...] = (
     "sheeprl_tpu.algos.dreamer_v3.dreamer_sebulba",
     "sheeprl_tpu.serve.engine",
     "sheeprl_tpu.serve.sessions",
+    "sheeprl_tpu.ops.kernels.audit",
 )
 
 

@@ -235,8 +235,11 @@ def run_algorithm(cfg: DotDict) -> None:
     from sheeprl_tpu.utils.timer import timer
 
     from sheeprl_tpu.distributions import set_validate_args
+    from sheeprl_tpu.ops.kernels import configure_from_config
 
     set_validate_args(bool(cfg.get("distribution", {}).get("validate_args", False)))
+    # ops.backend=auto|pallas|lax + per-kernel overrides (howto/kernels.md)
+    configure_from_config(cfg.get("ops"))
 
     if cfg.get("metric") is not None:
         predefined = getattr(utils, "AGGREGATOR_KEYS", None)
@@ -306,6 +309,10 @@ def eval_algorithm(cfg: DotDict) -> None:
 
     pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
 
+    from sheeprl_tpu.ops.kernels import configure_from_config
+
+    configure_from_config(cfg.get("ops"))
+
     fabric = Fabric(devices=1, accelerator=cfg.fabric.get("accelerator", "auto"), precision=str(cfg.fabric.get("precision", "32-true")))
     fabric.seed_everything(cfg.seed if cfg.get("seed") is not None else 42)
     state = load_state(cfg.checkpoint_path)
@@ -331,6 +338,10 @@ def serve_algorithm(cfg: DotDict) -> None:
     from sheeprl_tpu.utils.utils import pin_cpu_platform
 
     pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
+
+    from sheeprl_tpu.ops.kernels import configure_from_config
+
+    configure_from_config(cfg.get("ops"))
     # serve joins the same multi-host bring-up contract as train: a serve
     # replica launched by a pod runtime initializes jax.distributed from the
     # identical fabric.distributed.* / SHEEPRL_* knobs

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from sheeprl_tpu.ops.kernels import ragged_ring_scatter
 from sheeprl_tpu.parallel.compat import shard_map
 
 __all__ = [
@@ -314,8 +315,9 @@ def build_burst_train_step(
         # -- per-env ring append. Slot i writes env e iff staged_mask[i, e];
         # each env's rows pack densely from its own write head (ragged adds).
         row, new_pos, new_valid = ring_append_rows(pos, valid_n, staged_mask, capacity)
-        cols = jnp.broadcast_to(jnp.arange(ring_envs)[None, :], row.shape)
-        rb = {k: rb[k].at[row, cols].set(staged[k], mode="drop") for k in rb}
+        # registry-dispatched ragged scatter (ops.kernels; the lax backend is
+        # the literal .at[row, cols].set(..., mode="drop") this site ran)
+        rb = {k: ragged_ring_scatter(rb[k], staged[k], row, pos) for k in rb}
         # No env may be shorter than a sample window yet (the host buffer
         # raises in that case); until then every step is a no-op append.
         valid = valid * jnp.all(new_valid >= ring_seq).astype(valid.dtype)
@@ -469,9 +471,10 @@ def build_seq_append_step(
         pos_l = jax.lax.dynamic_slice(pos, (offset,), (local_envs,))
         valid_l = jax.lax.dynamic_slice(valid, (offset,), (local_envs,))
         row, new_pos_l, new_valid_l = ring_append_rows(pos_l, valid_l, mask, capacity)
-        # rows of dropped/padded slots carry index `capacity` -> mode="drop"
-        cols = offset + jnp.broadcast_to(jnp.arange(local_envs)[None, :], row.shape)
-        storage = {k: storage[k].at[row, cols].set(staged[k], mode="drop") for k in storage}
+        # rows of dropped/padded slots carry index `capacity` -> dropped by
+        # the registry-dispatched ragged scatter (lax backend: the literal
+        # .at[row, cols].set(..., mode="drop") this site ran)
+        storage = {k: ragged_ring_scatter(storage[k], staged[k], row, pos_l, offset) for k in storage}
         pos = jax.lax.dynamic_update_slice(pos, new_pos_l, (offset,))
         valid = jax.lax.dynamic_update_slice(valid, new_valid_l, (offset,))
         return storage, pos, valid

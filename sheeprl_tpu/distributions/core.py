@@ -485,12 +485,21 @@ class TwoHotEncodingDistribution(Distribution):
         self.bwd = transbwd
         self.bins = jnp.linspace(low, high, logits.shape[-1], dtype=logits.dtype)
 
+    def _default_transforms(self) -> bool:
+        # The fused kernels bake in symlog/symexp and a single event dim; any
+        # custom transform keeps the inline jnp path below.
+        return self.dims == 1 and self.fwd is symlog and self.bwd is symexp
+
     @property
     def probs(self):
         return jax.nn.softmax(self.logits, axis=-1)
 
     @property
     def mean(self):
+        if self._default_transforms():
+            from sheeprl_tpu.ops.kernels import two_hot_symexp_decode
+
+            return two_hot_symexp_decode(self.logits, self.low, self.high)
         return self.bwd(jnp.sum(self.probs * self.bins, axis=-1, keepdims=True))
 
     @property
@@ -498,6 +507,10 @@ class TwoHotEncodingDistribution(Distribution):
         return self.mean
 
     def log_prob(self, value):
+        if self._default_transforms():
+            from sheeprl_tpu.ops.kernels import two_hot_symlog_loss
+
+            return two_hot_symlog_loss(self.logits, value, self.low, self.high)
         x = self.fwd(value)
         num_buckets = self.logits.shape[-1]
         # twohot of x over self.bins

@@ -298,7 +298,7 @@ class LayerNormGRUCell(nn.Module):
     use_bias: bool = True
     layer_norm: bool = False
     norm_eps: float = 1e-3
-    use_pallas: Optional[bool] = None  # None = auto (on for TPU backends)
+    use_pallas: Optional[bool] = None  # None = follow the ops.backend registry
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -313,11 +313,13 @@ class LayerNormGRUCell(nn.Module):
         )(jnp.concatenate([h, x], axis=-1))
         if self.layer_norm:
             fused = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype, name="ln")(fused)
-        use_pallas = jax.default_backend() == "tpu" if self.use_pallas is None else self.use_pallas
-        if use_pallas and h.ndim == 2:
-            from sheeprl_tpu.ops.pallas_gru import gru_gates
+        if h.ndim == 2 and self.use_pallas is not False:
+            from sheeprl_tpu.ops.kernels import gru_gates
 
-            h_new = gru_gates(fused, h)
+            # None follows the ops.backend registry (auto = Pallas iff the
+            # process default backend is TPU — the historical rule); an
+            # explicit True forces the Pallas tier regardless of config.
+            h_new = gru_gates(fused, h, backend="pallas" if self.use_pallas else None)
             return h_new, h_new
         reset, cand, update = jnp.split(fused, 3, axis=-1)
         reset = nn.sigmoid(reset)

@@ -1,5 +1,12 @@
+"""Core jittable RL math plus the Pallas kernel tier.
+
+``gae`` is re-exported through the :mod:`sheeprl_tpu.ops.kernels` dispatch
+registry, so every PPO-family call site follows the ``ops.backend`` config
+knob; under ``ops.backend=lax`` (the CPU/GPU default) it is exactly
+:func:`sheeprl_tpu.ops.core.gae`.
+"""
+
 from sheeprl_tpu.ops.core import (
-    gae,
     lambda_returns,
     symexp,
     symlog,
@@ -7,6 +14,7 @@ from sheeprl_tpu.ops.core import (
     two_hot_encoder,
 )
 from sheeprl_tpu.ops.guard import finite_guard, guarded_select
+from sheeprl_tpu.ops.kernels import gae
 
 __all__ = [
     "gae",

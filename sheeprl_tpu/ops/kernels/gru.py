@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for the Hafner-GRU gate chain — the pointwise tail of
+every RSSM step (``models.LayerNormGRUCell``; reference torch cell:
+``sheeprl/models/models.py:331-412``).
+
+After the fused ``Dense -> (LayerNorm)`` projection, the cell runs
+``split -> sigmoid(reset) -> tanh(reset * cand) -> sigmoid(update - 1) ->
+blend`` — five elementwise passes over a ``(B, 3H)`` tensor that the dynamic
+and imagination scans execute at every timestep. This kernel pins the whole
+chain into ONE VPU pass per block: the ``(B, 3H)`` projection and the
+``(B, H)`` carry are read from VMEM once and a single ``(B, H)`` result is
+written back, instead of round-tripping each intermediate through HBM when
+XLA's fuser splits the chain.
+
+Gradients: ``jax.custom_vjp`` with the Pallas kernel on the forward and the
+(cheap, fully-fusable) jnp reference chain re-derived on the backward.
+
+On non-TPU backends the kernel runs in Pallas ``interpret`` mode, so the CPU
+test mesh exercises the same code path numerically. This module is the
+template entry of the kernel tier: every other kernel in
+:mod:`sheeprl_tpu.ops.kernels` follows the same reference/pallas/registry
+triple.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.kernels import registry
+
+__all__ = ["gru_gates", "gru_gates_pallas", "gru_gates_reference"]
+
+
+def gru_gates_reference(fused: jax.Array, h: jax.Array) -> jax.Array:
+    """The plain-jnp gate chain (ground truth and backward-pass body)."""
+    reset, cand, update = jnp.split(fused, 3, axis=-1)
+    reset = jax.nn.sigmoid(reset)
+    cand = jnp.tanh(reset * cand)
+    update = jax.nn.sigmoid(update - 1)
+    return update * cand + (1 - update) * h
+
+
+def _kernel(fused_ref, h_ref, out_ref):
+    # Gate math in f32 regardless of the IO dtype: Mosaic rejects the mixed
+    # f32-scalar/bf16-vector broadcasts the transcendental lowerings emit
+    # under bf16, and the VPU pays nothing extra for f32 elementwise.
+    fused = fused_ref[...].astype(jnp.float32)
+    h = h_ref[...].astype(jnp.float32)
+    hidden = h.shape[-1]
+    reset = jax.nn.sigmoid(fused[..., :hidden])
+    cand = jnp.tanh(reset * fused[..., hidden : 2 * hidden])
+    update = jax.nn.sigmoid(fused[..., 2 * hidden :] - 1)
+    out_ref[...] = (update * cand + (1 - update) * h).astype(out_ref.dtype)
+
+
+def _pallas_forward(fused: jax.Array, h: jax.Array, interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    B, H = h.shape
+    # Block over the batch; each row keeps its full 3H projection in VMEM
+    # (XL config: 3*4096 floats = 48 KiB/row, far under the ~16 MiB budget).
+    block_b = min(B, 256)
+    grid = (pl.cdiv(B, block_b),)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, 3 * H), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, H), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H), h.dtype),
+        interpret=interpret,
+    )(fused, h)
+
+
+@functools.partial(jax.named_call, name="pallas_gru_gates")
+def _forward(fused: jax.Array, h: jax.Array) -> jax.Array:
+    return registry.platform_dispatch(_pallas_forward, fused, h)
+
+
+@jax.custom_vjp
+def gru_gates_pallas(fused: jax.Array, h: jax.Array) -> jax.Array:
+    """Fused GRU gate chain, always on the Pallas path:
+    ``(B, 3H) x (B, H) -> (B, H)``."""
+    return _forward(fused, h)
+
+
+def _fwd(fused, h):
+    return _forward(fused, h), (fused, h)
+
+
+def _bwd(residual, g):
+    fused, h = residual
+    _, vjp = jax.vjp(gru_gates_reference, fused, h)
+    return vjp(g)
+
+
+gru_gates_pallas.defvjp(_fwd, _bwd)
+
+registry.register(
+    "gru_gates",
+    reference=gru_gates_reference,
+    pallas=gru_gates_pallas,
+    doc="Fused GRU gate chain (B, 3H) x (B, H) -> (B, H); RSSM step tail.",
+)
+
+
+def gru_gates(fused: jax.Array, h: jax.Array, backend: Optional[str] = None) -> jax.Array:
+    """Registry-dispatched GRU gate chain (``backend=None`` follows the
+    ``ops.backend`` config; ``"pallas"``/``"lax"`` force a tier)."""
+    return registry.dispatch("gru_gates", backend)(fused, h)

@@ -1,0 +1,234 @@
+"""The kernel dispatch registry — one switch for the whole Pallas tier.
+
+Every kernel in :mod:`sheeprl_tpu.ops.kernels` ships as a triple:
+
+- a **plain-lax reference** — a literal extraction of the inline math the
+  call site ran before the kernel existed, so ``ops.backend=lax`` reproduces
+  the historical graphs bit-for-bit;
+- a **Pallas kernel** wrapped in ``jax.custom_vjp`` (Pallas forward, the
+  reference chain re-derived on the backward);
+- a **registry entry** binding the two under one name.
+
+Call sites go through :func:`dispatch`, which picks the implementation from
+the process-global backend (``ops.backend=auto|pallas|lax``) with optional
+per-kernel overrides (``ops.kernels.<name>=...``). ``auto`` resolves to the
+Pallas tier iff this process's default JAX backend is a TPU — the same rule
+the LayerNorm-GRU cell used before the registry existed — so CPU/GPU
+processes keep the plain-lax references unless a config or test explicitly
+opts into the interpret-mode kernel path.
+
+Backend resolution happens at *trace* time and the chosen value is constant
+for the life of the process (it is config, not data), so switching backends
+never introduces retraces inside a warmed-up program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import os
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "Kernel",
+    "UnknownKernelError",
+    "UnknownOpsBackendError",
+    "VALID_BACKENDS",
+    "backend",
+    "configure",
+    "configure_from_config",
+    "dispatch",
+    "get",
+    "names",
+    "overrides",
+    "platform_dispatch",
+    "register",
+    "resolve",
+    "use_backend",
+]
+
+VALID_BACKENDS: Tuple[str, ...] = ("auto", "pallas", "lax")
+
+
+class UnknownOpsBackendError(ValueError):
+    """``ops.backend`` (or a per-kernel override) named a backend the
+    registry does not know."""
+
+    def __init__(self, backend: Any, kernel: Optional[str] = None):
+        scope = f"kernel '{kernel}'" if kernel else "ops.backend"
+        super().__init__(
+            f"Unknown ops backend {backend!r} for {scope}; valid backends are "
+            f"{', '.join(VALID_BACKENDS)}."
+        )
+        self.backend = backend
+        self.kernel = kernel
+
+
+class UnknownKernelError(KeyError):
+    """A dispatch or override referenced a kernel name that was never
+    registered."""
+
+    def __init__(self, name: Any):
+        known = ", ".join(sorted(_REGISTRY)) or "<none>"
+        super().__init__(f"Unknown kernel '{name}'; registered kernels: {known}.")
+        self.name = name
+
+
+@dataclasses.dataclass(frozen=True)
+class Kernel:
+    """One registry entry: the lax reference and its Pallas counterpart.
+
+    Both callables share one signature; the reference is also the ground
+    truth for the Pallas variant's parity tests and backward pass.
+    """
+
+    name: str
+    reference: Callable[..., Any]
+    pallas: Callable[..., Any]
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, Kernel] = {}
+# Seeded from the environment so bench/CI runs can flip the tier without a
+# config file; validated lazily (at first resolve) with the named error.
+_BACKEND: str = os.environ.get("SHEEPRL_TPU_OPS_BACKEND", "auto")
+_OVERRIDES: Dict[str, str] = {}
+
+
+def register(name: str, *, reference: Callable, pallas: Callable, doc: str = "") -> Kernel:
+    """Register a (reference, pallas) pair under ``name`` (module-import
+    side effect of each kernel module; duplicate names are a bug)."""
+    if name in _REGISTRY:
+        raise ValueError(f"Kernel '{name}' registered twice.")
+    kernel = Kernel(name=name, reference=reference, pallas=pallas, doc=doc)
+    _REGISTRY[name] = kernel
+    return kernel
+
+
+def get(name: str) -> Kernel:
+    """The registry entry for ``name`` (named error on unknown kernels)."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise UnknownKernelError(name) from None
+
+
+def names() -> Tuple[str, ...]:
+    """Sorted names of every registered kernel."""
+    return tuple(sorted(_REGISTRY))
+
+
+def _check_backend(value: Any, kernel: Optional[str] = None) -> str:
+    if value not in VALID_BACKENDS:
+        raise UnknownOpsBackendError(value, kernel)
+    return value
+
+
+def backend() -> str:
+    """The process-global backend selector (``auto`` until configured)."""
+    return _BACKEND
+
+
+def overrides() -> Dict[str, str]:
+    """A copy of the per-kernel backend overrides."""
+    return dict(_OVERRIDES)
+
+
+def configure(
+    backend: Optional[str] = None,
+    overrides: Optional[Mapping[str, str]] = None,
+    *,
+    reset: bool = False,
+) -> None:
+    """Set the process-global backend and/or per-kernel overrides.
+
+    Unknown backend strings raise :class:`UnknownOpsBackendError`; override
+    keys must name registered kernels (:class:`UnknownKernelError`).
+    ``reset=True`` restores the defaults first (used by tests/bench).
+    """
+    global _BACKEND
+    if reset:
+        _BACKEND = "auto"
+        _OVERRIDES.clear()
+    if backend is not None:
+        _BACKEND = _check_backend(str(backend))
+    for key, value in (overrides or {}).items():
+        get(key)
+        _OVERRIDES[key] = _check_backend(str(value), kernel=key)
+
+
+def configure_from_config(ops_cfg: Any) -> None:
+    """Wire the ``ops:`` config block (``ops.backend`` + ``ops.kernels``)
+    into the registry. Accepts ``None``/missing blocks (defaults stand)."""
+    if not ops_cfg:
+        return
+    if hasattr(ops_cfg, "get"):
+        backend = ops_cfg.get("backend")
+        kernels = ops_cfg.get("kernels")
+    else:  # pragma: no cover - plain-attribute config objects
+        backend = getattr(ops_cfg, "backend", None)
+        kernels = getattr(ops_cfg, "kernels", None)
+    configure(backend=backend, overrides=dict(kernels or {}))
+
+
+def resolve(name: str, backend: Optional[str] = None) -> str:
+    """The concrete backend (``pallas`` or ``lax``) kernel ``name`` will run
+    on: explicit per-call ``backend`` > per-kernel override > global knob,
+    with ``auto`` meaning Pallas iff ``jax.default_backend() == "tpu"``."""
+    get(name)
+    chosen = backend if backend is not None else _OVERRIDES.get(name, _BACKEND)
+    chosen = _check_backend(str(chosen), kernel=name)
+    if chosen == "auto":
+        chosen = "pallas" if jax.default_backend() == "tpu" else "lax"
+    return chosen
+
+
+def dispatch(name: str, backend: Optional[str] = None) -> Callable[..., Any]:
+    """The callable to run for kernel ``name`` under the active backend."""
+    kernel = get(name)
+    return kernel.pallas if resolve(name, backend) == "pallas" else kernel.reference
+
+
+@contextlib.contextmanager
+def use_backend(backend: Optional[str] = None, *, reset: bool = False, **kernel_overrides: str):
+    """Temporarily reconfigure the registry (tests, the bench lane, and the
+    audit runner). ``reset=True`` starts from the defaults — the audit pins
+    the registry this way so manifests stay environment-invariant."""
+    global _BACKEND
+    saved_backend, saved_overrides = _BACKEND, dict(_OVERRIDES)
+    try:
+        configure(backend=backend, overrides=kernel_overrides, reset=reset)
+        yield
+    finally:
+        _BACKEND = saved_backend
+        _OVERRIDES.clear()
+        _OVERRIDES.update(saved_overrides)
+
+
+def _process_has_tpu() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
+def platform_dispatch(pallas_forward: Callable[..., Any], *args: Any) -> Any:
+    """Run ``pallas_forward(*args, interpret=...)`` with the interpret flag
+    chosen at LOWERING time.
+
+    One process can trace the same op for both the TPU (compiled kernel) and
+    a host CPU player (interpret mode) — a process-global default_backend
+    switch cannot. TPU-less processes skip the dispatch entirely: older jax
+    lowers BOTH ``platform_dependent`` branches under ``lax.scan``, and the
+    non-interpret ``pallas_call`` rejects CPU lowering outright.
+    """
+    if not _process_has_tpu():
+        return pallas_forward(*args, interpret=True)
+    return jax.lax.platform_dependent(
+        *args,
+        tpu=functools.partial(pallas_forward, interpret=False),
+        default=functools.partial(pallas_forward, interpret=True),
+    )

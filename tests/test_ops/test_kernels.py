@@ -1,0 +1,370 @@
+"""Pallas kernel tier vs the lax references (howto/kernels.md).
+
+Every registered kernel: forward allclose + gradients via ``custom_vjp``
+against ``jax.grad`` of the reference (f32 and bf16, interpret mode on the
+CPU test mesh), registry dispatch semantics (auto/pallas/lax, per-kernel
+override, named errors), and one-entry jit caches under both backends."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops import kernels as K
+from sheeprl_tpu.replay import sumtree as st
+
+EXPECTED_KERNELS = (
+    "gae",
+    "gru_gates",
+    "ragged_ring_scatter",
+    "sumtree_sample",
+    "two_hot_symexp_decode",
+    "two_hot_symlog_loss",
+)
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _norm_logits(rng, shape, dtype=np.float32):
+    logits = jnp.asarray(rng.normal(size=shape).astype(dtype))
+    return logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+
+
+def _gae_inputs(rng, T=16, B=6, trailing=(1,), dtype=np.float32):
+    shape = (T, B) + trailing
+    r = jnp.asarray(rng.normal(size=shape).astype(dtype))
+    v = jnp.asarray(rng.normal(size=shape).astype(dtype))
+    d = (jnp.asarray(rng.uniform(size=shape)) < 0.15).astype(jnp.float32)
+    nv = jnp.asarray(rng.normal(size=shape[1:]).astype(dtype))
+    return r, v, d, nv
+
+
+def _tree(rng, leaves=64, filled=40):
+    tree = st.init(leaves)
+    pri = jnp.asarray(rng.uniform(0.1, 2.0, size=(filled,)).astype(np.float32))
+    return st.update(tree, jnp.arange(filled), pri)
+
+
+def _ring_case(rng, C=8, E=5, S=4, e=3, feat=(2,), dtype=np.float32):
+    from sheeprl_tpu.data.ring import ring_append_rows
+
+    pos = jnp.asarray([1, C - 1, 3], jnp.int32)  # includes a wrapping head
+    valid = jnp.asarray([1, C - 1, 3], jnp.int32)
+    mask = jnp.asarray([[1, 1, 1], [1, 0, 1], [0, 0, 1], [1, 0, 0]], jnp.int32)
+    row, _, _ = ring_append_rows(pos, valid, mask, C)
+    storage = jnp.asarray(rng.normal(size=(C, E) + feat).astype(dtype))
+    staged = jnp.asarray(rng.normal(size=(S, e) + feat).astype(dtype))
+    return storage, staged, row, pos
+
+
+# ---------------------------------------------------------------------------
+# registry dispatch semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_names():
+    assert K.names() == EXPECTED_KERNELS
+
+
+def test_auto_resolves_to_lax_on_cpu():
+    # the CPU test mesh: auto must keep the plain-lax references
+    with K.use_backend("auto"):
+        for name in K.names():
+            assert K.resolve(name) == "lax"
+            assert K.dispatch(name) is K.get(name).reference
+
+
+def test_global_backend_switch():
+    with K.use_backend("pallas"):
+        assert all(K.resolve(n) == "pallas" for n in K.names())
+        assert K.dispatch("gru_gates") is K.get("gru_gates").pallas
+    with K.use_backend("lax"):
+        assert all(K.resolve(n) == "lax" for n in K.names())
+
+
+def test_per_kernel_override_beats_global():
+    with K.use_backend("pallas", gae="lax"):
+        assert K.resolve("gae") == "lax"
+        assert K.resolve("gru_gates") == "pallas"
+    with K.use_backend("lax", sumtree_sample="pallas"):
+        assert K.resolve("sumtree_sample") == "pallas"
+        assert K.resolve("gae") == "lax"
+
+
+def test_per_call_backend_beats_everything():
+    with K.use_backend("lax", gae="lax"):
+        assert K.resolve("gae", backend="pallas") == "pallas"
+
+
+def test_unknown_backend_named_error():
+    with pytest.raises(K.UnknownOpsBackendError, match="tpu-magic"):
+        K.configure(backend="tpu-magic")
+    with pytest.raises(K.UnknownOpsBackendError, match="gae"):
+        K.configure(overrides={"gae": "cuda"})
+    with pytest.raises(K.UnknownOpsBackendError):
+        K.resolve("gae", backend="nope")
+
+
+def test_unknown_kernel_named_error():
+    with pytest.raises(K.UnknownKernelError, match="flash_attention"):
+        K.get("flash_attention")
+    with pytest.raises(K.UnknownKernelError):
+        K.configure(overrides={"flash_attention": "pallas"})
+
+
+def test_configure_from_config_and_env_shape():
+    cfg = {"backend": "lax", "kernels": {"gae": "pallas"}}
+    with K.use_backend():  # snapshot/restore
+        K.configure_from_config(cfg)
+        assert K.backend() == "lax"
+        assert K.resolve("gae") == "pallas"
+        K.configure_from_config(None)  # missing block is a no-op
+        assert K.backend() == "lax"
+
+
+def test_ops_gae_export_goes_through_registry():
+    import sheeprl_tpu.ops as ops
+
+    assert ops.gae is K.gae
+
+
+def test_pallas_gru_shim_is_the_pallas_variant():
+    from sheeprl_tpu.ops import pallas_gru
+
+    assert pallas_gru.gru_gates is K.gru_gates_pallas
+    assert pallas_gru.gru_gates_reference is K.gru_gates_reference
+
+
+# ---------------------------------------------------------------------------
+# forward + gradient parity (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+def test_gru_gates_parity(dtype):
+    rng = _rng(1)
+    fused = jnp.asarray(rng.normal(size=(7, 48)).astype(np.float32), dtype=dtype)
+    h = jnp.asarray(rng.normal(size=(7, 16)).astype(np.float32), dtype=dtype)
+    got = K.gru_gates(fused, h, backend="pallas")
+    want = K.gru_gates_reference(fused, h)
+    assert got.dtype == want.dtype
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-6
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=tol, atol=tol
+    )
+
+
+def test_gru_gates_grad_parity():
+    rng = _rng(2)
+    fused = jnp.asarray(rng.normal(size=(5, 24)).astype(np.float32))
+    h = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+    g_got = jax.grad(lambda f, c: jnp.sum(K.gru_gates(f, c, backend="pallas") ** 2), (0, 1))(fused, h)
+    g_want = jax.grad(lambda f, c: jnp.sum(K.gru_gates_reference(f, c) ** 2), (0, 1))(fused, h)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+@pytest.mark.parametrize("shape", [(6, 255), (3, 4, 63)], ids=["flat", "batched"])
+def test_two_hot_symlog_loss_parity(dtype, shape):
+    rng = _rng(3)
+    logits = _norm_logits(rng, shape).astype(dtype)
+    value = jnp.asarray(rng.normal(size=shape[:-1] + (1,)).astype(np.float32), dtype=dtype) * 4
+    got = K.two_hot_symlog_loss(logits, value, backend="pallas")
+    want = K.two_hot_symlog_loss_reference(logits, value)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    if dtype == jnp.bfloat16:
+        # the kernel computes in f32 and casts at the boundary, so its truth
+        # is the f32 reference (bf16-quantized bins can shift the two-hot
+        # indices in the all-bf16 lax chain; see the GRU bf16 test)
+        want = K.two_hot_symlog_loss_reference(
+            logits.astype(jnp.float32), value.astype(jnp.float32)
+        )
+        np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want), rtol=2e-2, atol=5e-2)
+    else:
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_two_hot_symlog_loss_grad_parity():
+    rng = _rng(4)
+    logits = _norm_logits(rng, (6, 63))
+    value = jnp.asarray(rng.normal(size=(6, 1)).astype(np.float32)) * 4
+    g_got = jax.grad(lambda l, v: K.two_hot_symlog_loss(l, v, backend="pallas").sum(), (0, 1))(logits, value)
+    g_want = jax.grad(lambda l, v: K.two_hot_symlog_loss_reference(l, v).sum(), (0, 1))(logits, value)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+def test_two_hot_symexp_decode_parity(dtype):
+    rng = _rng(5)
+    logits = _norm_logits(rng, (6, 255)).astype(dtype)
+    got = K.two_hot_symexp_decode(logits, backend="pallas")
+    want = K.two_hot_symexp_decode_reference(logits)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    if dtype == jnp.bfloat16:
+        want = K.two_hot_symexp_decode_reference(logits.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want), rtol=5e-2, atol=5e-2)
+    else:
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_two_hot_symexp_decode_grad_parity():
+    rng = _rng(6)
+    logits = _norm_logits(rng, (6, 63))
+    g_got = jax.grad(lambda l: K.two_hot_symexp_decode(l, backend="pallas").sum())(logits)
+    g_want = jax.grad(lambda l: K.two_hot_symexp_decode_reference(l).sum())(logits)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16], ids=["f32", "bf16"])
+@pytest.mark.parametrize("trailing", [(1,), ()], ids=["TB1", "TB"])
+def test_gae_parity(dtype, trailing):
+    rng = _rng(7)
+    r, v, d, nv = _gae_inputs(rng, trailing=trailing, dtype=np.float32)
+    r, v, nv = (x.astype(dtype) for x in (r, v, nv))
+    ret_p, adv_p = K.gae(r, v, d, nv, 0.99, 0.95, backend="pallas")
+    ret_l, adv_l = K.gae(r, v, d, nv, 0.99, 0.95, backend="lax")
+    assert ret_p.dtype == ret_l.dtype == jnp.float32  # f32 accumulation both ways
+    np.testing.assert_allclose(np.asarray(ret_p), np.asarray(ret_l), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(adv_p), np.asarray(adv_l), rtol=1e-6, atol=1e-6)
+
+
+def test_gae_grad_parity():
+    rng = _rng(8)
+    r, v, d, nv = _gae_inputs(rng)
+
+    def loss(backend, r_, v_, nv_):
+        ret, adv = K.gae(r_, v_, d, nv_, 0.99, 0.95, backend=backend)
+        return (ret * adv).sum()
+
+    g_got = jax.grad(lambda *a: loss("pallas", *a), (0, 1, 2))(r, v, nv)
+    g_want = jax.grad(lambda *a: loss("lax", *a), (0, 1, 2))(r, v, nv)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_sumtree_sample_parity():
+    rng = _rng(9)
+    tree = _tree(rng)
+    u = jnp.asarray(rng.uniform(size=(17,)).astype(np.float32))
+    n_valid = jnp.asarray(40, jnp.int32)
+    beta = jnp.asarray(0.4, jnp.float32)
+    leaf_p, w_p = K.sumtree_sample(tree, u, n_valid, beta, backend="pallas")
+    leaf_l, w_l = K.sumtree_sample(tree, u, n_valid, beta, backend="lax")
+    np.testing.assert_array_equal(np.asarray(leaf_p), np.asarray(leaf_l))
+    np.testing.assert_allclose(np.asarray(w_p), np.asarray(w_l), rtol=1e-6, atol=1e-7)
+
+
+def test_sumtree_sample_grad_parity():
+    rng = _rng(10)
+    tree = _tree(rng)
+    u = jnp.asarray(rng.uniform(size=(9,)).astype(np.float32))
+    n_valid = jnp.asarray(40, jnp.int32)
+
+    def loss(backend, tree_, beta_):
+        return K.sumtree_sample(tree_, u, n_valid, beta_, backend=backend)[1].sum()
+
+    beta = jnp.asarray(0.4, jnp.float32)
+    g_got = jax.grad(lambda t, b: loss("pallas", t, b), (0, 1))(tree, beta)
+    g_want = jax.grad(lambda t, b: loss("lax", t, b), (0, 1))(tree, beta)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "dtype", [np.float32, jnp.bfloat16, np.uint8], ids=["f32", "bf16", "u8"]
+)
+@pytest.mark.parametrize("feat", [(2,), ()], ids=["feature", "scalar"])
+def test_ragged_ring_scatter_parity(dtype, feat):
+    rng = _rng(11)
+    storage, staged, row, pos = _ring_case(rng, feat=feat)
+    if dtype == np.uint8:
+        storage = (jnp.abs(storage) * 20).astype(jnp.uint8)
+        staged = (jnp.abs(staged) * 20).astype(jnp.uint8)
+    else:
+        storage, staged = storage.astype(dtype), staged.astype(dtype)
+    off = jnp.asarray(1, jnp.int32)
+    got = K.ragged_ring_scatter(storage, staged, row, pos, off, backend="pallas")
+    want = K.ragged_ring_scatter(storage, staged, row, pos, off, backend="lax")
+    # a scatter copies values: parity is exact for every dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ragged_ring_scatter_all_dropped_column():
+    """An env whose every slot is masked out must keep its column untouched
+    (the dropped slots park on (pos-1) % C and write the old value back)."""
+    rng = _rng(12)
+    from sheeprl_tpu.data.ring import ring_append_rows
+
+    C, S, e = 6, 3, 2
+    pos = jnp.asarray([0, 4], jnp.int32)
+    valid = jnp.asarray([0, 4], jnp.int32)
+    mask = jnp.asarray([[0, 1], [0, 1], [0, 0]], jnp.int32)
+    row, _, _ = ring_append_rows(pos, valid, mask, C)
+    storage = jnp.asarray(rng.normal(size=(C, e, 3)).astype(np.float32))
+    staged = jnp.asarray(rng.normal(size=(S, e, 3)).astype(np.float32))
+    got = K.ragged_ring_scatter(storage, staged, row, pos, 0, backend="pallas")
+    want = K.ragged_ring_scatter(storage, staged, row, pos, 0, backend="lax")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got[:, 0]), np.asarray(storage[:, 0]))
+
+
+def test_ragged_ring_scatter_grad_parity():
+    rng = _rng(13)
+    storage, staged, row, pos = _ring_case(rng)
+    off = jnp.asarray(1, jnp.int32)
+
+    def loss(backend, s, t):
+        return (K.ragged_ring_scatter(s, t, row, pos, off, backend=backend) ** 2).sum()
+
+    g_got = jax.grad(lambda s, t: loss("pallas", s, t), (0, 1))(storage, staged)
+    g_want = jax.grad(lambda s, t: loss("lax", s, t), (0, 1))(storage, staged)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# jit stability: one cache entry per kernel under both backends
+# ---------------------------------------------------------------------------
+
+
+def _kernel_calls():
+    rng = _rng(14)
+    fused = jnp.asarray(rng.normal(size=(7, 24)).astype(np.float32))
+    h = jnp.asarray(rng.normal(size=(7, 8)).astype(np.float32))
+    logits = _norm_logits(rng, (6, 63))
+    value = jnp.asarray(rng.normal(size=(6, 1)).astype(np.float32))
+    r, v, d, nv = _gae_inputs(rng, T=8, B=4)
+    tree = _tree(rng, leaves=32, filled=20)
+    u = jnp.asarray(rng.uniform(size=(5,)).astype(np.float32))
+    storage, staged, row, pos = _ring_case(rng)
+    return {
+        "gru_gates": (lambda b: lambda f_, h_: K.gru_gates(f_, h_, backend=b), (fused, h)),
+        "two_hot_symlog_loss": (
+            lambda b: lambda l_, v_: K.two_hot_symlog_loss(l_, v_, backend=b), (logits, value)
+        ),
+        "two_hot_symexp_decode": (
+            lambda b: lambda l_: K.two_hot_symexp_decode(l_, backend=b), (logits,)
+        ),
+        "gae": (lambda b: lambda *a: K.gae(*a, 0.99, 0.95, backend=b), (r, v, d, nv)),
+        "sumtree_sample": (
+            lambda b: lambda t_, u_: K.sumtree_sample(t_, u_, jnp.asarray(20, jnp.int32), jnp.asarray(0.4, jnp.float32), backend=b),
+            (tree, u),
+        ),
+        "ragged_ring_scatter": (
+            lambda b: lambda s_, t_: K.ragged_ring_scatter(s_, t_, row, pos, 1, backend=b),
+            (storage, staged),
+        ),
+    }
+
+
+@pytest.mark.parametrize("backend", ["lax", "pallas"])
+def test_cache_size_one_per_kernel(backend):
+    for name, (make, args) in _kernel_calls().items():
+        jitted = jax.jit(make(backend))
+        jax.block_until_ready(jitted(*args))
+        jax.block_until_ready(jitted(*args))
+        assert jitted._cache_size() == 1, f"{name} retraced under backend={backend}"
