@@ -547,6 +547,22 @@ def _lane_serve_fleet() -> None:
     fleet_main()
 
 
+@lane("serve_flywheel", "flywheel", "serve_flywheel_rows_ingested_per_sec")
+def _lane_serve_flywheel() -> None:
+    # Production-loop SLO lane: closed-loop feedback clients against the
+    # full flywheel topology (SAC server + spool transport + the REAL
+    # `run --from-serve` learner subprocess under its supervisor), paired
+    # learner-off vs learner-on phases on identical traffic, with
+    # dropped == 0 / errors == 0 / rows_shed == 0 and nonzero learner ingest
+    # asserted in-lane. Knobs (BENCH_FLYWHEEL_DURATION / _CLIENTS / _CKPT)
+    # in benchmarks/serve_flywheel_bench.py, interpretation in
+    # howto/serving.md#the-flywheel.
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from serve_flywheel_bench import main as flywheel_main
+
+    flywheel_main()
+
+
 @lane("pod_restart", "pod", "pod_restart_mttr_s")
 def _lane_pod_restart() -> None:
     # Gang-restart MTTR lane: real 2-process pods through the CLI with one

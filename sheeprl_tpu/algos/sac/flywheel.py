@@ -1,0 +1,206 @@
+"""SAC flywheel learner-ingest: production serve rows → the device ring.
+
+The serve→train loop's learner side for the flat (SAC-family) algorithms:
+:class:`SACFlywheelIngest` rebuilds the agent from the SERVED checkpoint,
+stages spooled production transitions into a
+:class:`~sheeprl_tpu.replay.DeviceReplayBuffer` ring (``n_envs=1`` — each
+logged row is one transition), and drives the exact fused
+append+sample+update dispatch offline training uses
+(:func:`~sheeprl_tpu.algos.sac.sac.make_resident_train_step`): ``ingest_rows``
+rows per blob, grants metered by ``serve.flywheel.replay_ratio``, EMA flags
+on the ``critic.target_network_frequency`` cadence. Optimizer states start
+FRESH — the flywheel fine-tunes the published policy on live traffic; a
+checkpoint's optimizer moments belong to the offline run that wrote it.
+
+Registered via :func:`~sheeprl_tpu.utils.registry.register_flywheel_ingest`
+(the learner-side analogue of the serving tier's policy-builder registry) and
+audited as ``sac.flywheel_ingest`` in graft-audit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.registry import register_flywheel_ingest
+
+__all__ = ["SACFlywheelIngest", "flywheel_ingest_sac"]
+
+
+class SACFlywheelIngest:
+    """Feed flat ``(obs, action, reward, done, next_obs)`` float32 rows into
+    the SAC resident train step; publish-ready params live on ``.params``."""
+
+    def __init__(self, fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state) -> None:
+        from sheeprl_tpu.algos.sac.agent import build_agent
+        from sheeprl_tpu.algos.sac.sac import make_resident_train_step
+        from sheeprl_tpu.optim.builders import build_optimizer
+        from sheeprl_tpu.replay import DeviceReplayBuffer
+        from sheeprl_tpu.serve.flywheel import flywheel_row_width
+
+        fly = dict((cfg.get("serve", {}) or {}).get("flywheel", {}) or {})
+        self.fabric = fabric
+        mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+        self.obs_dim = int(sum(int(np.prod(observation_space[k].shape)) for k in mlp_keys))
+        self.act_dim = int(np.prod(action_space.shape))
+        self.row_width = flywheel_row_width(self.obs_dim, self.act_dim)
+
+        self.agent, self.params, _ = build_agent(fabric, cfg, observation_space, action_space, agent_state)
+        actor_tx = build_optimizer(cfg.algo.actor.optimizer)
+        critic_tx = build_optimizer(cfg.algo.critic.optimizer)
+        alpha_tx = build_optimizer(cfg.algo.alpha.optimizer)
+        self.aopt = actor_tx.init(self.params["actor"])
+        self.copt = critic_tx.init(self.params["critic"])
+        self.lopt = alpha_tx.init(self.params["log_alpha"])
+
+        self.ingest_rows = max(1, int(fly.get("ingest_rows", 64) or 64))
+        self.grad_max = max(1, int(fly.get("grad_max", 8) or 8))
+        self.replay_ratio = float(fly.get("replay_ratio", 0.5) or 0.5)
+        self.learning_starts = max(0, int(fly.get("learning_starts_rows", 128) or 128))
+        buffer_size = max(self.ingest_rows, int(fly.get("buffer_size", 4096) or 4096))
+        self.ema_every = max(1, int(cfg.algo.critic.target_network_frequency))
+        self.specs = {
+            "observations": ((self.obs_dim,), jnp.float32),
+            "next_observations": ((self.obs_dim,), jnp.float32),
+            "actions": ((self.act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "terminated": ((1,), jnp.float32),
+        }
+        self.drb = DeviceReplayBuffer(
+            fabric,
+            self.specs,
+            buffer_size,
+            1,  # one "env": every spooled row is one independent transition
+            stage_rows=self.ingest_rows,
+            extra_spec=[
+                ("__flags__", (self.grad_max,), np.float32),
+                ("__valid__", (self.grad_max,), np.float32),
+                ("__beta__", (), np.float32),
+            ],
+            seed=int(cfg.get("seed", 0) or 0) + 41,
+        )
+        self._fn = make_resident_train_step(
+            self.agent, actor_tx, critic_tx, alpha_tx, cfg, fabric.mesh, self.drb, self.grad_max,
+            guard=False, donate=True, append=True,
+        )
+        self.consumed = 0
+        self.grad_steps = 0
+        self._backlog = 0.0
+
+    def ingest(self, rows: np.ndarray) -> None:
+        """Consume ``(m, row_width)`` float32 rows: stage into the ring in
+        ``ingest_rows`` blobs, dispatching the fused append+train step per
+        blob (grants metered by the replay ratio, gated on
+        ``learning_starts_rows``; pre-gate blobs append-only via the zero
+        valid mask)."""
+        from sheeprl_tpu.serve.flywheel import split_rows
+
+        rows = np.ascontiguousarray(np.asarray(rows, np.float32).reshape(-1, self.row_width))
+        cols = split_rows(rows, self.obs_dim, self.act_dim)
+        m = len(rows)
+        i = 0
+        while i < m:
+            take = min(self.ingest_rows, m - i)
+            for j in range(i, i + take):
+                self.drb.add({k: cols[k][j] for k in self.specs})
+            i += take
+            self.consumed += take
+            if self.consumed >= self.learning_starts:
+                # cap the debt: a learner that fell behind catches up at
+                # grad_max per dispatch instead of hoarding unbounded grants
+                self._backlog = min(self._backlog + take * self.replay_ratio, float(self.grad_max * 4))
+            self._dispatch()
+
+    def _dispatch(self) -> None:
+        # mirrors the resident-mode loop in sac.py: the first dispatch
+        # appends the staged rows, append-free extras drain a big backlog
+        while True:
+            chunk = min(self.grad_max, int(self._backlog))
+            flags = np.zeros((self.grad_max,), np.float32)
+            valid = np.zeros((self.grad_max,), np.float32)
+            for t in range(chunk):
+                flags[t] = 1.0 if (self.grad_steps + t) % self.ema_every == 0 else 0.0
+            valid[:chunk] = 1.0
+            blob = self.drb.make_job(
+                {"__flags__": flags, "__valid__": valid, "__beta__": np.float32(0.0)}
+            )
+            outs = self._fn(self.params, self.aopt, self.copt, self.lopt, self.drb.state, blob)
+            self.params, self.aopt, self.copt, self.lopt, self.drb.state = outs[:5]
+            self._backlog -= chunk
+            self.grad_steps += chunk
+            if int(self._backlog) < self.grad_max:
+                break
+
+    def agent_state(self) -> Any:
+        """The publishable ``state["agent"]`` tree — the same structure the
+        serving tier's ``params_from_state`` rebuilds from, so a published
+        flywheel checkpoint hot-swaps with zero recompiles."""
+        return self.params
+
+
+@register_flywheel_ingest(algorithms=["sac", "sac_decoupled", "sac_sebulba"])
+def flywheel_ingest_sac(fabric, cfg: Dict[str, Any], observation_space, action_space, agent_state):
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    return SACFlywheelIngest(fabric, cfg, observation_space, action_space, agent_state)
+
+
+# --------------------------------------------------------------------------- #
+# graft-audit program registration (sheeprl_tpu.analysis.programs)
+# --------------------------------------------------------------------------- #
+
+from sheeprl_tpu.analysis.programs import AuditMesh, AuditProgram, register_audit_programs  # noqa: E402
+
+
+@register_audit_programs("sac.flywheel_ingest")
+def _audit_programs(spec: AuditMesh):
+    from jax.sharding import PartitionSpec as P
+
+    from sheeprl_tpu.algos.ppo.ppo import _abstract_like
+    from sheeprl_tpu.algos.sac.sac import audit_sac_setup, make_resident_train_step
+    from sheeprl_tpu.replay import DeviceReplayBuffer
+
+    s = audit_sac_setup(spec)
+    actor_tx, critic_tx, alpha_tx = s["txs"]
+    grad_max, ingest_rows = 2, 4
+    # the flywheel ring: n_envs=1 (one transition per spooled row),
+    # replicated storage, ingest_rows staged per blob
+    drb = DeviceReplayBuffer(
+        s["fabric"],
+        {
+            "observations": ((s["obs_dim"],), jnp.float32),
+            "next_observations": ((s["obs_dim"],), jnp.float32),
+            "actions": ((s["act_dim"],), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "terminated": ((1,), jnp.float32),
+        },
+        32,
+        1,
+        stage_rows=ingest_rows,
+        extra_spec=[
+            ("__flags__", (grad_max,), np.float32),
+            ("__valid__", (grad_max,), np.float32),
+            ("__beta__", (), np.float32),
+        ],
+        seed=41,
+    )
+    fn = make_resident_train_step(
+        s["agent"], actor_tx, critic_tx, alpha_tx, s["cfg"], s["mesh"], drb, grad_max,
+        guard=False, donate=True, append=True,
+    )
+    blob = jax.ShapeDtypeStruct((drb.layout.nbytes,), jnp.uint8, sharding=s["rep"])
+    yield AuditProgram(
+        name="sac.flywheel_ingest",
+        fn=fn,
+        args=(s["params"], s["aopt"], s["copt"], s["lopt"], _abstract_like(drb.state), blob),
+        source=__name__,
+        donate_argnums=(0, 1, 2, 3, 4),
+        feedback_outputs=(0, 1, 2, 3, 4),
+        out_decl={0: P(), 1: P(), 2: P(), 3: P()},
+        mesh=s["mesh"],
+        wire_dtype=spec.wire_dtype,
+    )

@@ -43,6 +43,7 @@ AUDIT_SOURCES: Tuple[str, ...] = (
     "sheeprl_tpu.algos.ppo.ppo_sebulba",
     "sheeprl_tpu.algos.sac.sac",
     "sheeprl_tpu.algos.sac.sac_sebulba",
+    "sheeprl_tpu.algos.sac.flywheel",
     "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
     "sheeprl_tpu.algos.dreamer_v3.dreamer_sebulba",
     "sheeprl_tpu.serve.engine",

@@ -365,6 +365,59 @@ def serve_algorithm(cfg: DotDict) -> None:
     fabric.launch(serve_policy, cfg, state, builder)
 
 
+def flywheel_algorithm(cfg: DotDict) -> None:
+    """Run the flywheel LEARNER for one serve spool directory
+    (howto/serving.md#the-flywheel). Mirrors :func:`serve_algorithm` —
+    single-device fabric, checkpoint state — but hands off to the spool
+    tailer/trainer instead of the request scheduler; the algorithm's
+    learner-ingest builder is resolved (and the typed
+    :class:`~sheeprl_tpu.serve.flywheel.FlywheelConfigError` raised) inside
+    :func:`~sheeprl_tpu.serve.flywheel.run_flywheel_learner`."""
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.serve.flywheel import run_flywheel_learner
+    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.utils.utils import pin_cpu_platform
+
+    pin_cpu_platform(cfg.get("fabric", {}).get("accelerator", "auto"))
+
+    from sheeprl_tpu.ops.kernels import configure_from_config
+
+    configure_from_config(cfg.get("ops"))
+
+    fabric = Fabric(
+        devices=1,
+        accelerator=cfg.fabric.get("accelerator", "auto"),
+        precision=str(cfg.fabric.get("precision", "32-true")),
+    )
+    fabric.seed_everything(cfg.seed if cfg.get("seed") is not None else 42)
+    state = load_state(cfg.checkpoint_path)
+    fabric.launch(run_flywheel_learner, cfg, state)
+
+
+def learn_from_serve(args: List[str], directory: str) -> None:
+    """``sheeprl_tpu run --from-serve <dir>``: the flywheel learner as its
+    own process — tail the serve fleet's spool directory, train through the
+    algorithm's registered learner-ingest builder starting from the served
+    checkpoint, and publish checkpoints back next to it. Composes like
+    ``serve`` (checkpoint-run config discovered and merged) so the learner
+    rebuilds the exact agent the fleet is serving."""
+    serve_cfg = compose(args, config_name="serve_config")
+    if not serve_cfg.get("checkpoint_path"):
+        raise ValueError("You must specify the checkpoint path the flywheel learner starts from")
+    serve_block = dict(serve_cfg.get("serve", {}))
+    fly = dict(serve_block.get("flywheel") or {})
+    fly["enabled"] = True
+    fly["dir"] = str(directory)
+    serve_block["flywheel"] = fly
+    merged = _merged_ckpt_cfg(
+        serve_cfg,
+        "flywheel",
+        capture_video=False,
+        extra={"serve": serve_block},
+    )
+    flywheel_algorithm(merged)
+
+
 def _extract_fleet_flag(args: List[str]) -> Tuple[List[str], Optional[int]]:
     """Pull ``--fleet [N]`` / ``--fleet=N`` out of hydra-style args; returns
     (remaining args, replica count or None). Bare ``--fleet`` means 3."""
@@ -388,6 +441,62 @@ def _extract_fleet_flag(args: List[str]) -> Tuple[List[str], Optional[int]]:
         out.append(tok)
         i += 1
     return out, fleet
+
+
+def _extract_flywheel_flag(args: List[str]) -> Tuple[List[str], bool, Optional[str]]:
+    """Pull ``--flywheel [DIR]`` / ``--flywheel=DIR`` out of hydra-style
+    args; returns (remaining args, enabled, spool dir or None). Bare
+    ``--flywheel`` enables the loop with the default spool dir (a
+    ``flywheel/`` sibling of the served checkpoint)."""
+    out: List[str] = []
+    enabled = False
+    directory: Optional[str] = None
+    i = 0
+    while i < len(args):
+        tok = args[i]
+        if tok == "--flywheel":
+            enabled = True
+            nxt = args[i + 1] if i + 1 < len(args) else None
+            if nxt is not None and "=" not in nxt and not nxt.startswith("-"):
+                directory = nxt
+                i += 2
+            else:
+                i += 1
+            continue
+        if tok.startswith("--flywheel="):
+            enabled = True
+            directory = tok.split("=", 1)[1] or None
+            i += 1
+            continue
+        out.append(tok)
+        i += 1
+    return out, enabled, directory
+
+
+def _extract_from_serve_flag(args: List[str]) -> Tuple[List[str], Optional[str]]:
+    """Pull ``--from-serve DIR`` / ``--from-serve=DIR`` out of hydra-style
+    args; returns (remaining args, spool dir or None). DIR is required —
+    the learner is meaningless without the spool directory to tail."""
+    out: List[str] = []
+    directory: Optional[str] = None
+    i = 0
+    while i < len(args):
+        tok = args[i]
+        if tok == "--from-serve":
+            if i + 1 >= len(args) or "=" in args[i + 1]:
+                raise ValueError("--from-serve needs the flywheel spool directory (`--from-serve <dir>`)")
+            directory = args[i + 1]
+            i += 2
+            continue
+        if tok.startswith("--from-serve="):
+            directory = tok.split("=", 1)[1]
+            if not directory:
+                raise ValueError("--from-serve needs the flywheel spool directory (`--from-serve=<dir>`)")
+            i += 1
+            continue
+        out.append(tok)
+        i += 1
+    return out, directory
 
 
 def _extract_pod_flag(args: List[str]) -> Tuple[List[str], Optional[int]]:
@@ -427,12 +536,17 @@ def serve(args: Optional[List[str]] = None, fleet: Optional[int] = None, require
     one in-process server (howto/serving.md#the-serve-fleet)."""
     args = list(sys.argv[1:] if args is None else args)
     args, flag_fleet = _extract_fleet_flag(args)
+    args, flag_flywheel, flywheel_dir = _extract_flywheel_flag(args)
     fleet = flag_fleet if flag_fleet is not None else fleet
     serve_cfg = compose(args, config_name="serve_config")
     if not serve_cfg.get("checkpoint_path"):
         raise ValueError("You must specify the checkpoint path to serve")
     if fleet is not None:
         serve_cfg.serve.fleet.replicas = int(fleet)
+    if flag_flywheel:
+        serve_cfg.serve.flywheel.enabled = True
+        if flywheel_dir is not None:
+            serve_cfg.serve.flywheel.dir = str(flywheel_dir)
     merged = _merged_ckpt_cfg(
         serve_cfg,
         "serve",
@@ -499,8 +613,18 @@ def run(args: Optional[List[str]] = None) -> None:
 
     ``--pod N`` (or ``fabric.pod.workers=N``) trains over a gang-supervised
     pod of N worker processes spanning ONE ``jax.distributed`` mesh instead
-    of a single process (howto/fault_tolerance.md#pod-training)."""
+    of a single process (howto/fault_tolerance.md#pod-training).
+
+    ``--from-serve <dir>`` runs the flywheel LEARNER instead of an offline
+    training run: tail the serve fleet's trajectory spool under <dir>,
+    fine-tune the served checkpoint on production rows, and publish
+    checkpoints back for the fleet's watchers to adopt
+    (howto/serving.md#the-flywheel)."""
     args = list(sys.argv[1:] if args is None else args)
+    args, from_serve = _extract_from_serve_flag(args)
+    if from_serve is not None:
+        learn_from_serve(args, from_serve)
+        return
     args, pod_flag = _extract_pod_flag(args)
     cfg = compose(args)
     from sheeprl_tpu.utils.utils import print_config

@@ -61,6 +61,7 @@ __all__ = [
     "release_hangs",
     "set_replica_chaos",
     "set_host_chaos",
+    "set_learner_chaos",
     "truncate_file",
     "scramble_file",
     "corrupt_checkpoint_arrays",
@@ -73,7 +74,10 @@ KILL_ENV_VAR = "SHEEPRL_FAULT_KILL"
 ARM_ENV_VAR = "SHEEPRL_FAULT_ARM"
 NAN_ENV_VAR = "SHEEPRL_FAULT_NAN_AT"
 
-_ACTIONS = ("raise", "kill", "kill-thread", "hang", "kill-replica", "hang-replica", "kill-host", "hang-host")
+_ACTIONS = (
+    "raise", "kill", "kill-thread", "hang",
+    "kill-replica", "hang-replica", "kill-host", "hang-host", "kill-learner", "hang-learner",
+)
 
 _counts: Dict[str, int] = {}
 _armed: Dict[str, Tuple[str, int, float]] = {}  # point -> (action, Nth-hit, hang_s)
@@ -87,6 +91,11 @@ _replica_chaos: Dict[str, Optional[Any]] = {"kill": None, "hang": None}
 # SIGKILL / SIGSTOP one of its training WORKER processes (a whole "host" of
 # the pod mesh); the "kill-host" / "hang-host" actions dispatch to them.
 _host_chaos: Dict[str, Optional[Any]] = {"kill": None, "hang": None}
+# learner-tier chaos (graft-flywheel): the serve owner registers callables
+# that SIGKILL / SIGSTOP the flywheel learner subprocess; the "kill-learner"
+# / "hang-learner" actions dispatch to them — the isolation drill's verbs
+# (serving must not notice either).
+_learner_chaos: Dict[str, Optional[Any]] = {"kill": None, "hang": None}
 
 
 class FaultInjected(RuntimeError):
@@ -143,6 +152,17 @@ def set_host_chaos(kill: Optional[Any] = None, hang: Optional[Any] = None) -> No
     _host_chaos["hang"] = hang
 
 
+def set_learner_chaos(kill: Optional[Any] = None, hang: Optional[Any] = None) -> None:
+    """Register the flywheel-learner chaos handlers (the serve owner's
+    :class:`~sheeprl_tpu.serve.flywheel.LearnerSupervisor` does this at
+    spawn): ``kill()`` SIGKILLs the learner subprocess, ``hang()`` wedges it
+    (SIGSTOP — alive but silent, the status-lease-expiry model). The
+    ``kill-learner`` / ``hang-learner`` actions dispatch here; unarmed or
+    unregistered they are no-ops. Cleared by :func:`reset`."""
+    _learner_chaos["kill"] = kill
+    _learner_chaos["hang"] = hang
+
+
 def release_hangs() -> None:
     """Wake every thread currently stalled in a ``hang`` fault point (and any
     future one until the next :func:`reset`) — test teardown's escape hatch."""
@@ -158,6 +178,8 @@ def reset() -> None:
     _replica_chaos["hang"] = None
     _host_chaos["kill"] = None
     _host_chaos["hang"] = None
+    _learner_chaos["kill"] = None
+    _learner_chaos["hang"] = None
     _hang_release.set()  # release any thread still stalled in a hang
     _hang_release = threading.Event()
 
@@ -230,12 +252,18 @@ def fault_point(point: str) -> None:
         return
     if action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)  # the preemption model: no cleanup
-    if action in ("kill-replica", "hang-replica", "kill-host", "hang-host"):
+    if action in ("kill-replica", "hang-replica", "kill-host", "hang-host", "kill-learner", "hang-learner"):
         # process-tier chaos: dispatch to the registered handler (fleet
-        # router for -replica, pod launcher for -host); the CALLING thread
-        # (the owner's poll loop) keeps running — the drill is that the
-        # fleet/pod survives, not that the caller dies
-        registry = _host_chaos if action.endswith("-host") else _replica_chaos
+        # router for -replica, pod launcher for -host, the serve owner's
+        # learner supervisor for -learner); the CALLING thread (the owner's
+        # poll loop) keeps running — the drill is that the fleet/pod/serve
+        # tier survives, not that the caller dies
+        if action.endswith("-host"):
+            registry = _host_chaos
+        elif action.endswith("-learner"):
+            registry = _learner_chaos
+        else:
+            registry = _replica_chaos
         handler = registry.get(action.split("-", 1)[0])
         if handler is not None:
             handler()

@@ -44,6 +44,12 @@ SIGTERM/SIGINT trigger a graceful drain in the CLI.
 
 from sheeprl_tpu.serve.engine import BucketEngine, JitEngine
 from sheeprl_tpu.serve.fleet import FleetReplicaError, FleetRouter, ReplicaEndpoint
+from sheeprl_tpu.serve.flywheel import (
+    FlywheelConfigError,
+    LearnerSupervisor,
+    SpoolReader,
+    TrajectoryLog,
+)
 from sheeprl_tpu.serve.policy import ServePolicy, StatefulServePolicy
 from sheeprl_tpu.serve.scheduler import (
     RequestScheduler,
@@ -76,4 +82,8 @@ __all__ = [
     "FleetRouter",
     "FleetReplicaError",
     "ReplicaEndpoint",
+    "FlywheelConfigError",
+    "TrajectoryLog",
+    "SpoolReader",
+    "LearnerSupervisor",
 ]

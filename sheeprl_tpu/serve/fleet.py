@@ -749,13 +749,19 @@ def replica_command(
     checkpoint_path: str,
     host: str,
     port: int,
+    name: Optional[str] = None,
 ) -> List[str]:
     """The ``sheeprl_tpu serve`` invocation for ONE replica: same checkpoint,
     its own port, watching the shared checkpoint dir with
     ``watch_publish_current`` so a respawn rejoins on the newest complete
     save. Only scalar serve knobs that survive a CLI round trip are
     forwarded; everything else re-derives from the checkpoint's own run
-    config exactly like a hand-started ``serve``."""
+    config exactly like a hand-started ``serve``.
+
+    With the flywheel enabled each replica logs into the SHARED spool dir
+    under its fleet name (spool headers carry the attribution) but never
+    spawns its own learner — the fleet parent owns the single supervised
+    learner process for the whole fleet."""
     serve_cfg = dict(cfg.get("serve", {}) or {})
     cmd = [
         sys.executable,
@@ -777,6 +783,15 @@ def replica_command(
             cmd.append(f"serve.{key}={serve_cfg[key]}")
     if serve_cfg.get("buckets"):
         cmd.append("serve.buckets=[" + ",".join(str(int(b)) for b in serve_cfg["buckets"]) + "]")
+    fly = dict(serve_cfg.get("flywheel", {}) or {})
+    if fly.get("enabled") and fly.get("dir"):
+        cmd.append("serve.flywheel.enabled=True")
+        cmd.append(f"serve.flywheel.dir={fly['dir']}")
+        cmd.append(f"serve.flywheel.replica={name or f'replica-{port}'}")
+        cmd.append("serve.flywheel.learner=False")  # ONE learner, owned by the fleet parent
+        for key in ("block_rows", "queue_blocks", "flush_s", "max_streams"):
+            if fly.get(key) is not None:
+                cmd.append(f"serve.flywheel.{key}={fly[key]}")
     return cmd
 
 
@@ -797,12 +812,22 @@ def serve_fleet(cfg: Any) -> None:
         raise ValueError("You must specify the checkpoint path to serve")
     host = str(serve_cfg.get("host", "127.0.0.1"))
     inject.arm_from_cfg(cfg)  # the seeded chaos schedule (fault.chaos.events)
+    fly_cfg = dict(serve_cfg.get("flywheel", {}) or {})
+    if fly_cfg.get("enabled"):
+        # resolve the shared spool dir ONCE, before any replica spawns, so
+        # every replica and the single fleet-owned learner agree on it
+        from pathlib import Path
+
+        if not fly_cfg.get("dir"):
+            fly_cfg["dir"] = str(Path(os.path.abspath(str(checkpoint_path))).parent / "flywheel")
+        serve_cfg["flywheel"] = fly_cfg
+        cfg["serve"] = serve_cfg
     procsup = ProcessSupervisor.from_config(fleet_cfg, name="serve-fleet")
     endpoints: List[ReplicaEndpoint] = []
     for i in range(n):
         port = free_port(host)
         name = f"replica-{i}"
-        cmd = replica_command(cfg, str(checkpoint_path), host, port)
+        cmd = replica_command(cfg, str(checkpoint_path), host, port, name=name)
         endpoints.append(
             ReplicaEndpoint(
                 name,
@@ -820,6 +845,13 @@ def serve_fleet(cfg: Any) -> None:
         host=host,
         port=serve_cfg.get("port", 0),
     )
+    learner_sup = None
+    if fly_cfg.get("enabled") and fly_cfg.get("learner", True):
+        # ONE supervised learner for the whole fleet: N replicas spool into
+        # the shared dir, this process owns (and ticks) the learner's lease
+        from sheeprl_tpu.serve.flywheel import LearnerSupervisor
+
+        learner_sup = LearnerSupervisor(cfg, fly_cfg["dir"])
     drain = threading.Event()
     restore_handlers = install_drain_handlers(drain)
     router.start()
@@ -832,6 +864,8 @@ def serve_fleet(cfg: Any) -> None:
         last_log = time.perf_counter()
         while not drain.is_set():
             drain.wait(0.2)
+            if learner_sup is not None:
+                learner_sup.tick()
             now = time.perf_counter()
             if now - last_log >= log_every_s:
                 print(json.dumps(router.health()))
@@ -842,6 +876,8 @@ def serve_fleet(cfg: Any) -> None:
         pass
     finally:
         router.stop()  # drain router admission -> drain each replica -> exit 0
+        if learner_sup is not None:
+            learner_sup.stop()
         restore_handlers()
         print(json.dumps(router.health()))
         if drain.is_set():

@@ -1,0 +1,728 @@
+"""graft-flywheel: the serve→train production loop.
+
+The serve tier (graft-sessions, graft-fleet) answers production traffic; the
+checkpoint dir it watches was a one-way street from an offline trainer. This
+module closes the loop, GA3C / Sample Factory shaped (arXiv 1611.06256,
+arXiv 2006.11751): every :class:`~sheeprl_tpu.serve.server.PolicyServer`
+replica logs its served ``(obs, action, reward-feedback, done)`` rows into a
+shared spool directory, a supervised **learner process** tails the spools,
+trains on the production rows through the device-resident replay machinery
+(the SAC ring + ``make_resident_train_step``), and publishes new checkpoints
+back into the watched checkpoint dir — where the fleet's rolling-swap
+machinery adopts them with zero client-visible resets.
+
+Isolation is the design invariant: serving must never degrade because
+learning is slow, wedged, or dead.
+
+- **Logging is best-effort and shed-counted.** The scheduler worker stages
+  completed transitions into a preallocated block ring (the
+  :class:`~sheeprl_tpu.replay.driver.SeqBlobWriter` write-through idiom); a
+  spool-writer thread drains shipped blocks to disk. A full transport queue
+  DROPS the oldest staged block (``rows_shed``) — it never blocks a
+  dispatch, and a logging error of any kind is counted, not raised.
+- **Feedback pairing is server-side.** A request's optional ``reward`` /
+  ``done`` fields are feedback for the PREVIOUS action served on the same
+  stream (a session, a connection, or an in-process client); the completed
+  transition is ``(prev_obs, prev_action, reward, done, next_obs=obs)``.
+  Feedback-less clients serve exactly as before — their rows are counted
+  ``feedback_missing`` and nothing is logged.
+- **The learner is a supervised subprocess.** ``serve --flywheel`` spawns
+  ``run --from-serve <dir>`` under the
+  :class:`~sheeprl_tpu.fault.procsup.ProcessSupervisor` ladder; its
+  heartbeat is the mtime of the ``learner_status.json`` it rewrites every
+  ingest pass, so a SIGSTOPped learner misses its lease, is SIGKILLed and
+  respawned — while serving continues untouched (the chaos drill in
+  ``tests/test_serve/test_flywheel_chaos.py`` proves zero dropped admitted
+  requests with the learner wedged, via the ``kill-learner`` /
+  ``hang-learner`` fault actions).
+
+Spool format (one file per replica generation, ``<replica>.<pid>.spool``):
+a JSON header line, then binary frames of ``<III`` (magic, n_rows,
+payload_bytes) + ``n_rows`` rows of ``row_width`` float32. A row is one flat
+transition: ``[obs, action, reward, done, next_obs]``. The reader tails
+files by offset, attributes rows to the replica named in the header, and
+waits out torn tails (a killed writer loses at most its staged blocks plus
+one partial frame — counted, bounded).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import queue
+import struct
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "FlywheelConfigError",
+    "TrajectoryLog",
+    "SpoolReader",
+    "flywheel_row_width",
+    "split_rows",
+    "read_learner_status",
+    "write_learner_status",
+    "learner_command",
+    "LearnerSupervisor",
+    "run_flywheel_learner",
+]
+
+SPOOL_MAGIC = "sheeprl-flywheel/1"
+SPOOL_SUFFIX = ".spool"
+FRAME_MAGIC = 0x57594C46  # "FLYW"
+_FRAME = struct.Struct("<III")  # magic, n_rows, payload_bytes
+STATUS_NAME = "learner_status.json"
+#: row layout keys, in column order — exactly the SAC resident_specs keys
+ROW_KEYS = ("observations", "actions", "rewards", "terminated", "next_observations")
+
+
+class FlywheelConfigError(ValueError):
+    """``serve.flywheel`` enabled for an algorithm with no registered
+    learner-ingest builder (or an unusable flywheel config) — raised at
+    server build time, before any socket binds."""
+
+
+def flywheel_row_width(obs_dim: int, act_dim: int) -> int:
+    """Columns of one flat logged transition: obs + action + reward + done +
+    next_obs."""
+    return 2 * int(obs_dim) + int(act_dim) + 2
+
+
+def split_rows(rows: np.ndarray, obs_dim: int, act_dim: int) -> Dict[str, np.ndarray]:
+    """``(m, row_width)`` float32 rows -> the SAC resident-spec column dict."""
+    od, ad = int(obs_dim), int(act_dim)
+    return {
+        "observations": rows[:, :od],
+        "actions": rows[:, od : od + ad],
+        "rewards": rows[:, od + ad : od + ad + 1],
+        "terminated": rows[:, od + ad + 1 : od + ad + 2],
+        "next_observations": rows[:, od + ad + 2 :],
+    }
+
+
+# -- server side: the trajectory log ------------------------------------------
+class TrajectoryLog:
+    """Per-replica write-through trajectory staging + spool writer.
+
+    The scheduler worker calls :meth:`observe` after resolving each request
+    (the caller is already unblocked — logging never sits on the request
+    path). Completed transitions are written into a preallocated block from
+    a fixed slot ring (``queue_blocks + 2`` blocks of ``block_rows`` rows —
+    the :class:`~sheeprl_tpu.replay.driver.SeqBlobWriter` aliasing rule: a
+    block in the transport queue is never written); full blocks ship through
+    a bounded queue to the spool-writer thread. No free block or a full
+    queue sheds the staged rows (counted) instead of blocking.
+
+    ``observe`` is exception-free by contract: any internal failure counts
+    ``errors`` and returns — a broken logger must never break serving.
+    """
+
+    def __init__(
+        self,
+        directory: "str | Path",
+        obs_spec: Dict[str, Tuple[tuple, Any]],
+        action_dim: int,
+        *,
+        replica: str = "replica",
+        block_rows: int = 256,
+        queue_blocks: int = 8,
+        flush_s: float = 0.25,
+        max_streams: int = 4096,
+    ) -> None:
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.replica = str(replica)
+        self._keys = tuple(sorted(obs_spec))
+        self.obs_dim = int(sum(int(np.prod(shape)) for shape, _ in obs_spec.values()))
+        self.act_dim = int(action_dim)
+        self.row_width = flywheel_row_width(self.obs_dim, self.act_dim)
+        self.block_rows = max(1, int(block_rows))
+        self.flush_s = float(flush_s)
+        self.max_streams = max(1, int(max_streams))
+
+        base = f"{self.replica}.{os.getpid()}"
+        path = self.directory / (base + SPOOL_SUFFIX)
+        i = 1
+        while path.exists():  # same replica name + pid re-opened in-process
+            path = self.directory / f"{base}.{i}{SPOOL_SUFFIX}"
+            i += 1
+        self.path = path
+        self._file = open(self.path, "wb")
+        header = {
+            "magic": SPOOL_MAGIC,
+            "replica": self.replica,
+            "row_width": self.row_width,
+            "obs_dim": self.obs_dim,
+            "act_dim": self.act_dim,
+            "keys": list(ROW_KEYS),
+        }
+        self._file.write((json.dumps(header) + "\n").encode())
+        self._file.flush()
+
+        n_blocks = max(2, int(queue_blocks)) + 2
+        self._free: "collections.deque[np.ndarray]" = collections.deque(
+            np.empty((self.block_rows, self.row_width), np.float32) for _ in range(n_blocks)
+        )
+        self._q: "queue.Queue[Tuple[np.ndarray, int]]" = queue.Queue(maxsize=max(2, int(queue_blocks)))
+        self._cur = self._free.popleft()
+        self._cursor = 0
+        self._last_ship = time.monotonic()
+        self._pending: "collections.OrderedDict[str, Tuple[np.ndarray, np.ndarray]]" = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "rows_logged": 0,
+            "rows_shed": 0,
+            "blocks_shed": 0,
+            "blocks_shipped": 0,
+            "feedback_missing": 0,
+            "feedback_orphans": 0,
+            "rows_spooled": 0,
+            "frames": 0,
+            "spool_bytes": 0,
+            "errors": 0,
+        }
+        self._stop = threading.Event()
+        self._closed = False
+        # graft-sync: disable-next-line=GS004 — the spool writer is the shed
+        # boundary itself: its death only stops draining the bounded queue,
+        # which surfaces as rows_shed/transport depth, never as a serve fault
+        self._writer = threading.Thread(target=self._writer_loop, name="flywheel-spool", daemon=True)
+        self._writer.start()
+
+    # -- the scheduler-facing hook -------------------------------------------
+    def observe(
+        self,
+        obs: Dict[str, np.ndarray],
+        n: int,
+        actions: Any,
+        reward: Any,
+        done: Any,
+        stream: Optional[str],
+    ) -> None:
+        """Pair this request with the pending action of its stream and stage
+        any completed transitions. NEVER raises (errors are counted)."""
+        try:
+            self._observe(obs, int(n), actions, reward, done, stream)
+        except Exception:
+            with self._lock:
+                self.counters["errors"] += 1
+
+    def _observe(self, obs, n, actions, reward, done, stream) -> None:
+        if self._closed:
+            return
+        stream = str(stream) if stream is not None else "anonymous"
+        flat = np.concatenate(
+            [np.asarray(obs[k], np.float32).reshape(n, -1) for k in self._keys], axis=1
+        )
+        acts = np.asarray(actions, np.float32).reshape(n, -1)[:, : self.act_dim]
+        with self._lock:
+            prev = self._pending.pop(stream, None)
+            if reward is None:
+                if prev is not None:
+                    # the previous action's feedback never arrived: the
+                    # transition cannot be completed — count it
+                    self.counters["feedback_missing"] += len(prev[0])
+            elif prev is None or len(prev[0]) != n:
+                # feedback with nothing pending (a stream's first request,
+                # or a row-count mismatch): nothing to pair it with
+                self.counters["feedback_orphans"] += n
+            else:
+                prev_obs, prev_act = prev
+                rows = np.empty((n, self.row_width), np.float32)
+                od, ad = self.obs_dim, self.act_dim
+                rows[:, :od] = prev_obs
+                rows[:, od : od + ad] = prev_act
+                rows[:, od + ad] = np.asarray(reward, np.float32).reshape(-1)[:n]
+                rows[:, od + ad + 1] = (
+                    np.asarray(done, np.float32).reshape(-1)[:n] if done is not None else 0.0
+                )
+                rows[:, od + ad + 2 :] = flat
+                self._emit_locked(rows)
+            self._pending[stream] = (flat.copy(), acts.copy())
+            while len(self._pending) > self.max_streams:
+                _, (evicted_obs, _a) = self._pending.popitem(last=False)
+                self.counters["feedback_missing"] += len(evicted_obs)
+
+    def _emit_locked(self, rows: np.ndarray) -> None:
+        m = len(rows)
+        done = 0
+        while done < m:
+            take = min(m - done, self.block_rows - self._cursor)
+            self._cur[self._cursor : self._cursor + take] = rows[done : done + take]
+            self._cursor += take
+            done += take
+            self.counters["rows_logged"] += take
+            if self._cursor >= self.block_rows:
+                self._ship_locked()
+        if self._cursor and time.monotonic() - self._last_ship > self.flush_s:
+            self._ship_locked()
+
+    def _ship_locked(self) -> None:
+        """Rotate the staged block into the transport queue, or shed it.
+        Shedding resets the cursor and reuses the block — the dispatch path
+        never waits on the writer."""
+        if self._cursor == 0:
+            return
+        if not self._free or self._q.full():
+            self.counters["rows_shed"] += self._cursor
+            self.counters["blocks_shed"] += 1
+            self._cursor = 0
+            self._last_ship = time.monotonic()
+            return
+        block, self._cur = self._cur, self._free.popleft()
+        try:
+            self._q.put_nowait((block, self._cursor))
+            self.counters["blocks_shipped"] += 1
+        except queue.Full:  # raced the writer's drain; shed
+            self.counters["rows_shed"] += self._cursor
+            self.counters["blocks_shed"] += 1
+            self._free.append(block)
+        self._cursor = 0
+        self._last_ship = time.monotonic()
+
+    # -- the writer thread ----------------------------------------------------
+    def _writer_loop(self) -> None:
+        while True:
+            try:
+                block, n = self._q.get(timeout=min(max(self.flush_s, 0.05), 0.25))
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
+                self._flush_partial()
+                continue
+            self._write_frame(block[:n])
+            with self._lock:
+                self._free.append(block)
+        # drain whatever shipped before the stop flag
+        while True:
+            try:
+                block, n = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._write_frame(block[:n])
+            with self._lock:
+                self._free.append(block)
+        self._flush_partial(force=True)
+        try:
+            self._file.flush()
+            self._file.close()
+        except OSError:
+            pass
+
+    def _flush_partial(self, force: bool = False) -> None:
+        """Copy out a stale partial block under the lock and spool it — a
+        quiet tail of traffic must reach the learner within ~flush_s."""
+        with self._lock:
+            stale = self._cursor and (force or time.monotonic() - self._last_ship > self.flush_s)
+            if not stale:
+                return
+            rows = self._cur[: self._cursor].copy()
+            self._cursor = 0
+            self._last_ship = time.monotonic()
+        self._write_frame(rows)
+
+    def _write_frame(self, rows: np.ndarray) -> None:
+        if not len(rows):
+            return
+        try:
+            payload = np.ascontiguousarray(rows, np.float32).tobytes()
+            self._file.write(_FRAME.pack(FRAME_MAGIC, len(rows), len(payload)))
+            self._file.write(payload)
+            self._file.flush()
+            with self._lock:
+                self.counters["rows_spooled"] += len(rows)
+                self.counters["frames"] += 1
+                self.counters["spool_bytes"] += _FRAME.size + len(payload)
+        except (OSError, ValueError):
+            with self._lock:
+                self.counters["errors"] += 1
+
+    # -- introspection / lifecycle -------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self.counters)
+            out["pending_streams"] = len(self._pending)
+            out["staged_rows"] = self._cursor
+        out["transport_depth"] = self._q.qsize()
+        out["path"] = str(self.path)
+        return out
+
+    def close(self, abandon: bool = False) -> None:
+        """Flush and stop the writer. ``abandon`` simulates a crashed
+        replica: staged and queued rows are dropped on the floor (what a
+        SIGKILL would lose) and the file is closed where it stands."""
+        if self._closed:
+            return
+        self._closed = True
+        if abandon:
+            with self._lock:
+                self._cursor = 0
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+        self._stop.set()
+        self._writer.join(timeout=10.0)
+
+
+# -- learner side: the spool reader -------------------------------------------
+class SpoolReader:
+    """Tail every ``*.spool`` under a flywheel dir, frame by frame.
+
+    Per-file offsets survive across polls; rows are attributed to the
+    replica named in each spool's header (``consumed_rows`` is per-replica).
+    A torn tail (header or frame still being written, or cut short by a
+    killed writer) is simply waited out — it never advances the offset, and
+    ``pending_bytes`` exposes how much is sitting unparsed. A corrupt frame
+    (bad magic / width mismatch) quarantines that file.
+    """
+
+    def __init__(self, directory: "str | Path", row_width: int) -> None:
+        self.directory = Path(directory)
+        self.row_width = int(row_width)
+        self._files: Dict[str, Dict[str, Any]] = {}
+        self.consumed_rows: Dict[str, int] = {}
+        self.frames = 0
+        self.corrupt_files = 0
+
+    @property
+    def total_consumed(self) -> int:
+        return sum(self.consumed_rows.values())
+
+    def pending_bytes(self) -> int:
+        """Bytes on disk past every healthy file's parse offset."""
+        total = 0
+        for name, st in self._files.items():
+            if st.get("corrupt"):
+                continue
+            try:
+                total += max(0, os.path.getsize(self.directory / name) - st["offset"])
+            except OSError:
+                continue
+        return total
+
+    def poll(self) -> List[Tuple[str, np.ndarray]]:
+        """One pass over the spool dir; returns ``(replica, rows)`` batches
+        newly available since the last poll."""
+        out: List[Tuple[str, np.ndarray]] = []
+        try:
+            paths = sorted(p for p in self.directory.glob("*" + SPOOL_SUFFIX) if p.is_file())
+        except OSError:
+            return out
+        for path in paths:
+            st = self._files.setdefault(path.name, {"offset": 0, "replica": None, "corrupt": False})
+            if st["corrupt"]:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(st["offset"])
+                    buf = f.read()
+            except OSError:
+                continue
+            pos = 0
+            if st["replica"] is None:
+                nl = buf.find(b"\n")
+                if nl < 0:  # header still being written
+                    continue
+                try:
+                    header = json.loads(buf[:nl].decode())
+                    if header.get("magic") != SPOOL_MAGIC or int(header["row_width"]) != self.row_width:
+                        raise ValueError("spool header mismatch")
+                    st["replica"] = str(header.get("replica") or path.stem)
+                except (ValueError, KeyError, UnicodeDecodeError):
+                    st["corrupt"] = True
+                    self.corrupt_files += 1
+                    continue
+                pos = nl + 1
+            row_bytes = self.row_width * 4
+            while len(buf) - pos >= _FRAME.size:
+                magic, n, payload = _FRAME.unpack_from(buf, pos)
+                if magic != FRAME_MAGIC or payload != n * row_bytes:
+                    st["corrupt"] = True
+                    self.corrupt_files += 1
+                    break
+                if len(buf) - pos - _FRAME.size < payload:
+                    break  # torn tail: wait for the writer (or count it lost)
+                rows = (
+                    np.frombuffer(buf, np.float32, count=n * self.row_width, offset=pos + _FRAME.size)
+                    .reshape(n, self.row_width)
+                    .copy()
+                )
+                out.append((st["replica"], rows))
+                self.consumed_rows[st["replica"]] = self.consumed_rows.get(st["replica"], 0) + n
+                self.frames += 1
+                pos += _FRAME.size + payload
+            st["offset"] += pos
+        return out
+
+
+# -- learner status (the heartbeat file) --------------------------------------
+def write_learner_status(directory: "str | Path", status: Dict[str, Any]) -> None:
+    """Atomically rewrite ``learner_status.json`` — the learner's liveness
+    beat (its mtime) and the serve-side health probe's data source."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    tmp = directory / (STATUS_NAME + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(status, f)
+    os.replace(tmp, directory / STATUS_NAME)
+
+
+def read_learner_status(directory: "str | Path") -> Optional[Dict[str, Any]]:
+    """Best-effort read of the learner's status file (None when absent or
+    mid-replace — callers treat that as 'no news')."""
+    path = Path(directory) / STATUS_NAME
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            status = json.load(f)
+        status["staleness_s"] = max(0.0, time.time() - os.path.getmtime(path))
+        return status
+    except (OSError, ValueError):
+        return None
+
+
+# -- the supervised learner process -------------------------------------------
+def learner_command(cfg: Any, flywheel_dir: "str | Path") -> List[str]:
+    """The ``run --from-serve`` invocation for the learner subprocess: same
+    checkpoint, the shared spool dir, and the scalar flywheel knobs that
+    survive a CLI round trip (mirrors the fleet's ``replica_command``)."""
+    fly = dict((cfg.get("serve", {}) or {}).get("flywheel", {}) or {})
+    cmd = [
+        sys.executable,
+        "-m",
+        "sheeprl_tpu",
+        "run",
+        "--from-serve",
+        str(flywheel_dir),
+        f"checkpoint_path={cfg.checkpoint_path}",
+        f"fabric.accelerator={(cfg.get('fabric') or {}).get('accelerator', 'auto')}",
+    ]
+    if cfg.get("seed") is not None:
+        cmd.append(f"seed={int(cfg['seed'])}")
+    for key in (
+        "poll_s",
+        "publish_rows",
+        "max_rows",
+        "buffer_size",
+        "ingest_rows",
+        "grad_max",
+        "replay_ratio",
+        "learning_starts_rows",
+    ):
+        if fly.get(key) is not None:
+            cmd.append(f"serve.flywheel.{key}={fly[key]}")
+    return cmd
+
+
+class LearnerSupervisor:
+    """Owner-side supervision of the flywheel learner subprocess.
+
+    The serve CLI (and the fleet body) drives this from its drain loop:
+    :meth:`tick` feeds the learner's status-file mtime into its
+    :class:`~sheeprl_tpu.fault.procsup.ProcessSupervisor` lease (a SIGSTOPped
+    learner stops rewriting the file, misses the lease, and is SIGKILLed +
+    respawned), and :meth:`probe` is the health-probe's ``flywheel.learner``
+    block. Registers the ``kill-learner`` / ``hang-learner`` chaos handlers
+    on construction; :meth:`stop` clears them and drains the process.
+    """
+
+    NAME = "flywheel-learner"
+
+    def __init__(self, cfg: Any, flywheel_dir: "str | Path", procsup: Any = None) -> None:
+        from sheeprl_tpu.fault import inject
+        from sheeprl_tpu.fault.procsup import ProcessSupervisor
+
+        self.directory = Path(flywheel_dir)
+        fly = dict((cfg.get("serve", {}) or {}).get("flywheel", {}) or {})
+        self.procsup = procsup or ProcessSupervisor.from_config(
+            dict(fly.get("supervisor") or {}),
+            name="serve-flywheel",
+            lease_s=float(fly.get("lease_s", 15.0) or 15.0),
+            grace_s=float(fly.get("grace_s", 180.0) or 180.0),
+            max_restarts=3,
+            backoff=0.5,
+        )
+        cmd = learner_command(cfg, self.directory)
+        self.handle = self.procsup.spawn(self.NAME, lambda: subprocess.Popen(cmd))
+        self.fatal: Optional[BaseException] = None
+        self._status_mtime = 0.0
+        inject.set_learner_chaos(kill=self._chaos_kill, hang=self._chaos_hang)
+
+    # chaos handlers: the drill's SIGKILL / SIGSTOP verbs against whichever
+    # learner generation is currently alive
+    def _chaos_kill(self) -> None:
+        if self.handle.is_alive():
+            os.kill(self.handle.pid(), 9)  # SIGKILL
+
+    def _chaos_hang(self) -> None:
+        if self.handle.is_alive():
+            os.kill(self.handle.pid(), 19)  # SIGSTOP
+
+    def tick(self) -> None:
+        """One supervision pass: status-mtime beat + the supervisor engine.
+        A fatal escalation is stored (and visible via :meth:`probe`), never
+        raised into the serve loop — learning must not take serving down."""
+        from sheeprl_tpu.fault.inject import fault_point
+        from sheeprl_tpu.fault.supervisor import SupervisionError
+
+        fault_point("serve.flywheel.tick")  # chaos: kill-learner / hang-learner
+        try:
+            mtime = os.path.getmtime(self.directory / STATUS_NAME)
+        except OSError:
+            mtime = 0.0
+        if mtime > self._status_mtime:
+            self._status_mtime = mtime
+            self.procsup.beat(self.NAME)
+        try:
+            self.procsup.check()
+        except SupervisionError as e:
+            self.fatal = e
+
+    def probe(self) -> Dict[str, Any]:
+        """The health probe's ``flywheel.learner`` block."""
+        info = self.handle.info()
+        status = read_learner_status(self.directory) or {}
+        return {
+            "alive": bool(info["alive"]),
+            "state": info["state"],
+            "restarts": int(info["restarts"]),
+            "deaths": int(info["deaths"]),
+            "hangs": int(info["hangs"]),
+            "consumed_rows": int(status.get("consumed_rows", 0)),
+            "grad_steps": int(status.get("grad_steps", 0)),
+            "published_step": int(status.get("published_step", -1)),
+            "staleness_s": round(float(status.get("staleness_s", -1.0)), 3),
+            "fatal": str(self.fatal) if self.fatal is not None else None,
+        }
+
+    def stop(self, grace_s: Optional[float] = None) -> None:
+        from sheeprl_tpu.fault import inject
+
+        inject.set_learner_chaos(None, None)
+        self.procsup.terminate_all(grace_s)
+
+
+def run_flywheel_learner(fabric, cfg: Any, state: Dict[str, Any]) -> None:
+    """The learner process body (``run --from-serve <dir>``): tail the spool
+    dir, feed production rows into the algorithm's registered ingest builder,
+    and publish checkpoints back into the served checkpoint dir (strictly
+    newer steps — the fleet's watchers adopt them with monotone versions).
+
+    Runs until ``serve.flywheel.max_rows`` rows were consumed (null →
+    forever) or SIGTERM/SIGINT (publish what was learned, exit 0). Rewrites
+    ``learner_status.json`` every pass — the supervision heartbeat.
+    """
+    import gymnasium as gym
+
+    from sheeprl_tpu.envs.factory import make_env
+    from sheeprl_tpu.fault.inject import fault_point
+    from sheeprl_tpu.fault.manager import CheckpointManager, _parse_step
+    from sheeprl_tpu.serve.server import install_drain_handlers
+    from sheeprl_tpu.utils.registry import (
+        get_entrypoint,
+        registered_flywheel_ingest_names,
+        resolve_flywheel_ingest,
+    )
+
+    fly = dict((cfg.get("serve", {}) or {}).get("flywheel", {}) or {})
+    directory = Path(fly.get("dir") or "")
+    if not str(directory):
+        raise FlywheelConfigError("serve.flywheel.dir must name the shared spool directory")
+    directory.mkdir(parents=True, exist_ok=True)
+
+    entry = resolve_flywheel_ingest(str(cfg.algo.name))
+    if entry is None:
+        raise FlywheelConfigError(
+            f"serve.flywheel is enabled but the algorithm named '{cfg.algo.name}' has no "
+            f"registered learner-ingest builder. Algorithms with flywheel support: "
+            f"{', '.join(registered_flywheel_ingest_names())}."
+        )
+    env = make_env(cfg, cfg.seed, 0, None, "flywheel", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    env.close()
+
+    builder = get_entrypoint(entry)
+    ingest = builder(fabric, cfg, observation_space, action_space, state.get("agent"))
+    reader = SpoolReader(directory, ingest.row_width)
+    manager = CheckpointManager()
+    ckpt_path = Path(cfg.checkpoint_path)
+    ckpt_dir = ckpt_path.parent
+    base_step = _parse_step(ckpt_path.name) or 0
+    poll_s = float(fly.get("poll_s", 0.5) or 0.5)
+    publish_rows = max(1, int(fly.get("publish_rows", 64) or 64))
+    max_rows = fly.get("max_rows")
+    max_rows = int(max_rows) if max_rows else None
+
+    drain = threading.Event()
+    restore_handlers = install_drain_handlers(drain)
+    published_step = -1
+    published_at = 0
+
+    def _publish() -> None:
+        nonlocal published_step, published_at
+        step = base_step + reader.total_consumed
+        if step <= max(base_step, published_step):
+            return
+        manager.save(
+            ckpt_dir / f"ckpt_{step}_0.ckpt",
+            {"agent": ingest.agent_state(), "flywheel_rows": reader.total_consumed},
+            step=step,
+        )
+        published_step = step
+        published_at = reader.total_consumed
+        print(f"flywheel: published step {step} ({reader.total_consumed} production rows consumed)")
+
+    def _status() -> None:
+        write_learner_status(
+            directory,
+            {
+                "pid": os.getpid(),
+                "consumed_rows": reader.total_consumed,
+                "per_replica": dict(reader.consumed_rows),
+                "grad_steps": int(ingest.grad_steps),
+                "published_step": int(published_step),
+                "pending_bytes": reader.pending_bytes(),
+                "corrupt_files": int(reader.corrupt_files),
+            },
+        )
+
+    print(f"flywheel learner: ingesting {directory} -> publishing into {ckpt_dir} (base step {base_step})")
+    _status()
+    try:
+        while not drain.is_set():
+            fault_point("serve.flywheel.ingest")
+            batches = reader.poll()
+            fresh = 0
+            for _replica, rows in batches:
+                ingest.ingest(rows)
+                fresh += len(rows)
+            if reader.total_consumed - published_at >= publish_rows and ingest.grad_steps > 0:
+                _publish()
+            _status()
+            if max_rows is not None and reader.total_consumed >= max_rows:
+                break
+            if fresh == 0:
+                drain.wait(poll_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if ingest.grad_steps > 0:
+            _publish()
+        _status()
+        restore_handlers()
+        print(
+            f"flywheel learner: done ({reader.total_consumed} rows from "
+            f"{len(reader.consumed_rows)} replica(s), {ingest.grad_steps} grad steps, "
+            f"last published step {published_step})"
+        )

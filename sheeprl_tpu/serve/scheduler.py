@@ -89,6 +89,7 @@ class ServeStats(PipelineStats):
         self._latencies = collections.deque(maxlen=int(latency_window))
         self._depth_fn = None  # wired by the scheduler
         self._sessions_fn = None  # wired when serving a stateful policy
+        self._flywheel_fn = None  # wired when the trajectory flywheel is on
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -133,6 +134,20 @@ class ServeStats(PipelineStats):
                 }
             )
             sessions_fn = self._sessions_fn
+            flywheel_fn = self._flywheel_fn
+        if flywheel_fn is not None:
+            fl = flywheel_fn()
+            out.update(
+                {
+                    "Serve/flywheel_rows": fl["rows_logged"],
+                    "Serve/flywheel_shed": fl["rows_shed"],
+                    "Serve/flywheel_feedback_missing": fl["feedback_missing"],
+                    "Serve/flywheel_feedback_orphans": fl["feedback_orphans"],
+                    "Serve/flywheel_depth": fl["transport_depth"],
+                    "Serve/flywheel_spooled": fl["rows_spooled"],
+                    "Serve/flywheel_errors": fl["errors"],
+                }
+            )
         if sessions_fn is not None:
             s = sessions_fn()
             out.update(
@@ -153,15 +168,28 @@ class ServeStats(PipelineStats):
 class _Request:
     __slots__ = (
         "obs", "n", "session_id", "reset", "event", "actions", "version", "error", "t_submit", "t_resolve",
+        "reward", "done", "stream",
     )
 
     def __init__(
-        self, obs: Dict[str, np.ndarray], n: int, session_id: Optional[str] = None, reset: bool = False
+        self,
+        obs: Dict[str, np.ndarray],
+        n: int,
+        session_id: Optional[str] = None,
+        reset: bool = False,
+        reward: Any = None,
+        done: Any = None,
+        stream: Optional[str] = None,
     ) -> None:
         self.obs = obs
         self.n = n
         self.session_id = session_id
         self.reset = bool(reset)
+        # flywheel feedback: reward/done grade the PREVIOUS action served on
+        # this request's stream (session id, connection, in-process client)
+        self.reward = reward
+        self.done = done
+        self.stream = stream
         self.event = threading.Event()
         self.actions: Optional[np.ndarray] = None
         self.version = -1
@@ -234,6 +262,9 @@ class RequestScheduler:
         self.greedy = bool(greedy)
         self.stats = stats or ServeStats()
         self.sessions = sessions
+        # a serve.flywheel.TrajectoryLog when the flywheel is on: observe()
+        # is called post-resolve (callers already unblocked) and never raises
+        self.flywheel: Any = None
         if sessions is not None and not (hasattr(engine, "step_sessions") and hasattr(engine, "check_swap")):
             raise ValueError("a session cache needs a SessionEngine (engine lacks step_sessions/check_swap)")
         self._last_version: Optional[int] = None  # swap-compat check cadence
@@ -348,6 +379,9 @@ class RequestScheduler:
         timeout: Optional[float] = None,
         session_id: Optional[str] = None,
         reset: bool = False,
+        reward: Any = None,
+        done: Any = None,
+        stream: Optional[str] = None,
     ) -> _Request:
         """Enqueue a prepared batch; returns the request future. Blocks while
         the queue sits at its bound (backpressure); ``timeout`` seconds later
@@ -366,7 +400,10 @@ class RequestScheduler:
         n = self.engine.policy.validate_batch(obs)
         if session_id is not None and n != 1:
             raise ValueError(f"a session request is one state row, got n={n}")
-        req = _Request(obs, n, session_id=session_id, reset=reset)
+        req = _Request(
+            obs, n, session_id=session_id, reset=reset, reward=reward, done=done,
+            stream=stream if stream is not None else session_id,
+        )
         try:
             if timeout is None:
                 while not self._closed.is_set():
@@ -495,9 +532,16 @@ class RequestScheduler:
         self.stats.add("batches", 1)
         self.stats.add("rows_served", rows)
         start = 0
+        log = self.flywheel
         for r in batch:
-            r.resolve(actions[start : start + r.n], version)
+            rows_r = actions[start : start + r.n]
+            r.resolve(rows_r, version)
             start += r.n
+            if log is not None:
+                # AFTER resolve: the caller is already unblocked, and observe
+                # is shed-counted + exception-free — logging cannot add
+                # latency to, or fail, the request it records
+                log.observe(r.obs, r.n, rows_r, r.reward, r.done, r.stream)
 
     def _settle(self, pending: List[_Request], drain: bool) -> None:
         """Shutdown settlement: serve ``pending`` in admission-preserving

@@ -21,6 +21,12 @@ Wire protocol (one JSON object per line, both directions)::
     -> {"obs": {...}, "session_id": "user-42"}
     -> {"obs": {...}, "session_id": "user-42", "reset": true}  # new episode
 
+    # flywheel feedback (graft-flywheel, optional): reward/done grade the
+    # PREVIOUS action served on this stream (the session, else this
+    # connection) — completed transitions feed the live learner; omitting
+    # them serves identically, the rows are just counted feedback_missing
+    -> {"obs": {...}, "reward": 0.7, "done": false}
+
 ``obs`` leaves are RAW env observations (the server applies the algorithm's
 own normalization via ``ServePolicy.prepare``); ``n`` (default 1) is the
 number of batched rows in the request. ``session_id`` (stateful policies
@@ -81,10 +87,14 @@ class PolicyClient:
         policy: ServePolicy,
         scheduler: RequestScheduler,
         timeout_s: Optional[float] = None,
+        stream: Optional[str] = None,
     ) -> None:
         self.policy = policy
         self.scheduler = scheduler
         self.timeout_s = timeout_s
+        # flywheel stream identity for session-less callers: feedback pairs
+        # with the previous action served to THIS client object
+        self.stream = stream if stream is not None else f"client-{id(self):x}"
 
     def act(
         self,
@@ -94,6 +104,9 @@ class PolicyClient:
         submit_timeout: Optional[float] = None,
         session_id: Optional[str] = None,
         reset: bool = False,
+        reward: Any = None,
+        done: Any = None,
+        stream: Optional[str] = None,
     ) -> Tuple[np.ndarray, int]:
         """Actions (``(n, action_dim)``) + the weight version that produced
         them. ``timeout`` bounds the wait for the result; ``submit_timeout``
@@ -101,17 +114,33 @@ class PolicyClient:
         client's ``timeout_s``). On a stateful server ``session_id`` carries
         this caller's recurrent/latent state between calls (``n`` must be 1
         — one user, one state row) and ``reset`` restarts it for a new
-        episode."""
+        episode. ``reward``/``done`` (optional, flywheel servers) are
+        feedback on the PREVIOUS action this stream was served — a scalar or
+        ``n`` values; they never change what this call returns. ``stream``
+        overrides the feedback-pairing identity (the TCP front end passes
+        one per connection); it defaults to the session, else this client."""
         timeout = self.timeout_s if timeout is None else timeout
         submit_timeout = self.timeout_s if submit_timeout is None else submit_timeout
         prepared = self.policy.prepare(obs, n)
-        req = self.scheduler.submit(prepared, timeout=submit_timeout, session_id=session_id, reset=reset)
+        if stream is None:
+            stream = session_id if session_id is not None else self.stream
+        req = self.scheduler.submit(
+            prepared,
+            timeout=submit_timeout,
+            session_id=session_id,
+            reset=reset,
+            reward=reward,
+            done=done,
+            stream=stream,
+        )
         return self.scheduler.result(req, timeout=timeout)
 
 
 class _JsonLineHandler(socketserver.StreamRequestHandler):
     def handle(self) -> None:  # one connection, many newline-framed requests
         server: "_TcpFrontEnd" = self.server  # type: ignore[assignment]
+        # session-less feedback pairs against THIS connection's stream
+        conn_stream = f"conn-{self.client_address[0]}:{self.client_address[1]}"
         for raw in self.rfile:
             line = raw.strip()
             if not line:
@@ -138,6 +167,9 @@ class _JsonLineHandler(socketserver.StreamRequestHandler):
                     submit_timeout=server.request_timeout_s,
                     session_id=session_id,
                     reset=bool(msg.get("reset", False)),
+                    reward=msg.get("reward"),
+                    done=msg.get("done"),
+                    stream=session_id if session_id is not None else conn_stream,
                 )
                 resp = {"actions": np.asarray(actions).tolist(), "version": int(version)}
             except Exception as e:  # per-request: report, keep the connection
@@ -257,6 +289,43 @@ class PolicyServer:
         self._host = str(cfg.get("host", "127.0.0.1"))
         self._port = cfg.get("port", None)
         self._draining = False
+        # graft-flywheel: best-effort trajectory logging behind the resolve
+        # path. Misconfiguration fails HERE — at build time, before a socket
+        # binds — never in the middle of serving traffic.
+        self.flywheel = None
+        self.learner_probe: Optional[Callable[[], Dict[str, Any]]] = None  # wired by serve_policy/fleet
+        fly = dict(cfg.get("flywheel") or {})
+        if fly.get("enabled"):
+            from sheeprl_tpu.serve.flywheel import FlywheelConfigError, TrajectoryLog
+            from sheeprl_tpu.utils.registry import (
+                registered_flywheel_ingest_names,
+                resolve_flywheel_ingest,
+            )
+
+            if resolve_flywheel_ingest(str(policy.name)) is None:
+                raise FlywheelConfigError(
+                    f"serve.flywheel is enabled but the algorithm named '{policy.name}' has no "
+                    f"registered learner-ingest builder. Algorithms with flywheel support: "
+                    f"{', '.join(registered_flywheel_ingest_names())}."
+                )
+            if not fly.get("dir"):
+                raise FlywheelConfigError(
+                    "serve.flywheel.enabled=True needs serve.flywheel.dir (the shared spool "
+                    "directory the learner tails); `serve --flywheel` derives it from the "
+                    "checkpoint dir automatically"
+                )
+            self.flywheel = TrajectoryLog(
+                fly["dir"],
+                policy.obs_spec,
+                int(policy.action_dim),
+                replica=str(fly.get("replica") or f"replica-{os.getpid()}"),
+                block_rows=int(fly.get("block_rows", 256) or 256),
+                queue_blocks=int(fly.get("queue_blocks", 8) or 8),
+                flush_s=float(fly.get("flush_s", 0.25) or 0.25),
+                max_streams=int(fly.get("max_streams", 4096) or 4096),
+            )
+            self.scheduler.flywheel = self.flywheel
+            self.stats._flywheel_fn = self.flywheel.snapshot
 
     # -- lifecycle ----------------------------------------------------------- #
 
@@ -338,6 +407,21 @@ class PolicyServer:
                 "quarantined": [str(p) for p in sorted(self.watcher.quarantined)],
                 "restarts": int(workers.get("serve-ckpt-watcher", {}).get("restarts", 0)),
             }
+        if self.flywheel is not None:
+            fl = self.flywheel.snapshot()
+            out["flywheel"] = {
+                "rows_logged": int(fl["rows_logged"]),
+                "rows_shed": int(fl["rows_shed"]),
+                "feedback_missing": int(fl["feedback_missing"]),
+                "feedback_orphans": int(fl["feedback_orphans"]),
+                "transport_depth": int(fl["transport_depth"]),
+                "rows_spooled": int(fl["rows_spooled"]),
+                "spool_bytes": int(fl["spool_bytes"]),
+                "errors": int(fl["errors"]),
+                "replica": str(self.flywheel.replica),
+            }
+            if self.learner_probe is not None:
+                out["flywheel"]["learner"] = self.learner_probe()
         cache = getattr(self.engine, "cache", None)
         if cache is not None:
             s = cache.snapshot()
@@ -370,6 +454,9 @@ class PolicyServer:
         if self.watcher is not None:
             self.watcher.stop()
         self.scheduler.stop(drain=True)
+        if self.flywheel is not None:
+            # AFTER the drain: the settled stragglers' rows still spool
+            self.flywheel.close()
 
     def __enter__(self) -> "PolicyServer":
         return self.start()
@@ -491,7 +578,25 @@ def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) ->
         from pathlib import Path
 
         watch_dir = str(Path(cfg.checkpoint_path).parent)
+    fly_cfg = dict(serve_cfg.get("flywheel") or {})
+    if fly_cfg.get("enabled"):
+        from pathlib import Path
+
+        # the spool dir defaults to a sibling of the served checkpoint so
+        # `serve --flywheel` is one flag: replicas spool there, the learner
+        # tails it, and the published checkpoints land in the watched dir
+        if not fly_cfg.get("dir"):  # the composed config carries dir: null
+            fly_cfg["dir"] = str(Path(cfg.checkpoint_path).parent / "flywheel")
+        if not fly_cfg.get("replica"):
+            fly_cfg["replica"] = f"replica-{os.getpid()}"
+        serve_cfg["flywheel"] = fly_cfg
     server = PolicyServer(policy, serve_cfg, watch_dir=watch_dir)
+    learner_sup = None
+    if fly_cfg.get("enabled") and fly_cfg.get("learner", True):
+        from sheeprl_tpu.serve.flywheel import LearnerSupervisor
+
+        learner_sup = LearnerSupervisor(cfg, fly_cfg["dir"])
+        server.learner_probe = learner_sup.probe
     max_requests = serve_cfg.get("max_requests")
     log_every_s = float(serve_cfg.get("log_every_s", 10.0) or 10.0)
     drain = threading.Event()
@@ -504,6 +609,11 @@ def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) ->
         last_log = time.perf_counter()
         while not drain.is_set():
             drain.wait(0.2)
+            if learner_sup is not None:
+                # status-mtime heartbeat + the supervisor engine: a wedged
+                # learner is SIGKILLed and respawned from HERE, while the
+                # serve tier above keeps answering untouched
+                learner_sup.tick()
             now = time.perf_counter()
             if now - last_log >= log_every_s:
                 print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}))
@@ -514,6 +624,8 @@ def serve_policy(fabric, cfg: Dict[str, Any], state: Dict[str, Any], builder) ->
         pass
     finally:
         server.stop()  # graceful drain: nothing admitted is dropped
+        if learner_sup is not None:
+            learner_sup.stop()
         restore_handlers()
         print(json.dumps({**server.stats.snapshot(), **server.engine.stats()}))
         if drain.is_set():

@@ -17,6 +17,9 @@ evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
 #: algo name -> ServePolicy builders (the serving tier's analogue of the
 #: evaluation registry; populated by the same ``evaluate`` modules)
 policy_builder_registry: Dict[str, List[Dict[str, Any]]] = {}
+#: algo name -> flywheel learner-ingest builders (the serve→train loop's
+#: learner side; populated by per-algo ``flywheel`` modules)
+flywheel_ingest_registry: Dict[str, List[Dict[str, Any]]] = {}
 
 _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.a2c.a2c",
@@ -41,6 +44,10 @@ _BUILTIN_ALGO_MODULES = [
     "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_finetuning",
     "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration",
     "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning",
+]
+
+_BUILTIN_FLYWHEEL_MODULES = [
+    "sheeprl_tpu.algos.sac.flywheel",
 ]
 
 _BUILTIN_EVAL_MODULES = [
@@ -106,9 +113,22 @@ def register_policy_builder(algorithms: str | List[str]) -> Callable:
     return _register_into(policy_builder_registry, algorithms)
 
 
+def register_flywheel_ingest(algorithms: str | List[str]) -> Callable:
+    """Register ``fn`` as the flywheel learner-ingest builder for
+    ``algorithms``.
+
+    A builder has the signature ``(fabric, cfg, observation_space,
+    action_space, agent_state) -> ingest`` where the ingest object exposes
+    ``row_width``, ``ingest(rows)``, ``grad_steps`` and ``agent_state()``
+    (see :mod:`sheeprl_tpu.serve.flywheel`); the ``run --from-serve``
+    learner resolves it exactly like ``serve`` resolves its policy builder.
+    """
+    return _register_into(flywheel_ingest_registry, algorithms)
+
+
 def _ensure_populated() -> None:
     """Import all builtin algorithm modules so their decorators run."""
-    for mod in _BUILTIN_ALGO_MODULES + _BUILTIN_EVAL_MODULES:
+    for mod in _BUILTIN_ALGO_MODULES + _BUILTIN_EVAL_MODULES + _BUILTIN_FLYWHEEL_MODULES:
         try:
             importlib.import_module(mod)
         except ModuleNotFoundError as e:
@@ -157,6 +177,18 @@ def resolve_evaluation(algo_name: str) -> Optional[Dict[str, Any]]:
 
 def resolve_policy_builder(algo_name: str) -> Optional[Dict[str, Any]]:
     return _resolve_from(policy_builder_registry, algo_name)
+
+
+def resolve_flywheel_ingest(algo_name: str) -> Optional[Dict[str, Any]]:
+    return _resolve_from(flywheel_ingest_registry, algo_name)
+
+
+def registered_flywheel_ingest_names() -> List[str]:
+    """Every algorithm name with a registered flywheel learner-ingest — the
+    ``FlywheelConfigError`` enumerates these so the operator sees which
+    algorithms CAN close the serve→train loop."""
+    _ensure_populated()
+    return sorted(flywheel_ingest_registry)
 
 
 def registered_policy_builder_names() -> List[str]:

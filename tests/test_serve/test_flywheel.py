@@ -1,0 +1,609 @@
+"""graft-flywheel: the serve→train production loop.
+
+Transport semantics (pairing, shedding, torn tails, attribution), the
+backward-compat guard for feedback-less clients, the typed config error for
+unsupported algorithms, the fleet-path multi-replica spool accounting, the
+SAC learner-ingest actually learning from spooled rows, and the learner
+supervision lease (SIGSTOP → missed beats → SIGKILL + respawn) — all
+in-process and deterministic. The real-CLI publish/adopt loop and the
+isolation chaos drill live in ``test_flywheel_chaos.py``.
+"""
+
+import json
+import os
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.flywheel import (
+    FRAME_MAGIC,
+    _FRAME,
+    FlywheelConfigError,
+    SpoolReader,
+    TrajectoryLog,
+    flywheel_row_width,
+    read_learner_status,
+    split_rows,
+    write_learner_status,
+)
+from sheeprl_tpu.serve.server import PolicyServer, request_over_socket
+
+OBS_SPEC = {"x": ((2,), np.float32)}  # matches the toy policy
+
+
+def _log(tmp_path, **kw):
+    kw.setdefault("replica", "r0")
+    return TrajectoryLog(tmp_path, OBS_SPEC, 3, **kw)
+
+
+def _obs(*rows):
+    return {"x": np.asarray(rows, np.float32)}
+
+
+# -- transport: pairing, spooling, round trip -------------------------------- #
+
+
+def test_feedback_pairs_previous_action_and_round_trips(tmp_path):
+    """reward/done grade the PREVIOUS action on the stream; the spooled row
+    is (prev_obs, prev_action, reward, done, next_obs=current obs), and the
+    reader hands back exactly what was logged."""
+    log = _log(tmp_path, block_rows=4, flush_s=0.01)
+    a0 = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+    log.observe(_obs([1.0, 2.0]), 1, a0, None, None, "s")
+    log.observe(_obs([3.0, 4.0]), 1, [[4.0, 5.0, 6.0]], 0.5, 1.0, "s")
+    log.close()
+    assert log.counters["rows_logged"] == 1
+    assert log.counters["rows_spooled"] == 1
+    reader = SpoolReader(tmp_path, log.row_width)
+    batches = reader.poll()
+    assert len(batches) == 1
+    replica, rows = batches[0]
+    assert replica == "r0"
+    cols = split_rows(rows, 2, 3)
+    assert np.allclose(cols["observations"], [[1.0, 2.0]])
+    assert np.allclose(cols["actions"], a0)
+    assert np.allclose(cols["rewards"], [[0.5]])
+    assert np.allclose(cols["terminated"], [[1.0]])
+    assert np.allclose(cols["next_observations"], [[3.0, 4.0]])
+    assert reader.consumed_rows == {"r0": 1}
+
+
+def test_streams_pair_independently(tmp_path):
+    """Two interleaved streams never cross-pair: each transition's action
+    comes from its own stream's previous request."""
+    log = _log(tmp_path, flush_s=0.01)
+    log.observe(_obs([1.0, 0.0]), 1, [[1.0, 1.0, 1.0]], None, None, "a")
+    log.observe(_obs([2.0, 0.0]), 1, [[2.0, 2.0, 2.0]], None, None, "b")
+    log.observe(_obs([3.0, 0.0]), 1, [[3.0, 3.0, 3.0]], 1.0, 0.0, "b")
+    log.observe(_obs([4.0, 0.0]), 1, [[4.0, 4.0, 4.0]], 2.0, 0.0, "a")
+    log.close()
+    rows = np.concatenate([r for _, r in SpoolReader(tmp_path, log.row_width).poll()])
+    cols = split_rows(rows, 2, 3)
+    by_reward = {float(r): i for i, r in enumerate(cols["rewards"][:, 0])}
+    assert np.allclose(cols["actions"][by_reward[1.0]], [2.0, 2.0, 2.0])  # stream b
+    assert np.allclose(cols["actions"][by_reward[2.0]], [1.0, 1.0, 1.0])  # stream a
+
+
+def test_feedback_missing_and_orphans_counted(tmp_path):
+    log = _log(tmp_path)
+    # feedback with nothing pending: orphan
+    log.observe(_obs([0.0, 0.0]), 1, [[0.0] * 3], 1.0, 0.0, "s")
+    assert log.counters["feedback_orphans"] == 1
+    # two feedback-less requests: the first pending action is never graded
+    log.observe(_obs([0.0, 0.0]), 1, [[0.0] * 3], None, None, "s")
+    assert log.counters["feedback_missing"] == 1
+    # row-count mismatch cannot pair either
+    log.observe(_obs([0.0, 0.0], [1.0, 1.0]), 2, [[0.0] * 3] * 2, [1.0, 1.0], None, "s")
+    assert log.counters["feedback_orphans"] == 3
+    assert log.counters["rows_logged"] == 0
+    log.close()
+
+
+def test_max_streams_lru_eviction_counts_missing(tmp_path):
+    log = _log(tmp_path, max_streams=2)
+    for i in range(4):
+        log.observe(_obs([0.0, 0.0]), 1, [[0.0] * 3], None, None, f"s{i}")
+    assert log.counters["feedback_missing"] == 2  # s0, s1 evicted ungraded
+    snap = log.snapshot()
+    assert snap["pending_streams"] == 2
+    log.close()
+
+
+def test_full_transport_sheds_instead_of_blocking(tmp_path, monkeypatch):
+    """With the writer wedged (the slow-disk / SIGSTOP shape), staged blocks
+    past the ring are SHED: observe keeps returning immediately and counts
+    what it dropped."""
+    import threading
+
+    log = _log(tmp_path, block_rows=2, queue_blocks=2, flush_s=3600.0)
+    release = threading.Event()
+    monkeypatch.setattr(log, "_write_frame", lambda rows: release.wait(30.0))
+    while not log._q.full():  # pre-fill the transport out of the free ring
+        log._q.put_nowait((log._free.popleft(), 2))
+    t0 = time.monotonic()
+    for i in range(10):
+        log.observe(_obs([float(i), 0.0]), 1, [[0.0] * 3], 1.0, 0.0, "s")
+    assert time.monotonic() - t0 < 1.0  # never blocked on the wedged writer
+    assert log.counters["rows_shed"] >= 2
+    assert log.counters["blocks_shed"] >= 1
+    release.set()
+    log.close(abandon=True)
+
+
+def test_observe_never_raises(tmp_path):
+    log = _log(tmp_path)
+    log.observe({"wrong": "garbage"}, 1, None, 1.0, None, "s")  # type: ignore[arg-type]
+    assert log.counters["errors"] == 1
+    log.close()
+
+
+def test_partial_block_flushes_within_flush_s(tmp_path):
+    """A quiet tail of traffic (less than a block) still reaches disk within
+    ~flush_s — the learner must not wait for a full block."""
+    log = _log(tmp_path, block_rows=256, flush_s=0.05)
+    log.observe(_obs([1.0, 2.0]), 1, [[1.0] * 3], None, None, "s")
+    log.observe(_obs([3.0, 4.0]), 1, [[2.0] * 3], 1.0, 0.0, "s")
+    reader = SpoolReader(tmp_path, log.row_width)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if reader.poll():
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("partial block never flushed")
+    log.close()
+
+
+# -- reader: torn tails, corruption, generations ----------------------------- #
+
+
+def test_torn_tail_waited_out_then_parsed(tmp_path):
+    width = flywheel_row_width(2, 3)
+    header = json.dumps(
+        {"magic": "sheeprl-flywheel/1", "replica": "r0", "row_width": width, "obs_dim": 2, "act_dim": 3}
+    )
+    payload = np.arange(width, dtype=np.float32).tobytes()
+    frame = _FRAME.pack(FRAME_MAGIC, 1, len(payload)) + payload
+    path = tmp_path / "r0.1.spool"
+    path.write_bytes((header + "\n").encode() + frame[: len(frame) // 2])
+    reader = SpoolReader(tmp_path, width)
+    assert reader.poll() == []  # torn: wait, do not advance
+    assert reader.pending_bytes() > 0
+    path.write_bytes((header + "\n").encode() + frame)
+    batches = reader.poll()
+    assert len(batches) == 1 and len(batches[0][1]) == 1
+    assert reader.total_consumed == 1
+
+
+def test_corrupt_frame_quarantines_file(tmp_path):
+    width = flywheel_row_width(2, 3)
+    header = json.dumps({"magic": "sheeprl-flywheel/1", "replica": "bad", "row_width": width})
+    junk = struct.pack("<III", 0xDEADBEEF, 1, 4) + b"\x00" * 4
+    (tmp_path / "bad.1.spool").write_bytes((header + "\n").encode() + junk)
+    reader = SpoolReader(tmp_path, width)
+    assert reader.poll() == []
+    assert reader.corrupt_files == 1
+    assert reader.poll() == []  # stays quarantined
+
+
+def test_new_generation_gets_fresh_spool_file(tmp_path):
+    """Same replica name re-opened (a respawn in-process) never appends to
+    the old file — each generation is its own spool."""
+    a = _log(tmp_path)
+    b = _log(tmp_path)
+    assert a.path != b.path
+    a.close()
+    b.close()
+
+
+# -- learner status ----------------------------------------------------------- #
+
+
+def test_learner_status_round_trip_and_staleness(tmp_path):
+    assert read_learner_status(tmp_path) is None
+    write_learner_status(tmp_path, {"consumed_rows": 7, "grad_steps": 3})
+    status = read_learner_status(tmp_path)
+    assert status["consumed_rows"] == 7
+    assert status["staleness_s"] >= 0.0
+
+
+# -- backward compat: the feedback-less world keeps working ------------------ #
+
+
+def test_feedbackless_client_serves_normally_rows_counted_missing(sac_policy, tmp_path):
+    """A client that never heard of the flywheel serves exactly as before on
+    a flywheel server — no errors, no latency coupling, its ungradeable rows
+    counted ``feedback_missing`` and nothing spooled for them."""
+    cfg = {
+        "buckets": [1, 4],
+        "max_wait_ms": 1.0,
+        "port": None,
+        "flywheel": {"enabled": True, "dir": str(tmp_path / "fly"), "replica": "r0", "flush_s": 0.01},
+    }
+    rng = np.random.default_rng(0)
+    with PolicyServer(sac_policy, cfg) as server:
+        for _ in range(3):
+            actions, version = server.client.act({"state": rng.standard_normal(3).astype(np.float32)}, n=1)
+            assert actions.shape == (1, 1) and version == 0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and server.flywheel.counters["feedback_missing"] < 2:
+            time.sleep(0.01)
+        snap = server.flywheel.snapshot()
+    assert snap["rows_logged"] == 0
+    assert snap["feedback_missing"] == 2  # 3 requests -> 2 ungraded predecessors
+    assert snap["errors"] == 0
+
+
+def test_unknown_obs_keys_still_rejected_with_named_error(sac_policy, tmp_path):
+    """The existing protocol guard survives the flywheel fields: a request
+    with wrong observation keys still gets the named ValueError over the
+    wire, and the connection keeps serving feedback requests after it."""
+    cfg = {
+        "buckets": [1, 4],
+        "max_wait_ms": 1.0,
+        "port": 0,
+        "flywheel": {"enabled": True, "dir": str(tmp_path / "fly"), "replica": "r0"},
+    }
+    with PolicyServer(sac_policy, cfg) as server:
+        addr = server.address
+        with socket.create_connection(addr, timeout=10.0) as sock:
+            f = sock.makefile("rw")
+            f.write(json.dumps({"obs": {"bogus": [1.0]}, "n": 1, "reward": 1.0}) + "\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert "error" in resp and "state" in resp["error"]  # the named per-request rejection
+            f.write(json.dumps({"obs": {"state": [0.1, 0.2, 0.3]}, "n": 1, "reward": 0.5, "done": 0.0}) + "\n")
+            f.flush()
+            assert "actions" in json.loads(f.readline())
+        # the scheduler's own spec guard is unchanged by the feedback fields:
+        # mismatched prepared keys get the SAME named ValueError as before
+        with pytest.raises(ValueError, match="observation keys"):
+            server.scheduler.submit({"bogus": np.zeros((1, 3), np.float32)}, reward=1.0, done=0.0, stream="s")
+
+
+def test_socket_feedback_pairs_per_connection(sac_policy, tmp_path):
+    """Session-less socket clients pair feedback per CONNECTION: two
+    connections interleaving never cross-grade each other's actions."""
+    fly_dir = tmp_path / "fly"
+    cfg = {
+        "buckets": [1, 4],
+        "max_wait_ms": 1.0,
+        "port": 0,
+        "flywheel": {"enabled": True, "dir": str(fly_dir), "replica": "r0", "flush_s": 0.01},
+    }
+    with PolicyServer(sac_policy, cfg) as server:
+        addr = server.address
+        obs = [0.1, 0.2, 0.3]
+        conns = [socket.create_connection(addr, timeout=10.0) for _ in range(2)]
+        files = [c.makefile("rw") for c in conns]
+        for i, f in enumerate(files):  # first request on each: nothing pending
+            f.write(json.dumps({"obs": {"state": obs}, "n": 1}) + "\n")
+            f.flush()
+            assert "actions" in json.loads(f.readline())
+        for i, f in enumerate(files):  # second request grades the first
+            f.write(json.dumps({"obs": {"state": obs}, "n": 1, "reward": float(i + 1), "done": 0.0}) + "\n")
+            f.flush()
+            assert "actions" in json.loads(f.readline())
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and server.flywheel.counters["rows_logged"] < 2:
+            time.sleep(0.01)
+        snap = server.flywheel.snapshot()
+        for c in conns:
+            c.close()
+    assert snap["rows_logged"] == 2
+    assert snap["feedback_orphans"] == 0
+    rows = np.concatenate(
+        [r for _, r in SpoolReader(fly_dir, flywheel_row_width(3, 1)).poll()]
+    )
+    assert sorted(split_rows(rows, 3, 1)["rewards"][:, 0].tolist()) == [1.0, 2.0]
+
+
+def test_flywheel_stats_and_health_block(sac_policy, tmp_path):
+    cfg = {
+        "buckets": [1, 4],
+        "max_wait_ms": 1.0,
+        "port": None,
+        "flywheel": {"enabled": True, "dir": str(tmp_path / "fly"), "replica": "r7", "flush_s": 0.01},
+    }
+    with PolicyServer(sac_policy, cfg) as server:
+        obs = {"state": np.asarray([0.1, 0.2, 0.3], np.float32)}
+        server.client.act(obs, n=1)
+        server.client.act(obs, n=1, reward=1.0, done=0.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and server.flywheel.counters["rows_spooled"] < 1:
+            time.sleep(0.01)
+        stats = server.stats.snapshot()
+        health = server.health()
+    assert stats["Serve/flywheel_rows"] == 1
+    assert stats["Serve/flywheel_shed"] == 0
+    assert stats["Serve/flywheel_spooled"] == 1
+    assert stats["Serve/flywheel_errors"] == 0
+    fl = health["flywheel"]
+    assert fl["replica"] == "r7"
+    assert fl["rows_logged"] == 1 and fl["rows_shed"] == 0 and fl["errors"] == 0
+    assert "learner" not in fl  # no learner wired at the PolicyServer layer
+
+
+def test_flywheel_off_means_zero_surface(toy_policy):
+    with PolicyServer(toy_policy, {"buckets": [1, 4], "max_wait_ms": 1.0, "port": None}) as server:
+        server.client.act({"x": np.ones(2, np.float32)}, n=1)
+        assert server.flywheel is None
+        health = server.health()
+        stats = server.stats.snapshot()
+    assert "flywheel" not in health
+    assert not any(k.startswith("Serve/flywheel") for k in stats)
+
+
+# -- the typed config error --------------------------------------------------- #
+
+
+def test_flywheel_config_error_for_unsupported_algo(toy_policy, tmp_path):
+    """An algo with no registered learner-ingest builder fails FAST at build
+    time (before any socket binds), naming the algos that do support it."""
+    with pytest.raises(FlywheelConfigError) as exc:
+        PolicyServer(
+            toy_policy,
+            {"buckets": [1], "port": None, "flywheel": {"enabled": True, "dir": str(tmp_path)}},
+        )
+    msg = str(exc.value)
+    assert "'toy'" in msg
+    assert "sac" in msg  # the supported list is enumerated
+
+
+def test_flywheel_config_error_without_dir(sac_policy):
+    with pytest.raises(FlywheelConfigError, match="serve.flywheel.dir"):
+        PolicyServer(sac_policy, {"buckets": [1], "port": None, "flywheel": {"enabled": True}})
+
+
+# -- fleet path: N replicas, one spool dir, one accounting -------------------- #
+
+
+def test_fleet_replicas_attributed_and_kill_loses_only_inflight(tmp_path):
+    """Three replicas stream into one dir; the reader attributes rows per
+    replica. One replica 'dies' (abandon: staged + queued rows dropped, the
+    SIGKILL shape) — the learner loses ONLY that replica's in-flight rows,
+    bounded by the transport ring, and the survivors' accounting is exact."""
+    logs = {f"replica-{i}": _log(tmp_path, replica=f"replica-{i}", block_rows=4, flush_s=0.01) for i in range(3)}
+    sent = {name: 0 for name in logs}
+    for round_i in range(10):
+        for name, log in logs.items():
+            log.observe(_obs([float(round_i), 0.0]), 1, [[0.0] * 3], float(round_i), 0.0, "s")
+            if round_i > 0:
+                sent[name] += 1  # first request per stream only opens the pairing
+    # replica-1 is killed mid-run: staged + queued rows are gone
+    logs["replica-1"].close(abandon=True)
+    logs["replica-0"].close()
+    logs["replica-2"].close()
+    reader = SpoolReader(tmp_path, logs["replica-0"].row_width)
+    reader.poll()
+    assert reader.consumed_rows.get("replica-0", 0) == sent["replica-0"]
+    assert reader.consumed_rows.get("replica-2", 0) == sent["replica-2"]
+    lost = sent["replica-1"] - reader.consumed_rows.get("replica-1", 0)
+    assert lost >= 0
+    # the loss is COUNTED on the replica side and bounded by the ring
+    c = logs["replica-1"].counters
+    assert c["rows_logged"] - c["rows_spooled"] - c["rows_shed"] == lost
+    assert reader.total_consumed == sum(reader.consumed_rows.values())
+
+
+def test_replica_command_forwards_flywheel_identity():
+    """Fleet replicas get the shared dir, their fleet name as spool identity,
+    and learner=False — the fleet parent owns the single learner."""
+    from sheeprl_tpu.config import dotdict
+    from sheeprl_tpu.serve.fleet import replica_command
+
+    cfg = dotdict(
+        {
+            "serve": {"flywheel": {"enabled": True, "dir": "/tmp/fly", "block_rows": 64}},
+            "fabric": {"accelerator": "cpu"},
+        }
+    )
+    cmd = replica_command(cfg, "/ckpt/ckpt_2_0.ckpt", "127.0.0.1", 1234, name="replica-1")
+    assert "serve.flywheel.enabled=True" in cmd
+    assert "serve.flywheel.dir=/tmp/fly" in cmd
+    assert "serve.flywheel.replica=replica-1" in cmd
+    assert "serve.flywheel.learner=False" in cmd
+    assert "serve.flywheel.block_rows=64" in cmd
+    # without the flywheel nothing leaks into the replica invocation
+    cmd = replica_command(dotdict({"serve": {}, "fabric": {}}), "/ckpt/c.ckpt", "127.0.0.1", 1)
+    assert not any("flywheel" in c for c in cmd)
+
+
+# -- the SAC learner-ingest ---------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def sac_ingest_setup():
+    import gymnasium as gym
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel import Fabric
+    from sheeprl_tpu.utils.registry import get_entrypoint, resolve_flywheel_ingest
+
+    cfg = compose(
+        [
+            "exp=sac",
+            "env=gym",
+            "env.id=Pendulum-v1",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=16",
+        ]
+    )
+    cfg["serve"] = {
+        "flywheel": {
+            "ingest_rows": 4,
+            "grad_max": 2,
+            "replay_ratio": 1.0,
+            "learning_starts_rows": 8,
+            "buffer_size": 64,
+        }
+    }
+    fabric = Fabric(devices=1, accelerator="cpu")
+    fabric.seed_everything(3)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32)})
+    act_space = gym.spaces.Box(-2.0, 2.0, (1,), np.float32)
+    builder = get_entrypoint(resolve_flywheel_ingest("sac"))
+    return builder(fabric, cfg, obs_space, act_space, None)
+
+
+def test_sac_ingest_learns_from_spooled_rows(sac_ingest_setup):
+    """Spool-shaped rows drive real grad steps through the resident train
+    step: updates start only past learning_starts_rows, grants follow the
+    replay ratio, and the published params actually move."""
+    import jax
+
+    ingest = sac_ingest_setup
+    assert ingest.row_width == flywheel_row_width(3, 1)
+    # copy=True: the resident fn DONATES params, so a zero-copy view of the
+    # pre-update buffer would silently alias the post-update values
+    before = jax.tree.map(lambda x: np.array(x, copy=True), ingest.params["actor"])
+    rng = np.random.default_rng(0)
+
+    def batch(m):
+        rows = rng.standard_normal((m, ingest.row_width)).astype(np.float32)
+        rows[:, 4] = 0.0  # terminated column: mid-episode transitions
+        return rows
+
+    ingest.ingest(batch(4))
+    assert ingest.consumed == 4
+    assert ingest.grad_steps == 0  # below learning_starts_rows
+    ingest.ingest(batch(8))
+    assert ingest.consumed == 12
+    assert ingest.grad_steps > 0
+    after = jax.tree.map(np.asarray, ingest.params["actor"])
+    changed = jax.tree_util.tree_leaves(
+        jax.tree.map(lambda a, b: not np.allclose(a, b), before, after)
+    )
+    assert any(changed), "actor params did not move after production-row grad steps"
+
+
+def test_sac_ingest_agent_state_matches_checkpoint_tree(sac_ingest_setup):
+    """The publishable tree has the checkpoint's ``state['agent']`` keys —
+    the serving tier's ``params_from_state`` must hot-swap it unchanged."""
+    tree = sac_ingest_setup.agent_state()
+    assert {"actor", "critic", "target_critic", "log_alpha"} <= set(tree)
+
+
+# -- learner supervision (in-process, fake learner) --------------------------- #
+
+
+def _fake_learner_cmd(status_dir, beat: bool):
+    """A stand-in learner: beats learner_status.json like the real one."""
+    import sys
+
+    body = (
+        "import json,os,sys,time\n"
+        f"d={str(status_dir)!r}\n"
+        "i=0\n"
+        "while True:\n"
+        f"    beat={beat}\n"
+        "    if beat:\n"
+        "        tmp=os.path.join(d,'learner_status.json.tmp')\n"
+        "        json.dump({'consumed_rows':i,'grad_steps':i,'published_step':-1},open(tmp,'w'))\n"
+        "        os.replace(tmp,os.path.join(d,'learner_status.json'))\n"
+        "    i+=1\n"
+        "    time.sleep(0.05)\n"
+    )
+    return [sys.executable, "-c", body]
+
+
+def test_learner_lease_expiry_sigkills_and_respawns(tmp_path, monkeypatch):
+    """The supervision ladder end-to-end against a real (fake) subprocess:
+    SIGSTOP stops the status beats, the lease expires, the learner is
+    SIGKILLed + respawned (counted as a hang), and probe() reports it."""
+    import sheeprl_tpu.serve.flywheel as flywheel_mod
+    from sheeprl_tpu.config import dotdict
+    from sheeprl_tpu.serve.flywheel import LearnerSupervisor
+
+    monkeypatch.setattr(flywheel_mod, "learner_command", lambda cfg, d: _fake_learner_cmd(d, beat=True))
+    cfg = dotdict(
+        {
+            "serve": {"flywheel": {"lease_s": 0.6, "grace_s": 2.0, "supervisor": {"max_restarts": 3, "backoff": 0.1}}},
+            "checkpoint_path": "unused",
+            "fabric": {"accelerator": "cpu"},
+        }
+    )
+    sup = LearnerSupervisor(cfg, tmp_path)
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and sup.probe()["consumed_rows"] == 0:
+            sup.tick()
+            time.sleep(0.05)
+        assert sup.probe()["alive"]
+        pid = sup.handle.pid()
+        os.kill(pid, 19)  # SIGSTOP: beats stop, serving would carry on
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and sup.probe()["hangs"] == 0:
+            sup.tick()
+            time.sleep(0.05)
+        probe = sup.probe()
+        assert probe["hangs"] == 1, probe
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            sup.tick()
+            probe = sup.probe()
+            if probe["alive"] and sup.handle.pid() != pid:
+                break
+            time.sleep(0.05)
+        assert sup.handle.pid() != pid, "learner was not respawned after the SIGKILL"
+        assert probe["restarts"] >= 1
+        assert probe["fatal"] is None
+    finally:
+        sup.stop(grace_s=2.0)
+    assert not sup.handle.is_alive()
+
+
+def test_learner_chaos_handlers_registered_and_cleared(tmp_path, monkeypatch):
+    """kill-learner / hang-learner dispatch to the CURRENT learner handle
+    via the inject registry; stop() clears them."""
+    import sheeprl_tpu.serve.flywheel as flywheel_mod
+    from sheeprl_tpu.config import dotdict
+    from sheeprl_tpu.fault import inject
+    from sheeprl_tpu.serve.flywheel import LearnerSupervisor
+
+    monkeypatch.setattr(flywheel_mod, "learner_command", lambda cfg, d: _fake_learner_cmd(d, beat=True))
+    cfg = dotdict(
+        {
+            "serve": {"flywheel": {"lease_s": 5.0, "grace_s": 5.0}},
+            "checkpoint_path": "unused",
+            "fabric": {"accelerator": "cpu"},
+        }
+    )
+    inject.reset()
+    sup = LearnerSupervisor(cfg, tmp_path)
+    try:
+        pid = sup.handle.pid()
+        inject._learner_chaos["kill"]()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and sup.handle.is_alive():
+            time.sleep(0.05)
+        assert not sup.handle.is_alive() or sup.handle.pid() != pid
+    finally:
+        sup.stop(grace_s=2.0)
+    assert inject._learner_chaos["kill"] is None  # cleared by stop()
+
+
+def test_learner_command_round_trip():
+    from sheeprl_tpu.config import dotdict
+    from sheeprl_tpu.serve.flywheel import learner_command
+
+    cfg = dotdict(
+        {
+            "checkpoint_path": "/ckpt/ckpt_2_0.ckpt",
+            "seed": 5,
+            "fabric": {"accelerator": "cpu"},
+            "serve": {"flywheel": {"publish_rows": 16, "poll_s": 0.1}},
+        }
+    )
+    cmd = learner_command(cfg, "/tmp/fly")
+    assert "--from-serve" in cmd and "/tmp/fly" in cmd
+    assert "checkpoint_path=/ckpt/ckpt_2_0.ckpt" in cmd
+    assert "serve.flywheel.publish_rows=16" in cmd
+    assert "serve.flywheel.poll_s=0.1" in cmd
+    assert "seed=5" in cmd
