@@ -14,6 +14,19 @@ correctness):
     disable=GLxxx`` suppressions and a checked-in baseline so pre-existing
     findings don't block CI. Run it as ``python -m sheeprl_tpu.analysis``.
 
+:mod:`sheeprl_tpu.analysis.jit` (+ ``jitgraph``)
+    The traced tier as a CORPUS: a per-repo tracedness model whose roots are
+    every ``@jax.jit``/``pjit``/``shard_map``/``pallas_call``-wrapped
+    function plus the registered graft-audit programs, closed
+    interprocedurally over calls that pass traced values — then proved
+    against purity/trace-hygiene rules (GJ001-GJ005: alias-aware PRNG key
+    dataflow incl. stale scan-carry keys, host syncs in traced code, Python
+    control flow on tracer-derived booleans, trace-time constant baking over
+    the 64 KiB budget + jit-in-loop, unhashable/loop-varying static
+    arguments). Conservative resolution: an unresolvable reference never
+    produces a guessed finding. Run it as ``python -m sheeprl_tpu.analysis
+    jit``.
+
 :mod:`sheeprl_tpu.analysis.audit` (+ ``programs``, ``budgets``, ``hlo``)
     The compiled-program tier: every registered hot-path program AOT-lowered
     with abstract inputs on a configurable mesh (no execution) and checked
@@ -48,8 +61,11 @@ correctness):
     Anakin, arXiv:2104.06272) attributes its throughput to exactly these
     invariants holding in the steady state.
 
-``python -m sheeprl_tpu.analysis all`` runs lint + sync + audit with one
-merged exit code and a single ``--format=github`` annotation stream.
+``python -m sheeprl_tpu.analysis all`` runs lint + jit + sync + audit with
+one merged exit code, merged ``--list-rules``/``--select`` across every
+tier's catalog, and a single ``--format=github`` annotation stream. All AST
+tiers share the suppression machinery, including stale-suppression
+detection (``--strict-suppressions``).
 """
 
 from sheeprl_tpu.analysis.lint import Finding, RULES, analyze_paths, analyze_source

@@ -1,8 +1,11 @@
-"""``python -m sheeprl_tpu.analysis`` — the graft-lint/audit/sync CLI.
+"""``python -m sheeprl_tpu.analysis`` — the graft-lint/jit/sync/audit CLI.
 
 Subcommands, one exit-code contract (CI relies on it):
 
 - ``lint`` (the default — bare paths keep working): AST rules GL001-GL008;
+- ``jit``: purity & trace-hygiene analysis of the traced tier — corpus-wide
+  tracedness model, PRNG key dataflow, host-sync-in-jit, constant baking,
+  retrace hazards (rules GJ001-GJ005);
 - ``audit``: AOT-lower every registered hot-path program on a virtual mesh
   and check donation aliasing, sharding declarations, dtype policy, baked
   constants, and the checked-in budget manifest (rules AUD001-AUD005);
@@ -10,14 +13,18 @@ Subcommands, one exit-code contract (CI relies on it):
   lockset model, lock-order graph, blocking-under-lock (rules GS001-GS005);
 - ``sync-validate``: judge a runtime lock-sanitizer dump
   (``SHEEPRL_TPU_SYNC_DUMP``) — order cycles, inversions, over-budget holds;
-- ``all``: lint + sync + audit with one merged exit code and a single
-  ``--format=github`` annotation stream (the CI front door);
+- ``all``: lint + jit + sync + audit with one merged exit code and a single
+  ``--format=github`` annotation stream (the CI front door); its
+  ``--list-rules`` prints EVERY tier's catalog, and ``--select/--ignore``
+  accept any rule from the merged catalog;
 - ``tracecheck``: validate a runtime trace-event dump
   (``SHEEPRL_TPU_TRACECHECK_DUMP``) — post-warmup retraces are findings.
 
 Exit codes: ``0`` clean, ``1`` at least one finding, ``2`` usage/internal
 error. Formats: ``text``, ``json``, ``github`` (workflow annotations that
-land inline on the PR diff).
+land inline on the PR diff). Every AST tier takes ``--strict-suppressions``:
+stale ``# graft-*: disable`` directives (the rule no longer fires there) are
+warnings by default, findings (exit 1) under the flag.
 
 ``audit`` re-executes itself in a worker subprocess with
 ``JAX_PLATFORMS=cpu`` and ``--xla_force_host_platform_device_count`` set
@@ -78,6 +85,24 @@ def _emit_github(findings: List[Finding], out, tool: str = "graft-lint") -> None
         )
 
 
+def _merge_stale(
+    findings: List[Finding], stale: List[Finding], strict: bool, tool: str
+) -> List[Finding]:
+    """Stale-suppression handling shared by the AST tiers: warn-level on
+    stderr by default so fixed code surfaces its dead directives without
+    breaking the build; ``--strict-suppressions`` merges them into the
+    findings stream (exit 1) for the CI lane that keeps the tree honest."""
+    if not stale:
+        return findings
+    if strict:
+        merged = findings + stale
+        merged.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return merged
+    for f in stale:
+        print(f"{tool}: warning: {f.render()}", file=sys.stderr)
+    return findings
+
+
 def _emit_json(findings: List[Finding], baselined: int, out, tool: str = "graft-lint", rules=None) -> None:
     payload = {
         "tool": tool,
@@ -121,6 +146,11 @@ def lint_main(argv: List[str]) -> int:
     parser.add_argument("--select", help="comma-separated rules to run (default: all)")
     parser.add_argument("--ignore", help="comma-separated rules to skip")
     parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    parser.add_argument(
+        "--strict-suppressions",
+        action="store_true",
+        help="stale `# graft-lint: disable` directives become findings (exit 1) instead of warnings",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -135,8 +165,9 @@ def lint_main(argv: List[str]) -> int:
         print(f"graft-lint: {e}", file=sys.stderr)
         return 2
 
+    stale: List[Finding] = []
     try:
-        findings = analyze_paths(args.paths, select=select, ignore=ignore)
+        findings = analyze_paths(args.paths, select=select, ignore=ignore, stale_out=stale)
     except Exception as e:  # pragma: no cover - internal error contract
         print(f"graft-lint: internal error: {e}", file=sys.stderr)
         return 2
@@ -163,6 +194,10 @@ def lint_main(argv: List[str]) -> int:
         before = len(findings)
         findings = apply_baseline(findings, baseline)
         baselined = before - len(findings)
+
+    # stale suppressions join AFTER the baseline: they describe directives,
+    # not code, and must never consume a baseline slot
+    findings = _merge_stale(findings, stale, args.strict_suppressions, "graft-lint")
 
     if args.format == "json":
         _emit_json(findings, baselined, sys.stdout)
@@ -451,6 +486,11 @@ def sync_main(argv: List[str]) -> int:
     parser.add_argument("--select", help="comma-separated rules to run (default: all)")
     parser.add_argument("--ignore", help="comma-separated rules to skip")
     parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    parser.add_argument(
+        "--strict-suppressions",
+        action="store_true",
+        help="stale `# graft-sync: disable` directives become findings (exit 1) instead of warnings",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -465,11 +505,13 @@ def sync_main(argv: List[str]) -> int:
         print(f"graft-sync: {e}", file=sys.stderr)
         return 2
 
+    stale: List[Finding] = []
     try:
-        findings = analyze_sync_paths(args.paths, select=select, ignore=ignore)
+        findings = analyze_sync_paths(args.paths, select=select, ignore=ignore, stale_out=stale)
     except Exception as e:  # pragma: no cover - internal error contract
         print(f"graft-sync: internal error: {e}", file=sys.stderr)
         return 2
+    findings = _merge_stale(findings, stale, args.strict_suppressions, "graft-sync")
 
     if args.format == "json":
         _emit_json(findings, 0, sys.stdout, tool="graft-sync", rules=SYNC_RULES)
@@ -478,6 +520,63 @@ def sync_main(argv: List[str]) -> int:
     else:
         _emit_text(findings, sys.stdout)
     print(f"graft-sync: {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+# --------------------------------------------------------------------------- #
+# jit subcommand (graft-jit: traced-tier purity & hygiene, rules GJ001-GJ005)
+# --------------------------------------------------------------------------- #
+
+
+def jit_main(argv: List[str]) -> int:
+    from sheeprl_tpu.analysis.jit import JIT_RULES, analyze_jit_paths
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis jit",
+        description=(
+            "graft-jit: static purity & trace-hygiene analysis over the traced/JAX tier "
+            "(GJ001-GJ005 — PRNG key dataflow, host-sync-in-jit, constant baking, retrace hazards)."
+        ),
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/dirs to analyze")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
+    parser.add_argument("--select", help="comma-separated rules to run (default: all)")
+    parser.add_argument("--ignore", help="comma-separated rules to skip")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    parser.add_argument(
+        "--strict-suppressions",
+        action="store_true",
+        help="stale `# graft-jit: disable` directives become findings (exit 1) instead of warnings",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(JIT_RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    try:
+        select = _parse_rules(args.select, catalog=JIT_RULES)
+        ignore = _parse_rules(args.ignore, catalog=JIT_RULES)
+    except SystemExit2 as e:
+        print(f"graft-jit: {e}", file=sys.stderr)
+        return 2
+
+    stale: List[Finding] = []
+    try:
+        findings = analyze_jit_paths(args.paths, select=select, ignore=ignore, stale_out=stale)
+    except Exception as e:  # pragma: no cover - internal error contract
+        print(f"graft-jit: internal error: {e}", file=sys.stderr)
+        return 2
+    findings = _merge_stale(findings, stale, args.strict_suppressions, "graft-jit")
+
+    if args.format == "json":
+        _emit_json(findings, 0, sys.stdout, tool="graft-jit", rules=JIT_RULES)
+    elif args.format == "github":
+        _emit_github(findings, sys.stdout, tool="graft-jit")
+    else:
+        _emit_text(findings, sys.stdout)
+    print(f"graft-jit: {len(findings)} finding(s)", file=sys.stderr)
     return 1 if findings else 0
 
 
@@ -513,16 +612,34 @@ def sync_validate_main(argv: List[str]) -> int:
 
 
 # --------------------------------------------------------------------------- #
-# all subcommand: lint + sync + audit, one merged exit code / annotation stream
+# all subcommand: lint + jit + sync + audit, one exit code / annotation stream
 # --------------------------------------------------------------------------- #
+
+
+def _merged_catalogs() -> List:
+    """``(tool, catalog)`` for every tier, light imports only — AUDIT_RULES
+    lives in a module whose top level never touches JAX, so listing the full
+    catalog costs no compile machinery."""
+    from sheeprl_tpu.analysis.audit import AUDIT_RULES
+    from sheeprl_tpu.analysis.jit import JIT_RULES
+    from sheeprl_tpu.analysis.lint import SUPPRESSION_RULE
+    from sheeprl_tpu.analysis.sync import SYNC_RULES
+
+    return [
+        ("graft-lint", {**RULES, SUPPRESSION_RULE: "stale suppression directive (see --strict-suppressions)"}),
+        ("graft-jit", JIT_RULES),
+        ("graft-sync", SYNC_RULES),
+        ("graft-audit", AUDIT_RULES),
+    ]
 
 
 def all_main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sheeprl_tpu.analysis all",
         description=(
-            "Run every static tier — graft-lint (GL), graft-sync (GS), graft-audit (AUD) — "
-            "with one merged exit code and a single --format stream (CI runs exactly this)."
+            "Run every static tier — graft-lint (GL), graft-jit (GJ), graft-sync (GS), "
+            "graft-audit (AUD) — with one merged exit code and a single --format stream "
+            "(CI runs exactly this)."
         ),
     )
     parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/dirs for the AST tiers")
@@ -536,24 +653,86 @@ def all_main(argv: List[str]) -> int:
     parser.add_argument("--mesh", default="dp=2", help="virtual audit mesh (default dp=2)")
     parser.add_argument("--tolerance", type=float, default=None, help="audit budget tolerance override")
     parser.add_argument("--skip-audit", action="store_true", help="AST tiers only (no compile pass)")
+    parser.add_argument(
+        "--select",
+        help="comma-separated rules from ANY tier's catalog; tiers with no selected rule are skipped "
+        "(an AUD rule selects the whole audit pass — it has no per-rule filter)",
+    )
+    parser.add_argument("--ignore", help="comma-separated rules from any tier's catalog to skip")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print EVERY tier's rule catalog and exit"
+    )
+    parser.add_argument(
+        "--strict-suppressions",
+        action="store_true",
+        help="stale `# graft-*: disable` directives become findings (exit 1) in every AST tier",
+    )
     args = parser.parse_args(argv)
 
-    rcs = [lint_main(list(args.paths) + ["--format", args.format])]
-    rcs.append(sync_main(list(args.paths) + ["--format", args.format]))
-    if not args.skip_audit:
+    catalogs = _merged_catalogs()
+
+    if args.list_rules:
+        for tool, catalog in catalogs:
+            print(f"{tool}:")
+            for rule, desc in sorted(catalog.items()):
+                print(f"  {rule}  {desc}")
+        return 0
+
+    merged: Dict[str, str] = {}
+    for _tool, catalog in catalogs:
+        merged.update(catalog)
+    try:
+        select = _parse_rules(args.select, catalog=merged)
+        ignore = _parse_rules(args.ignore, catalog=merged)
+    except SystemExit2 as e:
+        print(f"analysis all: {e}", file=sys.stderr)
+        return 2
+
+    def tier_argv(catalog: Dict[str, str]) -> Optional[List[str]]:
+        """Per-tier --select/--ignore subset; None = the selection names no
+        rule of this tier, skip it entirely."""
+        extra: List[str] = []
+        if select is not None:
+            sub = select & set(catalog)
+            if not sub:
+                return None
+            extra += ["--select", ",".join(sorted(sub))]
+        if ignore is not None:
+            sub = ignore & set(catalog)
+            if set(catalog) - sub == set():
+                return None  # every rule of the tier ignored
+            if sub:
+                extra += ["--ignore", ",".join(sorted(sub))]
+        return extra
+
+    strict = ["--strict-suppressions"] if args.strict_suppressions else []
+    rcs: Dict[str, object] = {}
+    for tool, tier_main, catalog in (
+        ("lint", lint_main, catalogs[0][1]),
+        ("jit", jit_main, catalogs[1][1]),
+        ("sync", sync_main, catalogs[2][1]),
+    ):
+        extra = tier_argv(catalog)
+        if extra is None:
+            rcs[tool] = "skipped"
+            continue
+        rcs[tool] = tier_main(list(args.paths) + ["--format", args.format] + extra + strict)
+    if args.skip_audit or (select is not None and not (select & set(catalogs[3][1]))):
+        rcs["audit"] = "skipped"
+    else:
         audit_argv = ["--format", args.format, "--mesh", args.mesh]
         if args.tolerance is not None:
             audit_argv += ["--tolerance", str(args.tolerance)]
-        rcs.append(audit_main(audit_argv))
+        rcs["audit"] = audit_main(audit_argv)
+
     print(
-        "analysis all: lint={} sync={}{}".format(
-            rcs[0], rcs[1], f" audit={rcs[2]}" if len(rcs) > 2 else " audit=skipped"
-        ),
+        "analysis all: lint={lint} jit={jit} sync={sync} audit={audit}".format(**rcs),
         file=sys.stderr,
     )
-    if any(rc == 2 for rc in rcs):
+    codes = [rc for rc in rcs.values() if isinstance(rc, int)]
+    if any(rc == 2 for rc in codes):
         return 2
-    return 1 if any(rc == 1 for rc in rcs) else 0
+    return 1 if any(rc == 1 for rc in codes) else 0
 
 
 # --------------------------------------------------------------------------- #
@@ -600,6 +779,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return audit_main(argv[1:])
     if argv and argv[0] == "tracecheck":
         return tracecheck_main(argv[1:])
+    if argv and argv[0] == "jit":
+        return jit_main(argv[1:])
     if argv and argv[0] == "sync":
         return sync_main(argv[1:])
     if argv and argv[0] == "sync-validate":

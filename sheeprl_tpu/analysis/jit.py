@@ -1,0 +1,295 @@
+"""graft-jit: static purity & trace-hygiene analysis for the traced tier.
+
+The FIFTH analysis tier. The framework's performance thesis is Podracer-style
+"one compiled program, no host round-trips, no retraces" (arXiv 2104.06272) —
+but tracecheck and graft-audit enforce that discipline only on paths a test
+actually dispatches, and nothing checks PRNG key discipline at all. graft-jit
+is graft-sync's device-side twin: it proves purity/hygiene invariants for ALL
+code paths statically, including the cold ones chaos drills and Sample
+Factory-scale throughput runs (arXiv 2006.11751) never sample. The tracedness
+model (which functions run under a trace, which values are tracers) comes
+from :mod:`sheeprl_tpu.analysis.jitgraph`; this module owns the rules,
+messages, suppressions and findings:
+
+GJ001  PRNG key dataflow: the same key VALUE (alias-aware) consumed by two
+       sampling calls without an intervening ``split``/``fold_in``; split
+       results discarded; a carry key spent inside a ``scan``/``fori_loop``/
+       ``while_loop`` body but returned unsplit in the carry (every iteration
+       replays the same stream); ``PRNGKey(<const>)`` constructed inside a
+       traced function (same stream every dispatch).
+GJ002  Host synchronization inside traced code: ``.item()``/``.tolist()`` /
+       ``float()/int()/bool()`` on traced values, ``np.*`` applied to
+       tracers, ``jax.device_get``, ``print()`` of a tracer (use
+       ``jax.debug.print``). Each one is a device→host round-trip baked into
+       the compiled program — the exact thing ``jax.transfer_guard`` samples
+       dynamically, proven here for every path.
+GJ003  Python ``if``/``while``/``assert`` on a tracer-derived boolean inside
+       traced code — a concretization error at trace time, or worse, a
+       trace-time-frozen branch; ``lax.cond``/``lax.select``/
+       ``lax.while_loop``/``checkify`` is required.
+GJ004  Trace-time constant baking: a closure-captured host array above the
+       64 KiB constant budget (the static twin of graft-audit's AUD004,
+       which measures the same constants in lowered HLO), and ``jax.jit``
+       constructed inside a loop body (a fresh wrapper per iteration
+       discards the compilation cache — re-trace, re-compile, every time).
+GJ005  Retrace hazards tracecheck can only catch on exercised paths:
+       unhashable literals (lists/dicts/comprehensions) at declared jit
+       static argument positions, and static arguments fed from an enclosing
+       Python loop variable (a new hash per iteration = a recompile per
+       iteration).
+
+Tracedness roots are every ``@jax.jit``/``pjit``/``shard_map``/
+``pl.pallas_call``-wrapped function plus the registered graft-audit programs
+(``analysis/programs.py`` is ground truth for what the framework compiles),
+closed over interprocedural calls that pass traced values. Conservative
+resolution like graft-sync: unresolvable references never produce guessed
+findings, and a helper called only with static arguments (config, shapes)
+stays host code — ``np.*`` on concrete trace-time values is legal and quiet.
+
+Suppression: append ``# graft-jit: disable=GJxxx[,GJyyy]`` (or a bare
+``disable``) to the offending line, or ``# graft-jit: disable-next-line=...``
+on the line above. The shipped tree carries an EMPTY baseline by policy:
+every suppression needs an inline justification comment, and real findings
+get fixed, not baselined. Stale suppressions (the rule no longer fires on
+that line) are themselves reported — see ``--strict-suppressions``.
+
+CLI (same contract as graft-lint — exit 0 clean / 1 findings / 2 error):
+
+    python -m sheeprl_tpu.analysis jit [paths] [--format=text|json|github]
+    python -m sheeprl_tpu.analysis jit --list-rules
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.jitgraph import Corpus, Event
+from sheeprl_tpu.analysis.lint import (
+    Finding,
+    collect_suppressions,
+    iter_python_files,
+    stale_suppression_findings,
+)
+
+__all__ = [
+    "JIT_RULES",
+    "analyze_jit_sources",
+    "analyze_jit_paths",
+    "analyze_source_jit",
+]
+
+JIT_RULES: Dict[str, str] = {
+    "GJ001": "PRNG key misuse in traced code (reuse without split/fold_in, discarded split, stale scan carry, constant key)",
+    "GJ002": "host synchronization inside traced code (.item/.tolist/float/int/bool, np.* on tracers, device_get, print)",
+    "GJ003": "Python if/while/assert on a tracer-derived boolean inside traced code",
+    "GJ004": "trace-time constant baking (closure-captured array over the 64 KiB budget; jax.jit built inside a loop)",
+    "GJ005": "retrace hazard at jit static arguments (unhashable literal; per-iteration loop variable)",
+}
+
+
+class _Suppressions:
+    """Per-file ``# graft-jit: disable=...`` comment map — the SHARED
+    :func:`~sheeprl_tpu.analysis.lint.collect_suppressions` machinery with
+    the graft-jit tool tag, recording which directives actually absorbed a
+    finding so stale ones can be reported."""
+
+    def __init__(self, src: str) -> None:
+        self.lines = collect_suppressions(src, tool="graft-jit")
+        self.used: Dict[int, Set[str]] = {}
+
+    def active(self, rule: str, line: int) -> bool:
+        if line not in self.lines:
+            return False
+        rules = self.lines[line]
+        if rules is None or rule in rules:
+            self.used.setdefault(line, set()).add(rule)
+            return True
+        return False
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"
+
+
+def _message(ev: Event) -> str:
+    """Render a neutral jitgraph event into the finding message."""
+    k = ev.kind
+    if k == "key_reuse":
+        return (
+            f"key '{ev.get('name')}' is consumed again here but was already spent at "
+            f"line {ev.get('prev_line')} — the two draws are IDENTICAL (split the key, "
+            "or fold_in a distinct index, between uses)"
+        )
+    if k == "split_discarded":
+        return (
+            "jax.random.split result is discarded — the parent key is now burned with "
+            "nothing derived from it; bind the subkeys (`key, sub = jax.random.split(key)`)"
+        )
+    if k == "scan_carry":
+        return (
+            f"carry key '{ev.get('name')}' is spent at line {ev.get('consume_line')} but "
+            f"returned UNSPLIT in the {ev.get('loop')} carry — every iteration replays the "
+            "same stream; thread a fresh key through the carry "
+            "(`key, sub = jax.random.split(key)` and return `key`)"
+        )
+    if k == "const_key":
+        return (
+            f"PRNGKey({ev.get('seed')}) constructed inside a traced function — the seed is "
+            "baked at trace time, so EVERY dispatch replays the same stream; take the key "
+            "as an argument (or fold_in a traced index)"
+        )
+    if k == "method_sync":
+        return (
+            f".{ev.get('method')}() on a traced value — a device→host sync baked into the "
+            "compiled program; keep the value on device (or move this to the host boundary)"
+        )
+    if k == "cast_sync":
+        return (
+            f"{ev.get('cast')}() on a traced value forces a concretizing device→host sync "
+            "at trace time; keep the math in jax.numpy (or mark the argument static)"
+        )
+    if k == "np_on_tracer":
+        return (
+            f"np.{ev.get('func')} applied to a traced value — numpy concretizes the tracer "
+            "(ConcretizationTypeError at best, a silent host round-trip at worst); use the "
+            "jax.numpy equivalent"
+        )
+    if k == "device_get":
+        return (
+            "jax.device_get inside traced code — an explicit device→host transfer in the "
+            "middle of the program; return the value instead and fetch it at the host boundary"
+        )
+    if k == "print_tracer":
+        return (
+            "print() of a traced value prints the TRACER at trace time (once), not the "
+            "runtime value — use jax.debug.print for per-dispatch output"
+        )
+    if k == "dyn_flow":
+        stmt = ev.get("stmt_kind")
+        fix = {
+            "if": "lax.cond / lax.select",
+            "while": "lax.while_loop",
+            "assert": "checkify.check (or drop the assert)",
+        }.get(stmt, "lax control flow")
+        return (
+            f"Python `{stmt}` on a tracer-derived boolean — the branch is decided at TRACE "
+            f"time (or raises ConcretizationTypeError); use {fix}"
+        )
+    if k == "baked_const":
+        return (
+            f"closure-captured host array '{ev.get('name')}' ({_fmt_bytes(ev.get('nbytes', 0))}, "
+            f"bound at line {ev.get('bind_line')}) is baked into the compiled program as a "
+            "constant — over the 64 KiB budget (AUD004's static twin); pass it as an argument "
+            "so it lives in device memory once"
+        )
+    if k == "jit_in_loop":
+        return (
+            "jax.jit constructed inside a loop body — each iteration builds a FRESH wrapper "
+            "with an empty compile cache (re-trace + re-compile every pass); hoist the jit "
+            "out of the loop"
+        )
+    if k == "static_unhashable":
+        return (
+            f"unhashable literal at {ev.get('where')} of jitted '{ev.get('fn')}' — static "
+            "arguments are cache keys and must hash; pass a tuple (or make the argument traced)"
+        )
+    if k == "static_loop_varying":
+        return (
+            f"loop variable '{ev.get('var')}' flows into {ev.get('where')} of jitted "
+            f"'{ev.get('fn')}' — a new static value per iteration means a RECOMPILE per "
+            "iteration; make the argument traced, or hoist the variation out of the loop"
+        )
+    return k  # pragma: no cover - every kind above is exhaustive
+
+
+def analyze_jit_sources(
+    sources: Sequence[Tuple[str, str]],
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
+) -> List[Finding]:
+    """Run the GJ rules over ``(src, path)`` pairs as ONE corpus (tracedness
+    propagates across modules by design — a helper in ``ops/`` called from a
+    jitted train step in ``algos/`` is analyzed as traced)."""
+    corpus = Corpus()
+    suppressions: Dict[str, _Suppressions] = {}
+    findings: List[Finding] = []
+    for src, path in sources:
+        suppressions[path] = _Suppressions(src)
+        err = corpus.add_source(src, path)
+        if err is not None:
+            findings.append(Finding("GJ000", path, err[0], 1, f"syntax error: {err[1]}", "<module>"))
+    corpus.finalize()
+
+    def report(ev: Event, path: str) -> None:
+        if select is not None and ev.rule not in select:
+            return
+        if ignore is not None and ev.rule in ignore:
+            return
+        sup = suppressions.get(path)
+        if sup is not None and sup.active(ev.rule, ev.line):
+            return
+        findings.append(Finding(ev.rule, path, ev.line, ev.col, _message(ev), ev.qualname))
+
+    for module in corpus.modules:
+        for ev in module.events:
+            report(ev, module.path)
+        for fn in module.functions.values():
+            for ev in fn.events:
+                report(ev, module.path)
+
+    if stale_out is not None:
+        for src, path in sources:
+            sup = suppressions[path]
+            stale_out.extend(
+                stale_suppression_findings(
+                    "graft-jit", JIT_RULES, sup.lines, sup.used, path,
+                    select=select, ignore=ignore,
+                )
+            )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_source_jit(
+    src: str,
+    path: str = "<string>",
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
+) -> List[Finding]:
+    """Single-module convenience wrapper (tests, fixtures)."""
+    return analyze_jit_sources([(src, path)], select=select, ignore=ignore, stale_out=stale_out)
+
+
+def analyze_jit_paths(
+    paths: Sequence[str],
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
+) -> List[Finding]:
+    sources: List[Tuple[str, str]] = []
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as e:  # pragma: no cover
+            findings.append(Finding("GJ000", path, 0, 1, f"unreadable: {e}", "<module>"))
+            continue
+        sources.append((src, os.path.relpath(path)))
+    findings.extend(
+        analyze_jit_sources(sources, select=select, ignore=ignore, stale_out=stale_out)
+    )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

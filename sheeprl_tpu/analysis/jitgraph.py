@@ -1,0 +1,1314 @@
+"""The graft-jit corpus model: WHICH functions run under a JAX trace, and
+what happens to traced values inside them.
+
+graft-lint answers "is this hazard in jit-reachable code?" per MODULE — its
+reachability stops at the file boundary. This model answers it per CORPUS:
+the root set is every function wrapped by a trace entry point
+(``@jax.jit`` / ``pjit`` / ``shard_map`` / ``pmap`` / ``vmap`` /
+``pl.pallas_call`` / ``lax.scan``-family bodies, as a decorator or a call
+argument) PLUS every function the graft-audit registry declares as a
+compiled hot-path program (``analysis/programs.py`` is ground truth for what
+this framework actually compiles), with interprocedural reachability through
+``self.method()`` and imported-module calls — so a loss helper in
+``ops/`` called from a jitted train step is analyzed AS traced code even
+though its own file never mentions ``jit``.
+
+Tracedness propagates with the traced VALUES, not with mere call edges: a
+helper called from traced code with only static arguments (config, shapes,
+names) executes on concrete host values at trace time, where ``np.*`` and
+``float()`` are legal — so only call sites that pass at least one tainted
+argument extend the traced set, and only the parameters that receive tainted
+arguments are tainted in the callee. Unresolvable references (dynamic
+dispatch, attributes on unknown objects, names from outside the corpus)
+NEVER extend the traced set and never produce guessed findings — same
+conservative-resolution contract as :mod:`~sheeprl_tpu.analysis.syncgraph`.
+
+Two phases, like syncgraph: :meth:`Corpus.add_source` parses each module and
+collects declarations (functions, roots, imports, constant bindings, the
+module-scope hazards that don't need taint); :meth:`Corpus.finalize` runs
+the cross-module taint fixpoint and walks every traced function, emitting
+neutral :class:`Event` records that :mod:`sheeprl_tpu.analysis.jit` turns
+into findings (that module owns the rule catalog, messages, suppressions and
+the CLI contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Corpus", "Event", "FunctionModel", "ModuleModel"]
+
+# Trace entry points: wrapping a function in any of these compiles/stages it.
+# Superset of graft-lint's set — pjit and the Pallas kernel entry included.
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "pmap", "vmap", "shard_map", "grad", "value_and_grad",
+    "checkpoint", "remat", "custom_jvp", "custom_vjp", "scan", "cond",
+    "while_loop", "fori_loop", "switch", "associative_scan", "named_call",
+    "pallas_call",
+}
+
+# Axis collectives: a body containing one is trace context by construction.
+_COLLECTIVES = {
+    "pmean", "psum", "pmin", "pmax", "all_gather", "all_to_all", "ppermute",
+    "axis_index", "pshuffle", "psum_scatter",
+}
+
+# jax.random callables that SPEND the key passed as their first argument
+# (``fold_in`` deliberately absent — deriving child keys via fold_in(key, i)
+# is the documented streaming idiom; it derives, it does not spend).
+from sheeprl_tpu.analysis.lint import _KEY_CONSUMERS  # one list, two tiers
+
+# Parameter names that are conventionally static metadata, never traced
+# values — mirrors graft-lint's exclusion list so the two tiers agree on
+# what a "traced parameter" is.
+_STATIC_PARAM_NAMES = {
+    "self", "cls", "shape", "shapes", "dtype", "dtypes", "axis", "axes",
+    "cfg", "config", "path", "paths", "name", "names", "layout", "mesh",
+    "spec", "specs", "treedef",
+}
+
+# Bytes per element for the GJ004 closure-constant size estimate.
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "bool_": 1,
+}
+
+#: GJ004's static twin of graft-audit's AUD004 budget: a closure-captured
+#: host array above this many bytes is an over-budget baked constant.
+CONSTANT_BUDGET_BYTES = 64 * 1024
+
+
+@dataclass(frozen=True)
+class Event:
+    """One neutral analysis event; :mod:`..jit` owns turning it into a
+    finding (rule text, select/ignore, suppressions)."""
+
+    rule: str  # "GJ001".."GJ005"
+    kind: str  # sub-pattern tag, e.g. "key_reuse", "device_get"
+    line: int
+    col: int
+    qualname: str
+    data: Tuple[Tuple[str, object], ...] = ()  # frozen kwargs for the message
+
+    def get(self, key: str, default=None):
+        for k, v in self.data:
+            if k == key:
+                return v
+        return default
+
+
+def _ev(rule: str, kind: str, node: ast.AST, qualname: str, **data) -> Event:
+    return Event(
+        rule,
+        kind,
+        getattr(node, "lineno", 0),
+        getattr(node, "col_offset", 0) + 1,
+        qualname,
+        tuple(sorted(data.items())),
+    )
+
+
+class _Imports:
+    """Import-alias resolution (same semantics as graft-lint's module
+    context, plus package-relative ``from . import x`` handling so corpus
+    modules resolve each other)."""
+
+    def __init__(self, package: str) -> None:
+        self.package = package  # dotted package of the module ("" if unknown)
+        self.aliases: Dict[str, str] = {}
+
+    def add(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    self.aliases[a.asname] = a.name
+                else:
+                    self.aliases[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = self.package.split(".") if self.package else []
+                keep = parts[: len(parts) - (node.level - 1)] if node.level > 1 else parts
+                base = ".".join(keep + ([node.module] if node.module else []))
+            if not base:
+                return
+            for a in node.names:
+                self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(self.aliases.get(cur.id, cur.id))
+        return ".".join(reversed(parts))
+
+
+def _tail(resolved: Optional[str]) -> Optional[str]:
+    return resolved.rsplit(".", 1)[-1] if resolved else None
+
+
+def _is_trace_wrapper(resolved: Optional[str]) -> bool:
+    tail = _tail(resolved)
+    if tail not in _TRACE_WRAPPERS:
+        return False
+    if resolved == tail:  # bare, never imported: local defs named e.g. `scan`
+        return tail in ("jit", "shard_map", "pallas_call")
+    return True
+
+
+def _is_numpy(resolved: Optional[str]) -> bool:
+    return bool(resolved) and (resolved == "numpy" or resolved.startswith("numpy."))
+
+
+def _is_jax_random(resolved: Optional[str]) -> bool:
+    return bool(resolved) and resolved.startswith("jax.random.")
+
+
+@dataclass
+class _CallSite:
+    """A resolvable-looking call made from a traced function's own frame,
+    kept for the taint fixpoint."""
+
+    node: ast.Call
+    func_kind: str  # "name" | "self" | "dotted"
+    target: str  # bare name / method name / dotted name
+    arg_taint: Tuple[bool, ...]
+    kw_taint: Tuple[Tuple[str, bool], ...]
+
+
+class FunctionModel:
+    def __init__(
+        self,
+        node: ast.AST,
+        qualname: str,
+        module: "ModuleModel",
+        class_name: Optional[str],
+        parent: Optional["FunctionModel"],
+    ) -> None:
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.module = module
+        self.class_name = class_name
+        self.parent = parent
+        self.traced = False
+        self.trace_reason = ""
+        self.tainted_params: Set[str] = set()
+        self.static_argnums: Set[int] = set()
+        self.static_argnames: Set[str] = set()
+        self.loop_body_kinds: Set[str] = set()  # "scan" / "fori_loop" / "while_loop"
+        self.const_bindings: Dict[str, Tuple[int, int]] = {}  # name -> (line, nbytes)
+        self.events: List[Event] = []
+        self.calls: List[_CallSite] = []
+
+    def params(self) -> List[str]:
+        node = self.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return []
+        a = node.args
+        return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+    def default_taint(self) -> Set[str]:
+        """All parameters minus conventional-static names and jit-static
+        args — the taint set a root function starts from."""
+        node = self.node
+        out: Set[str] = set()
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return out
+        a = node.args
+        positional = list(a.posonlyargs) + list(a.args)
+        for i, p in enumerate(positional + list(a.kwonlyargs)):
+            if p.arg in _STATIC_PARAM_NAMES:
+                continue
+            if i < len(positional) and i in self.static_argnums:
+                continue
+            if p.arg in self.static_argnames:
+                continue
+            out.add(p.arg)
+        if a.vararg:
+            out.add(a.vararg.arg)
+        if a.kwarg:
+            out.add(a.kwarg.arg)
+        return out
+
+    def mark_traced(self, reason: str, root: bool) -> bool:
+        """Returns True when this marks the function traced for the first
+        time (callers use it to schedule a walk)."""
+        first = not self.traced
+        self.traced = True
+        if first:
+            self.trace_reason = reason
+        if root:
+            self.tainted_params |= self.default_taint()
+        return first
+
+
+class ModuleModel:
+    def __init__(self, path: str, modname: str, tree: ast.Module) -> None:
+        self.path = path
+        self.modname = modname
+        self.tree = tree
+        package = modname.rsplit(".", 1)[0] if "." in modname else ""
+        self.imports = _Imports(package)
+        self.functions: Dict[str, FunctionModel] = {}  # qualname -> model
+        self.by_name: Dict[str, List[FunctionModel]] = {}
+        self.const_bindings: Dict[str, Tuple[int, int]] = {}  # module scope
+        self.static_jit_bindings: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+        self.events: List[Event] = []  # taint-free module-scope events (GJ004/GJ005)
+
+
+def _module_name(path: str) -> str:
+    norm = path.replace("\\", "/").lstrip("./")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    if norm.endswith("/__init__"):
+        norm = norm[: -len("/__init__")]
+    return norm.replace("/", ".")
+
+
+def _own_frame_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Nodes of ``fn``'s body excluding nested function/class frames."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _literal_shape_count(node: ast.expr) -> Optional[int]:
+    """Element count of a literal shape argument (int or tuple/list of
+    ints); None when not statically computable."""
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(val, bool):
+        return None
+    if isinstance(val, int):
+        return val if val >= 0 else None
+    if isinstance(val, (tuple, list)) and all(isinstance(v, int) and not isinstance(v, bool) for v in val):
+        n = 1
+        for v in val:
+            if v < 0:
+                return None
+            n *= v
+        return n
+    return None
+
+
+def _dtype_bytes(call: ast.Call, imports: _Imports, default: int) -> int:
+    for kw in call.keywords:
+        if kw.arg != "dtype":
+            continue
+        if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+            return _DTYPE_BYTES.get(kw.value.value, default)
+        resolved = imports.resolve(kw.value)
+        if resolved:
+            return _DTYPE_BYTES.get(resolved.rsplit(".", 1)[-1], default)
+    return default
+
+
+def _const_nbytes(value: ast.expr, imports: _Imports) -> Optional[int]:
+    """Statically-computable byte size of an ``np.*``/``jnp.*`` array
+    constructor, or None (unknown sizes never produce findings)."""
+    if not isinstance(value, ast.Call):
+        return None
+    resolved = imports.resolve(value.func)
+    if not resolved:
+        return None
+    is_np = _is_numpy(resolved)
+    is_jnp = resolved.startswith("jax.numpy.")
+    if not (is_np or is_jnp):
+        return None
+    tail = resolved.rsplit(".", 1)[-1]
+    default = 8 if is_np else 4  # numpy defaults f64; jax defaults f32
+    count: Optional[int] = None
+    if tail in ("zeros", "ones", "empty", "full") and value.args:
+        count = _literal_shape_count(value.args[0])
+    elif tail == "arange" and value.args:
+        try:
+            args = [ast.literal_eval(a) for a in value.args[:3]]
+        except (ValueError, SyntaxError):
+            return None
+        if not all(isinstance(a, int) and not isinstance(a, bool) for a in args):
+            return None
+        count = len(range(*args)) if args else None
+    elif tail == "linspace":
+        if len(value.args) >= 3:
+            count = _literal_shape_count(value.args[2])
+        else:
+            for kw in value.keywords:
+                if kw.arg == "num":
+                    count = _literal_shape_count(kw.value)
+            if count is None:
+                count = 50
+    elif tail in ("eye", "identity") and value.args:
+        n = _literal_shape_count(value.args[0])
+        if n is None:
+            return None
+        m = n
+        if tail == "eye" and len(value.args) > 1:
+            m = _literal_shape_count(value.args[1])
+            if m is None:
+                return None
+        count = n * m
+    elif tail in ("array", "asarray") and value.args:
+        try:
+            val = ast.literal_eval(value.args[0])
+        except (ValueError, SyntaxError):
+            return None
+
+        def _count(v) -> Optional[int]:
+            if isinstance(v, (list, tuple)):
+                total = 0
+                for item in v:
+                    c = _count(item)
+                    if c is None:
+                        return None
+                    total += c
+                return total
+            return 1 if isinstance(v, (int, float, bool, complex)) else None
+
+        count = _count(val)
+    if count is None:
+        return None
+    return count * _dtype_bytes(value, imports, default)
+
+
+# --------------------------------------------------------------------------- #
+# per-function traced walk (taint + GJ001/GJ002/GJ003 events + call sites)
+# --------------------------------------------------------------------------- #
+
+
+class _TracedWalk:
+    """One pass over a traced function frame: parameter-seeded taint,
+    PRNG-key value numbering, host-sync/control-flow events, and the
+    taint-annotated call sites the fixpoint propagates through. Structure
+    mirrors graft-lint's ``_FnAnalysis`` (branch merge, two loop passes)."""
+
+    def __init__(self, fn: FunctionModel) -> None:
+        self.fn = fn
+        self.imports = fn.module.imports
+        self.tainted: Set[str] = set(fn.tainted_params)
+        self.param_names: Set[str] = set(fn.params()) | set(fn.tainted_params)
+        self.reassigned: Set[str] = set()
+        self.key_of: Dict[str, int] = {}
+        self.consumed: Dict[int, int] = {}  # key id -> line of first spend
+        self._next_key = 0
+        self.loop_depth = 0
+        self.local_names = self._collect_locals()
+        self._baked_seen: Set[str] = set()
+
+    # -- setup -------------------------------------------------------------- #
+
+    def _collect_locals(self) -> Set[str]:
+        names: Set[str] = set(self.fn.params())
+        node = self.fn.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for extra in (a.kwonlyargs, [a.vararg] if a.vararg else [], [a.kwarg] if a.kwarg else []):
+                names.update(p.arg for p in extra)
+        for sub in _own_frame_nodes(self.fn.node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                names.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.add(sub.name)
+            elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                for alias in sub.names:
+                    names.add((alias.asname or alias.name).split(".")[0])
+        return names
+
+    def _fresh_key(self) -> int:
+        self._next_key += 1
+        return self._next_key
+
+    def emit(self, rule: str, kind: str, node: ast.AST, **data) -> None:
+        self.fn.events.append(_ev(rule, kind, node, self.fn.qualname, **data))
+
+    # -- taint -------------------------------------------------------------- #
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        """Structural taint, same precision rule as graft-lint: attribute
+        access does NOT propagate (config/shape/metadata reads are static
+        even on tracers) except the array views that stay arrays."""
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("T", "mT", "at", "real", "imag"):
+                return self.is_tainted(node.value)
+            return False
+        if isinstance(node, ast.Call):
+            recv = isinstance(node.func, ast.Attribute) and self.is_tainted(node.func.value)
+            return (
+                recv
+                or any(self.is_tainted(a) for a in node.args)
+                or any(self.is_tainted(kw.value) for kw in node.keywords)
+            )
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value) or self.is_tainted(node.slice)
+        return any(self.is_tainted(c) for c in ast.iter_child_nodes(node))
+
+    def _is_bare_param(self, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Name)
+            and node.id in self.param_names
+            and node.id not in self.reassigned
+        )
+
+    @staticmethod
+    def _static_test(test: ast.expr) -> bool:
+        if isinstance(test, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return True
+            operands = [test.left] + list(test.comparators)
+            if any(
+                isinstance(o, ast.Call) and isinstance(o.func, ast.Name) and o.func.id == "len"
+                for o in operands
+            ):
+                return True
+        if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) and test.func.id in (
+            "isinstance", "hasattr", "len", "callable",
+        ):
+            return True
+        if isinstance(test, ast.BoolOp):
+            return all(_TracedWalk._static_test(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return _TracedWalk._static_test(test.operand)
+        return False
+
+    def _dynamic_test(self, test: ast.expr) -> bool:
+        if isinstance(test, ast.BoolOp):
+            if any(
+                isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and v.func.id == "isinstance"
+                for v in test.values
+            ):
+                return False
+            return any(self._dynamic_test(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._dynamic_test(test.operand)
+        if self._static_test(test) or self._is_bare_param(test):
+            return False
+        return self.is_tainted(test)
+
+    def _assign_names(self, target: ast.expr) -> List[str]:
+        return [
+            sub.id
+            for sub in ast.walk(target)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)
+        ]
+
+    # -- statement walk ----------------------------------------------------- #
+
+    def run(self) -> None:
+        self.walk_block(getattr(self.fn.node, "body", []))
+
+    def walk_block(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate frame
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self.visit_expr(value)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            rhs_tainted = value is not None and self.is_tainted(value)
+            if isinstance(stmt, ast.AugAssign):
+                rhs_tainted = rhs_tainted or self.is_tainted(stmt.target)
+            # discarded split bound to `_` is a discard too
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_"
+                and isinstance(value, ast.Call)
+                and self.imports.resolve(value.func) == "jax.random.split"
+            ):
+                self.emit("GJ001", "split_discarded", value)
+            key_src = self._key_source(value)
+            for t in targets:
+                names = self._assign_names(t)
+                if key_src is not None and isinstance(t, ast.Name):
+                    self.key_of[t.id] = key_src if isinstance(key_src, int) else self._fresh_key()
+                elif key_src == "fresh" and isinstance(t, (ast.Tuple, ast.List)):
+                    # key, sub = jax.random.split(key): each element a new key
+                    for elt in t.elts:
+                        if isinstance(elt, ast.Name):
+                            self.key_of[elt.id] = self._fresh_key()
+                else:
+                    for name in names:
+                        self.key_of.pop(name, None)
+                for name in names:
+                    self.reassigned.add(name)
+                    if rhs_tainted:
+                        self.tainted.add(name)
+                    else:
+                        self.tainted.discard(name)
+        elif isinstance(stmt, ast.If):
+            self.visit_expr(stmt.test)
+            if self._dynamic_test(stmt.test):
+                self.emit("GJ003", "dyn_flow", stmt, stmt_kind="if")
+            self._walk_branches([stmt.body, stmt.orelse])
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.visit_expr(stmt.iter)
+            iter_tainted = self.is_tainted(stmt.iter)
+            target_names = self._assign_names(stmt.target)
+            untainted: Set[str] = set()
+            if isinstance(stmt.iter, ast.Call) and isinstance(stmt.iter.func, ast.Name):
+                if stmt.iter.func.id == "range":
+                    untainted.update(target_names)
+                elif stmt.iter.func.id == "enumerate" and isinstance(stmt.target, ast.Tuple) and stmt.target.elts:
+                    untainted.update(self._assign_names(stmt.target.elts[0]))
+            self.loop_depth += 1
+            for _pass in range(2):  # cross-iteration key reuse needs 2 passes
+                for name in target_names:
+                    self.key_of.pop(name, None)
+                    self.reassigned.add(name)
+                    if iter_tainted and name not in untainted:
+                        self.tainted.add(name)
+                    else:
+                        self.tainted.discard(name)
+                self.walk_block(stmt.body)
+            self.loop_depth -= 1
+            self.walk_block(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.visit_expr(stmt.test)
+            if self._dynamic_test(stmt.test):
+                self.emit("GJ003", "dyn_flow", stmt, stmt_kind="while")
+            self.loop_depth += 1
+            self.walk_block(stmt.body)
+            self.walk_block(stmt.body)
+            self.loop_depth -= 1
+            self.walk_block(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    for name in self._assign_names(item.optional_vars):
+                        if self.is_tainted(item.context_expr):
+                            self.tainted.add(name)
+            self.walk_block(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.walk_block(stmt.body)
+            for h in stmt.handlers:
+                self.walk_block(h.body)
+            self.walk_block(stmt.orelse)
+            self.walk_block(stmt.finalbody)
+        elif isinstance(stmt, ast.Assert):
+            self.visit_expr(stmt.test)
+            if self._dynamic_test(stmt.test):
+                self.emit("GJ003", "dyn_flow", stmt, stmt_kind="assert")
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                if (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and self.imports.resolve(stmt.value.func) == "jax.random.split"
+                ):
+                    self.emit("GJ001", "split_discarded", stmt.value)
+                self.visit_expr(stmt.value)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.discard(t.id)
+                    self.key_of.pop(t.id, None)
+        elif isinstance(stmt, ast.Raise):
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self.visit_expr(sub)
+
+    @staticmethod
+    def _terminates(block: Sequence[ast.stmt]) -> bool:
+        return bool(block) and isinstance(block[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    def _walk_branches(self, blocks: Sequence[Sequence[ast.stmt]]) -> None:
+        merged_consumed = dict(self.consumed)
+        merged_keys = dict(self.key_of)
+        merged_tainted = set(self.tainted)
+        base = (dict(self.consumed), dict(self.key_of), set(self.tainted))
+        for block in blocks:
+            self.consumed, self.key_of, self.tainted = dict(base[0]), dict(base[1]), set(base[2])
+            self.walk_block(block)
+            if self._terminates(block):
+                continue
+            merged_consumed.update(self.consumed)
+            merged_keys.update(self.key_of)
+            merged_tainted |= self.tainted
+        self.consumed, self.key_of, self.tainted = merged_consumed, merged_keys, merged_tainted
+
+    # -- expressions -------------------------------------------------------- #
+
+    def _key_source(self, value: Optional[ast.expr]):
+        """What a RHS does to key state: an int (alias of an existing key
+        id), the sentinel "fresh" (key constructor / split / fold_in), or
+        None (not key-typed)."""
+        if value is None:
+            return None
+        if isinstance(value, ast.Name):
+            # eager id assignment: `k2 = key` must alias even before `key` is
+            # first spent; the id is only ever consulted if both names later
+            # reach a key consumer, in which case they ARE the same key value
+            kid = self.key_of.get(value.id)
+            if kid is None:
+                kid = self._fresh_key()
+                self.key_of[value.id] = kid
+            return kid
+        if isinstance(value, ast.Call):
+            resolved = self.imports.resolve(value.func)
+            if resolved in (
+                "jax.random.PRNGKey", "jax.random.key", "jax.random.fold_in",
+                "jax.random.split", "jax.random.clone", "jax.random.wrap_key_data",
+            ):
+                return "fresh"
+        if isinstance(value, ast.Subscript):
+            # keys[0] from a split result: a key, identity unknown -> fresh
+            base = value.value
+            if isinstance(base, ast.Name) and base.id in self.key_of:
+                return "fresh"
+        return None
+
+    def visit_expr(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self._check_baked_const(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child)
+            elif isinstance(child, (ast.keyword, ast.comprehension)):
+                self.visit_expr(child.value if isinstance(child, ast.keyword) else child.iter)
+
+    def _check_baked_const(self, node: ast.Name) -> None:
+        """GJ004: a closure-captured host array with a statically-known size
+        over the constant budget is materialized into EVERY copy of the
+        compiled program."""
+        name = node.id
+        if name in self.local_names or name in self._baked_seen:
+            return
+        binding: Optional[Tuple[int, int]] = None
+        scope: Optional[FunctionModel] = self.fn.parent
+        while scope is not None and binding is None:
+            binding = scope.const_bindings.get(name)
+            scope = scope.parent
+        if binding is None:
+            binding = self.fn.module.const_bindings.get(name)
+        if binding is None:
+            return
+        bind_line, nbytes = binding
+        if nbytes <= CONSTANT_BUDGET_BYTES:
+            return
+        self._baked_seen.add(name)
+        self.emit("GJ004", "baked_const", node, name=name, nbytes=nbytes, bind_line=bind_line)
+
+    def _visit_call(self, node: ast.Call) -> None:
+        resolved = self.imports.resolve(node.func)
+        tail = _tail(resolved)
+
+        # arguments evaluate before the call
+        for arg in node.args:
+            self.visit_expr(arg)
+        for kw in node.keywords:
+            self.visit_expr(kw.value)
+        if isinstance(node.func, ast.Attribute):
+            self.visit_expr(node.func.value)
+
+        # GJ001: constant-seeded key constructed inside a traced function —
+        # same stream every dispatch, silently correlated batches
+        if resolved in ("jax.random.PRNGKey", "jax.random.key") and node.args and isinstance(
+            node.args[0], ast.Constant
+        ):
+            self.emit("GJ001", "const_key", node, seed=repr(node.args[0].value))
+
+        # GJ001: key spends with value numbering (aliases share an id)
+        if _is_jax_random(resolved) and tail in _KEY_CONSUMERS:
+            key_arg: Optional[ast.expr] = node.args[0] if node.args else None
+            if key_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "key":
+                        key_arg = kw.value
+            if isinstance(key_arg, ast.Name):
+                kid = self.key_of.get(key_arg.id)
+                if kid is None:
+                    kid = self._fresh_key()
+                    self.key_of[key_arg.id] = kid
+                prev = self.consumed.get(kid)
+                if prev is not None:
+                    self.emit("GJ001", "key_reuse", node, name=key_arg.id, prev_line=prev)
+                else:
+                    self.consumed[kid] = node.lineno
+
+        # GJ002: host syncs on traced values
+        if isinstance(node.func, ast.Attribute) and node.func.attr in ("item", "tolist"):
+            if self.is_tainted(node.func.value):
+                self.emit("GJ002", "method_sync", node, method=node.func.attr)
+        elif isinstance(node.func, ast.Name) and node.func.id in ("float", "int", "bool") and node.args:
+            if self.is_tainted(node.args[0]):
+                self.emit("GJ002", "cast_sync", node, cast=node.func.id)
+        elif isinstance(node.func, ast.Name) and node.func.id == "print":
+            if any(self.is_tainted(a) for a in node.args):
+                self.emit("GJ002", "print_tracer", node)
+        elif resolved == "jax.device_get":
+            if any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(kw.value) for kw in node.keywords
+            ):
+                self.emit("GJ002", "device_get", node)
+        elif _is_numpy(resolved):
+            if any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(kw.value) for kw in node.keywords
+            ):
+                self.emit("GJ002", "np_on_tracer", node, func=tail or "?")
+
+        # call-site record for the taint fixpoint
+        self._record_call(node)
+
+    def _record_call(self, node: ast.Call) -> None:
+        arg_taint = tuple(self.is_tainted(a) for a in node.args)
+        kw_taint = tuple((kw.arg, self.is_tainted(kw.value)) for kw in node.keywords if kw.arg)
+        if isinstance(node.func, ast.Name):
+            self.fn.calls.append(_CallSite(node, "name", node.func.id, arg_taint, kw_taint))
+        elif isinstance(node.func, ast.Attribute):
+            if isinstance(node.func.value, ast.Name) and node.func.value.id == "self":
+                self.fn.calls.append(_CallSite(node, "self", node.func.attr, arg_taint, kw_taint))
+            else:
+                dotted = self.imports.resolve(node.func)
+                if dotted:
+                    self.fn.calls.append(_CallSite(node, "dotted", dotted, arg_taint, kw_taint))
+
+
+# --------------------------------------------------------------------------- #
+# corpus
+# --------------------------------------------------------------------------- #
+
+
+class Corpus:
+    def __init__(self) -> None:
+        self.modules: List[ModuleModel] = []
+        self.by_modname: Dict[str, ModuleModel] = {}
+
+    # -- phase 1 ------------------------------------------------------------ #
+
+    def add_source(self, src: str, path: str) -> Optional[Tuple[int, str]]:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return (e.lineno or 0, e.msg or "invalid syntax")
+        module = ModuleModel(path, _module_name(path), tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                module.imports.add(node)
+        self._collect_functions(module)
+        self._collect_const_bindings(module)
+        self._collect_roots(module)
+        self._collect_module_hazards(module)
+        self.modules.append(module)
+        self.by_modname[module.modname] = module
+        return None
+
+    def _collect_functions(self, module: ModuleModel) -> None:
+        def walk(node: ast.AST, prefix: str, class_name: Optional[str], parent: Optional[FunctionModel]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    fn = FunctionModel(child, qual, module, class_name, parent)
+                    module.functions[qual] = fn
+                    module.by_name.setdefault(child.name, []).append(fn)
+                    walk(child, qual + ".", None, fn)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, f"{prefix}{child.name}.", child.name, parent)
+                else:
+                    walk(child, prefix, class_name, parent)
+
+        walk(module.tree, "", None, None)
+
+    def _collect_const_bindings(self, module: ModuleModel) -> None:
+        def scan(body: Sequence[ast.stmt], sink: Dict[str, Tuple[int, int]]) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
+                    stmt.targets[0], ast.Name
+                ):
+                    nbytes = _const_nbytes(stmt.value, module.imports)
+                    if nbytes is not None:
+                        sink[stmt.targets[0].id] = (stmt.lineno, nbytes)
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name) and stmt.value is not None:
+                    nbytes = _const_nbytes(stmt.value, module.imports)
+                    if nbytes is not None:
+                        sink[stmt.target.id] = (stmt.lineno, nbytes)
+
+        scan(module.tree.body, module.const_bindings)
+        for fn in module.functions.values():
+            scan(getattr(fn.node, "body", []), fn.const_bindings)
+
+    @staticmethod
+    def _record_static_args(fn: FunctionModel, call: Optional[ast.Call]) -> None:
+        if call is None:
+            return
+        for kw in call.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                if isinstance(v, int) and not isinstance(v, bool):
+                    fn.static_argnums.add(v)
+                elif isinstance(v, str):
+                    fn.static_argnames.add(v)
+
+    def _collect_roots(self, module: ModuleModel) -> None:
+        imports = module.imports
+
+        # (a) decorator roots: @jax.jit, @partial(jax.jit, ...), @shard_map,
+        # and @register_audit_programs builders (see (d))
+        for fn in module.functions.values():
+            for dec in getattr(fn.node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                resolved = imports.resolve(target)
+                if _is_trace_wrapper(resolved):
+                    self._record_static_args(fn, dec if isinstance(dec, ast.Call) else None)
+                    fn.mark_traced(f"@{_tail(resolved)}", root=True)
+                elif isinstance(dec, ast.Call) and _tail(imports.resolve(dec.func)) == "partial":
+                    inner = dec.args[0] if dec.args else None
+                    if inner is not None and _is_trace_wrapper(imports.resolve(inner)):
+                        self._record_static_args(fn, dec)
+                        fn.mark_traced(f"@partial({_tail(imports.resolve(inner))})", root=True)
+
+        # (b) call-argument roots: f passed to jit/scan/shard_map/pallas_call
+        # (directly or partial-wrapped); scan/fori/while bodies additionally
+        # get the key-carry check
+        _loop_kinds = {"scan": 0, "fori_loop": 2, "while_loop": 1}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = imports.resolve(node.func)
+            if not _is_trace_wrapper(resolved):
+                continue
+            wrapper = _tail(resolved) or ""
+            for pos, arg in enumerate(list(node.args) + [kw.value for kw in node.keywords]):
+                if isinstance(arg, ast.Call) and _tail(imports.resolve(arg.func)) == "partial" and arg.args:
+                    arg = arg.args[0]
+                if not isinstance(arg, ast.Name):
+                    continue
+                for fn in module.by_name.get(arg.id, []):
+                    if wrapper == "jit":
+                        self._record_static_args(fn, node)
+                    fn.mark_traced(f"passed to {wrapper}", root=True)
+                    if wrapper in _loop_kinds and pos == _loop_kinds[wrapper]:
+                        fn.loop_body_kinds.add(wrapper)
+
+        # (c) intrinsic trace context: bodies using axis collectives
+        for fn in module.functions.values():
+            if fn.traced:
+                continue
+            for node in _own_frame_nodes(fn.node):
+                if isinstance(node, ast.Call) and _tail(imports.resolve(node.func)) in _COLLECTIVES:
+                    fn.mark_traced("contains an axis collective", root=True)
+                    break
+
+        # (d) audit-registry roots: `AuditProgram(fn=X, ...)` (or positional
+        # #2) inside a @register_audit_programs builder, where X is a bare
+        # name of a module function. The registry is ground truth for what
+        # the framework compiles; factory-call `fn=make_step(...)` values
+        # are already rooted by (a)/(b) inside the factory.
+        for fn in module.functions.values():
+            is_builder = any(
+                _tail(imports.resolve(dec.func if isinstance(dec, ast.Call) else dec))
+                == "register_audit_programs"
+                for dec in getattr(fn.node, "decorator_list", [])
+            )
+            if not is_builder:
+                continue
+            for node in _own_frame_nodes(fn.node):
+                if not (isinstance(node, ast.Call) and _tail(imports.resolve(node.func)) == "AuditProgram"):
+                    continue
+                fn_expr: Optional[ast.expr] = None
+                for kw in node.keywords:
+                    if kw.arg == "fn":
+                        fn_expr = kw.value
+                if fn_expr is None and len(node.args) > 1:
+                    fn_expr = node.args[1]
+                if isinstance(fn_expr, ast.Name):
+                    for target in module.by_name.get(fn_expr.id, []):
+                        target.mark_traced("registered audit program", root=True)
+
+    def _collect_module_hazards(self, module: ModuleModel) -> None:
+        """Taint-free module-wide hazards: GJ004's jit-in-a-loop and GJ005's
+        static-argument call-site checks. These apply to HOST code (the loop
+        that drives a jitted function), so they don't ride the traced walk."""
+        imports = module.imports
+
+        def is_jit_call(node: ast.AST) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            resolved = imports.resolve(node.func)
+            if _tail(resolved) in ("jit", "pjit") and _is_trace_wrapper(resolved):
+                return True
+            if _tail(resolved) == "partial" and node.args:
+                return _tail(imports.resolve(node.args[0])) in ("jit", "pjit")
+            return False
+
+        # qualname lookup for event anchoring
+        def qual_of(stack: List[str]) -> str:
+            return ".".join(stack) if stack else "<module>"
+
+        # GJ005 pre-pass: names bound to jit(..., static_argnums/names=...)
+        # — as a module-level/function-level assignment or a decorated def
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                call = node.value
+                if isinstance(call, ast.Call) and _tail(imports.resolve(call.func)) in ("jit", "pjit"):
+                    nums: Set[int] = set()
+                    names: Set[str] = set()
+                    for kw in call.keywords:
+                        if kw.arg not in ("static_argnums", "static_argnames"):
+                            continue
+                        try:
+                            val = ast.literal_eval(kw.value)
+                        except (ValueError, SyntaxError):
+                            continue
+                        vals = val if isinstance(val, (tuple, list)) else (val,)
+                        for v in vals:
+                            if isinstance(v, int) and not isinstance(v, bool):
+                                nums.add(v)
+                            elif isinstance(v, str):
+                                names.add(v)
+                    if nums or names:
+                        module.static_jit_bindings[node.targets[0].id] = (
+                            tuple(sorted(nums)),
+                            tuple(sorted(names)),
+                        )
+        for fn in module.functions.values():
+            if fn.static_argnums or fn.static_argnames:
+                module.static_jit_bindings.setdefault(
+                    fn.name, (tuple(sorted(fn.static_argnums)), tuple(sorted(fn.static_argnames)))
+                )
+
+        # one recursive walk carrying (qualname stack, loop-target stack)
+        def walk(node: ast.AST, qstack: List[str], loop_vars: List[Set[str]], loop_depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk(child, qstack + [child.name], loop_vars, 0)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    walk(child, qstack + [child.name], loop_vars, loop_depth)
+                    continue
+                if isinstance(child, (ast.For, ast.AsyncFor)):
+                    targets = {
+                        sub.id
+                        for sub in ast.walk(child.target)
+                        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)
+                    }
+                    self._scan_loop_frame(module, child, qual_of(qstack), is_jit_call)
+                    walk_iter_only(child.iter, qstack, loop_vars, loop_depth)
+                    for sub in child.body + child.orelse:
+                        walk(sub, qstack, loop_vars + [targets], loop_depth + 1)
+                    continue
+                if isinstance(child, ast.While):
+                    self._scan_loop_frame(module, child, qual_of(qstack), is_jit_call)
+                    for sub in child.body + child.orelse:
+                        walk(sub, qstack, loop_vars, loop_depth + 1)
+                    walk(child.test, qstack, loop_vars, loop_depth)
+                    continue
+                if isinstance(child, ast.Call):
+                    self._check_static_call(module, child, qual_of(qstack), loop_vars)
+                walk(child, qstack, loop_vars, loop_depth)
+
+        def walk_iter_only(node: ast.AST, qstack, loop_vars, loop_depth) -> None:
+            if isinstance(node, ast.Call):
+                self._check_static_call(module, node, qual_of(qstack), loop_vars)
+            for child in ast.iter_child_nodes(node):
+                walk_iter_only(child, qstack, loop_vars, loop_depth)
+
+        walk(module.tree, [], [], 0)
+
+    def _scan_loop_frame(self, module: ModuleModel, loop: ast.AST, qualname: str, is_jit_call) -> None:
+        """GJ004: `jax.jit(...)` constructed inside a loop body — a fresh
+        wrapper per iteration discards the compilation cache every time."""
+        stack = list(loop.body) + list(getattr(loop, "orelse", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                # decorator exprs still evaluate per iteration
+                for dec in getattr(node, "decorator_list", []):
+                    if is_jit_call(dec):
+                        module.events.append(_ev("GJ004", "jit_in_loop", dec, qualname))
+                continue
+            if is_jit_call(node):
+                module.events.append(_ev("GJ004", "jit_in_loop", node, qualname))
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_static_call(
+        self, module: ModuleModel, node: ast.Call, qualname: str, loop_vars: List[Set[str]]
+    ) -> None:
+        """GJ005 at a call site of a statically-argnum'd jitted binding:
+        unhashable literals and loop-varying values at static positions."""
+        if not isinstance(node.func, ast.Name):
+            return
+        binding = module.static_jit_bindings.get(node.func.id)
+        if binding is None:
+            return
+        nums, names = binding
+        enclosing = set().union(*loop_vars) if loop_vars else set()
+
+        def judge(arg: ast.expr, where: str) -> None:
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                module.events.append(
+                    _ev("GJ005", "static_unhashable", arg, qualname, fn=node.func.id, where=where)
+                )
+                return
+            used = {
+                sub.id for sub in ast.walk(arg) if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+            }
+            varying = used & enclosing
+            if varying:
+                module.events.append(
+                    _ev(
+                        "GJ005",
+                        "static_loop_varying",
+                        arg,
+                        qualname,
+                        fn=node.func.id,
+                        where=where,
+                        var=sorted(varying)[0],
+                    )
+                )
+
+        for pos in nums:
+            if pos < len(node.args):
+                judge(node.args[pos], f"static_argnums position {pos}")
+        for kw in node.keywords:
+            if kw.arg in names:
+                judge(kw.value, f"static_argnames '{kw.arg}'")
+
+    # -- phase 2 ------------------------------------------------------------ #
+
+    def finalize(self) -> None:
+        """Cross-module taint fixpoint: walk every traced function, collect
+        events + taint-annotated call sites, extend the traced set through
+        resolvable calls that pass traced values. Tainted-parameter sets grow
+        monotonically, so the worklist terminates."""
+        work: List[FunctionModel] = [
+            fn for m in self.modules for fn in m.functions.values() if fn.traced
+        ]
+        walked: Set[int] = set()
+        guard = 0
+        while work:
+            guard += 1
+            if guard > 100_000:  # pragma: no cover - structural safety valve
+                break
+            fn = work.pop()
+            fn.events = []  # re-walks must not duplicate prior events
+            fn.calls = []
+            walker = _TracedWalk(fn)
+            walker.run()
+            walked.add(id(fn))
+            for call in fn.calls:
+                if not (any(call.arg_taint) or any(t for _, t in call.kw_taint)):
+                    continue  # static-only call: concrete host values at trace time
+                for callee in self._resolve_call(fn, call):
+                    if callee is fn:
+                        continue
+                    grew = self._bind_taint(fn, call, callee)
+                    if grew or id(callee) not in walked:
+                        if callee not in work:
+                            work.append(callee)
+
+        for m in self.modules:
+            for fn in m.functions.values():
+                if fn.loop_body_kinds and fn.traced:
+                    self._scan_carry_check(fn)
+
+    def _resolve_call(self, caller: FunctionModel, call: _CallSite) -> List[FunctionModel]:
+        module = caller.module
+        if call.func_kind == "name":
+            local = module.by_name.get(call.target)
+            if local:
+                return list(local)
+            dotted = module.imports.aliases.get(call.target)
+            if dotted:
+                return self._resolve_dotted(dotted)
+            return []
+        if call.func_kind == "self":
+            if caller.class_name is None:
+                return []
+            qual = f"{caller.class_name}.{call.target}"
+            fn = module.functions.get(qual)
+            return [fn] if fn is not None else []
+        if call.func_kind == "dotted":
+            return self._resolve_dotted(call.target)
+        return []
+
+    def _resolve_dotted(self, dotted: str) -> List[FunctionModel]:
+        if "." not in dotted:
+            return []
+        modname, fname = dotted.rsplit(".", 1)
+        target = self.by_modname.get(modname)
+        if target is None:
+            return []
+        fn = target.functions.get(fname)  # top-level functions only
+        return [fn] if fn is not None else []
+
+    def _bind_taint(self, caller: FunctionModel, call: _CallSite, callee: FunctionModel) -> bool:
+        """Map tainted arguments at the call site onto callee parameters;
+        returns True when the callee's tainted set grew."""
+        params = callee.params()
+        if params and params[0] in ("self", "cls") and call.func_kind in ("self", "dotted"):
+            params = params[1:]
+        exclusions = _STATIC_PARAM_NAMES
+        added = False
+        for i, tainted in enumerate(call.arg_taint):
+            if not tainted or i >= len(params):
+                continue
+            p = params[i]
+            if p in exclusions or p in callee.static_argnames:
+                continue
+            if p not in callee.tainted_params:
+                callee.tainted_params.add(p)
+                added = True
+        for kwname, tainted in call.kw_taint:
+            if not tainted or kwname in exclusions or kwname in callee.static_argnames:
+                continue
+            if kwname in callee.params() or kwname in {
+                a.arg for a in getattr(callee.node, "args", ast.arguments(
+                    posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[], defaults=[]
+                )).kwonlyargs
+            }:
+                if kwname not in callee.tainted_params:
+                    callee.tainted_params.add(kwname)
+                    added = True
+        if added or not callee.traced:
+            callee.mark_traced(f"called from {caller.qualname} with traced arguments", root=False)
+        return added
+
+    def _scan_carry_check(self, fn: FunctionModel) -> None:
+        """GJ001: a carry key spent in a scan/fori/while body and returned
+        UNSPLIT in the carry — every iteration replays the same stream."""
+        node = fn.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        params = fn.params()
+        carry_pos = 1 if "fori_loop" in fn.loop_body_kinds and len(params) > 1 else 0
+        if carry_pos >= len(params):
+            return
+        carry = params[carry_pos]
+
+        # carry-derived names: the carry param itself + unpack targets of
+        # `a, b = carry`, `k = carry[0]`, `k, acc = carry[0], carry[1]`,
+        # transitively through plain aliases — iterated to a fixpoint because
+        # frame iteration order is not statement order
+        derived: Set[str] = {carry}
+
+        def _from_derived(rhs: ast.expr) -> bool:
+            if isinstance(rhs, ast.Name):
+                return rhs.id in derived
+            if isinstance(rhs, ast.Subscript):
+                return isinstance(rhs.value, ast.Name) and rhs.value.id in derived
+            return False
+
+        assigns = [sub for sub in _own_frame_nodes(node) if isinstance(sub, ast.Assign)]
+        changed = True
+        while changed:
+            changed = False
+            for sub in assigns:
+                rhs = sub.value
+                for t in sub.targets:
+                    new: Set[str] = set()
+                    if isinstance(t, (ast.Tuple, ast.List)) and isinstance(rhs, (ast.Tuple, ast.List)) and len(
+                        t.elts
+                    ) == len(rhs.elts):
+                        # element-wise: k, acc = carry[0], carry[1]
+                        for te, ve in zip(t.elts, rhs.elts):
+                            if isinstance(te, ast.Name) and _from_derived(ve):
+                                new.add(te.id)
+                    elif _from_derived(rhs):
+                        new.update(
+                            s.id
+                            for s in ast.walk(t)
+                            if isinstance(s, ast.Name) and isinstance(s.ctx, ast.Store)
+                        )
+                    if new - derived:
+                        derived |= new
+                        changed = True
+
+        # a name is REFRESHED when assigned from a non-carry-derived RHS
+        # (a split result, a fresh fold_in, ...) — the initial unpack from
+        # the carry itself is derivation, not a refresh
+        refreshed: Set[str] = set()
+        consumed: Dict[str, int] = {}
+        imports = fn.module.imports
+        for sub in _own_frame_nodes(node):
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                rhs = getattr(sub, "value", None)
+                for t in targets:
+                    if isinstance(t, (ast.Tuple, ast.List)) and isinstance(rhs, (ast.Tuple, ast.List)) and len(
+                        t.elts
+                    ) == len(rhs.elts):
+                        for te, ve in zip(t.elts, rhs.elts):
+                            if isinstance(te, ast.Name) and not _from_derived(ve):
+                                refreshed.add(te.id)
+                    elif rhs is not None and not _from_derived(rhs):
+                        refreshed.update(
+                            s.id
+                            for s in ast.walk(t)
+                            if isinstance(s, ast.Name) and isinstance(s.ctx, ast.Store)
+                        )
+            if isinstance(sub, ast.Call):
+                resolved = imports.resolve(sub.func)
+                if _is_jax_random(resolved) and _tail(resolved) in _KEY_CONSUMERS:
+                    key_arg = sub.args[0] if sub.args else None
+                    if key_arg is None:
+                        for kw in sub.keywords:
+                            if kw.arg == "key":
+                                key_arg = kw.value
+                    if isinstance(key_arg, ast.Name) and key_arg.id in derived:
+                        consumed.setdefault(key_arg.id, sub.lineno)
+
+        stale = {name for name in consumed if name not in refreshed}
+        if not stale:
+            return
+        for sub in _own_frame_nodes(node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            returned = {
+                s.id for s in ast.walk(sub.value) if isinstance(s, ast.Name) and isinstance(s.ctx, ast.Load)
+            }
+            for name in sorted(stale & returned):
+                fn.events.append(
+                    _ev(
+                        "GJ001",
+                        "scan_carry",
+                        sub,
+                        fn.qualname,
+                        name=name,
+                        loop=sorted(fn.loop_body_kinds)[0],
+                        consume_line=consumed[name],
+                    )
+                )
+
+    # -- views -------------------------------------------------------------- #
+
+    def traced_functions(self) -> List[FunctionModel]:
+        return [fn for m in self.modules for fn in m.functions.values() if fn.traced]

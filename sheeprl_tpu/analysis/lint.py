@@ -200,6 +200,61 @@ def fingerprint(f: Finding) -> str:
     return f"{f.path}::{f.rule}::{f.function}::{msg}"
 
 
+#: The stale-suppression pseudo-rule, shared by every AST tier: a
+#: ``# graft-*: disable=...`` directive that absorbed nothing this run is a
+#: dead justification riding fixed code. Reported warn-level by default;
+#: ``--strict-suppressions`` promotes it into the findings stream (exit 1).
+SUPPRESSION_RULE = "SUP001"
+
+
+def stale_suppression_findings(
+    tool: str,
+    catalog: Dict[str, str],
+    declared: Dict[int, Optional[Set[str]]],
+    used: Dict[int, Set[str]],
+    path: str,
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Compare a file's declared suppressions against the rules that actually
+    hit them. ONE implementation for every tier (graft-lint/sync/jit) so
+    staleness semantics cannot drift. A directive naming a rule that this run
+    did not execute (``--select``/``--ignore`` filtered it out) is NOT stale —
+    the rule might fire on a full run. A directive naming a rule outside the
+    tier's catalog can never fire and is always stale."""
+    rules_run = set(catalog) if select is None else (select & set(catalog))
+    if ignore:
+        rules_run -= set(ignore)
+    out: List[Finding] = []
+    for line in sorted(declared):
+        rules = declared[line]
+        absorbed = used.get(line, set())
+        if rules is None:
+            if not absorbed:
+                out.append(
+                    Finding(
+                        SUPPRESSION_RULE, path, line, 1,
+                        f"stale suppression: `# {tool}: disable` absorbs nothing on this "
+                        "line (remove the dead directive)",
+                    )
+                )
+            continue
+        for rule in sorted(rules):
+            if rule in absorbed:
+                continue
+            if rule in catalog and rule not in rules_run:
+                continue  # rule filtered out this run: can't judge staleness
+            hint = "" if rule in catalog else f" ({rule} is not a {tool} rule and can never fire)"
+            out.append(
+                Finding(
+                    SUPPRESSION_RULE, path, line, 1,
+                    f"stale suppression: `# {tool}: disable={rule}` — {rule} does not fire "
+                    f"on this line{hint} (remove the dead directive)",
+                )
+            )
+    return out
+
+
 # --------------------------------------------------------------------------- #
 # module context: imports, aliases, suppressions
 # --------------------------------------------------------------------------- #
@@ -211,6 +266,7 @@ class _ModuleContext:
         self.path = path
         self.aliases: Dict[str, str] = {}  # local name -> canonical dotted prefix
         self.suppressed: Dict[int, Optional[Set[str]]] = {}  # line -> rules (None = all)
+        self.sup_used: Dict[int, Set[str]] = {}  # line -> rules a directive absorbed
         self._collect_suppressions()
 
     def _collect_suppressions(self) -> None:
@@ -220,7 +276,10 @@ class _ModuleContext:
         if line not in self.suppressed:
             return False
         rules = self.suppressed[line]
-        return rules is None or rule in rules
+        if rules is None or rule in rules:
+            self.sup_used.setdefault(line, set()).add(rule)
+            return True
+        return False
 
     def add_import(self, node: ast.AST) -> None:
         if isinstance(node, ast.Import):
@@ -1105,6 +1164,7 @@ def analyze_source(
     path: str = "<string>",
     select: Optional[Set[str]] = None,
     ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
 ) -> List[Finding]:
     try:
         tree = ast.parse(src)
@@ -1130,6 +1190,14 @@ def analyze_source(
     for info in funcs.values():
         _FnAnalysis(ctx, info, findings, donate_sites).run()
     _check_gl008(ctx, tree, funcs, findings)
+
+    if stale_out is not None:
+        stale_out.extend(
+            stale_suppression_findings(
+                "graft-lint", RULES, ctx.suppressed, ctx.sup_used, path,
+                select=select, ignore=ignore,
+            )
+        )
 
     out = [
         f
@@ -1161,6 +1229,7 @@ def analyze_paths(
     paths: Sequence[str],
     select: Optional[Set[str]] = None,
     ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
 ) -> List[Finding]:
     findings: List[Finding] = []
     for path in iter_python_files(paths):
@@ -1171,7 +1240,9 @@ def analyze_paths(
             findings.append(Finding("GL000", path, 0, 1, f"unreadable: {e}", "<module>"))
             continue
         rel = os.path.relpath(path)
-        findings.extend(analyze_source(src, rel, select=select, ignore=ignore))
+        findings.extend(
+            analyze_source(src, rel, select=select, ignore=ignore, stale_out=stale_out)
+        )
     return findings
 
 

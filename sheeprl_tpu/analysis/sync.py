@@ -55,7 +55,12 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from sheeprl_tpu.analysis.lint import Finding, collect_suppressions, iter_python_files
+from sheeprl_tpu.analysis.lint import (
+    Finding,
+    collect_suppressions,
+    iter_python_files,
+    stale_suppression_findings,
+)
 from sheeprl_tpu.analysis.syncgraph import Corpus
 
 __all__ = [
@@ -85,12 +90,16 @@ class _Suppressions:
 
     def __init__(self, src: str) -> None:
         self.lines = collect_suppressions(src, tool="graft-sync")
+        self.used: Dict[int, Set[str]] = {}
 
     def active(self, rule: str, line: int) -> bool:
         if line not in self.lines:
             return False
         rules = self.lines[line]
-        return rules is None or rule in rules
+        if rules is None or rule in rules:
+            self.used.setdefault(line, set()).add(rule)
+            return True
+        return False
 
 
 def _norm(path: str) -> str:
@@ -101,6 +110,7 @@ def analyze_sync_sources(
     sources: Sequence[Tuple[str, str]],
     select: Optional[Set[str]] = None,
     ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
 ) -> List[Finding]:
     """Run the GS rules over ``(src, path)`` pairs as ONE corpus (GS002's
     order graph is cross-module by design)."""
@@ -163,6 +173,16 @@ def analyze_sync_sources(
                 "waits allow spurious wakeups and notify can race the predicate; use "
                 "`while not pred: cond.wait()` (or wait_for)",
                 w.qualname,
+            )
+
+    if stale_out is not None:
+        for src, path in sources:
+            sup = suppressions[path]
+            stale_out.extend(
+                stale_suppression_findings(
+                    "graft-sync", SYNC_RULES, sup.lines, sup.used, path,
+                    select=select, ignore=ignore,
+                )
             )
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
@@ -286,15 +306,19 @@ def analyze_source_sync(
     path: str = "<string>",
     select: Optional[Set[str]] = None,
     ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
 ) -> List[Finding]:
     """Single-module convenience wrapper (tests, fixtures)."""
-    return analyze_sync_sources([(src, path)], select=select, ignore=ignore)
+    return analyze_sync_sources(
+        [(src, path)], select=select, ignore=ignore, stale_out=stale_out
+    )
 
 
 def analyze_sync_paths(
     paths: Sequence[str],
     select: Optional[Set[str]] = None,
     ignore: Optional[Set[str]] = None,
+    stale_out: Optional[List[Finding]] = None,
 ) -> List[Finding]:
     sources: List[Tuple[str, str]] = []
     findings: List[Finding] = []
@@ -306,6 +330,8 @@ def analyze_sync_paths(
             findings.append(Finding("GS000", path, 0, 1, f"unreadable: {e}", "<module>"))
             continue
         sources.append((src, os.path.relpath(path)))
-    findings.extend(analyze_sync_sources(sources, select=select, ignore=ignore))
+    findings.extend(
+        analyze_sync_sources(sources, select=select, ignore=ignore, stale_out=stale_out)
+    )
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

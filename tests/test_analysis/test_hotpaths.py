@@ -78,6 +78,42 @@ def test_ppo_anakin_dry_run_clean(tmp_path, trace_hygiene):
     _assert_quiet(trace_hygiene, ["ppo_anakin.block"])
 
 
+def test_ppo_anakin_block_raw_transfer_guard(tmp_path, trace_hygiene, monkeypatch):
+    """The strict trace-hygiene lane, un-mediated: a literal
+    ``jax.transfer_guard("disallow")`` armed around EVERY fused-block
+    dispatch — including the maiden trace+compile+execute call that
+    tracecheck's own steady-state guard deliberately exempts as warmup.
+    Proves the block program performs zero implicit transfers from its very
+    first dispatch: inputs are explicitly staged (``device_put`` /
+    ``shard_data``), constants are device-resident, and nothing inside the
+    compiled program reaches back to the host. This is the dynamic sample of
+    what graft-jit's GJ002 proves statically for all paths."""
+    import functools
+
+    import jax
+
+    from sheeprl_tpu.algos.ppo import ppo_anakin as anakin_mod
+
+    dispatched = []
+    orig_call = anakin_mod.AnakinBlockCache.__call__
+
+    def guarded(self, n_iters):
+        fn = orig_call(self, n_iters)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            dispatched.append(n_iters)
+            with jax.transfer_guard("disallow"):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    monkeypatch.setattr(anakin_mod.AnakinBlockCache, "__call__", guarded)
+    run(_args(tmp_path, "ppo_anakin", env="gym", extra=PPO_FAST))
+    assert dispatched, "the fused block was never dispatched under the raw guard"
+    _assert_quiet(trace_hygiene, ["ppo_anakin.block"])
+
+
 def test_ppo_anakin_steady_state_clean(tmp_path, trace_hygiene):
     """Multiple fused-block dispatches (NOT a dry run): the second call is
     fed by the first call's donated outputs, so this pins the sharding-level
